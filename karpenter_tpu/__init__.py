@@ -1,0 +1,31 @@
+"""karpenter-tpu: a TPU-native cluster node-provisioning framework.
+
+A ground-up rebuild of the capabilities of Karpenter (the Kubernetes
+node-provisioning autoscaler, reference: asimshankar/karpenter): watch for
+unschedulable pods, solve a constrained bin-packing problem over pods x
+instance types (resources, node selectors, taints/tolerations, pod
+affinity/anti-affinity, topology spread), launch cost-optimal nodes through a
+pluggable cloud provider, and continuously consolidate the cluster.
+
+Where the reference implements its scheduling core as a sequential
+first-fit-decreasing loop in Go (reference:
+pkg/controllers/provisioning/scheduling/scheduler.go), this framework reframes
+provisioning and consolidation as dense constraint-matrix programs solved on
+TPU via JAX/pjit, with an exact host-side FFD implementation serving as both
+the differential-testing oracle and the fallback path.
+
+Layout (mirrors SURVEY.md section 7):
+  api/            object model + Provisioner CRD equivalent + label taxonomy
+  scheduling/     constraint algebra (Requirement sets, taints, node templates)
+  core/           host scheduler core (FFD oracle) + controllers
+  ir/             dense problem IR: vocab interning + matrix encoders
+  ops/            JAX kernels: feasibility masks, on-device packing
+  solver/         the TPU solver service (jit, bucketing, fallback)
+  parallel/       device mesh + sharded solver (ICI-scaled)
+  cloudprovider/  provider plugin boundary + fake provider
+  kube/           in-memory cluster API (apiserver stand-in for tests/sim)
+  controllers/    provisioning, state, consolidation, node, termination, ...
+  utils/          quantities, resource arithmetic
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,95 @@
+"""Label taxonomy: well-known, restricted, and normalized labels.
+
+Mirrors the reference's pkg/apis/provisioning/v1alpha5/labels.go:25-122 label
+rules: a small set of well-known node labels the scheduler understands natively
+(open-world if undefined), restricted domains users may not set, and
+normalization of deprecated beta labels onto their stable equivalents.
+"""
+
+from __future__ import annotations
+
+# Kubernetes stable labels
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+LABEL_TOPOLOGY_REGION = "topology.kubernetes.io/region"
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+LABEL_ARCH = "kubernetes.io/arch"
+LABEL_OS = "kubernetes.io/os"
+
+# Framework-specific domain and labels (karpenter.sh analog)
+GROUP = "karpenter.sh"
+PROVISIONER_NAME_LABEL = GROUP + "/provisioner-name"
+LABEL_CAPACITY_TYPE = GROUP + "/capacity-type"
+LABEL_NODE_INITIALIZED = GROUP + "/initialized"
+DO_NOT_EVICT_ANNOTATION = GROUP + "/do-not-evict"
+DO_NOT_CONSOLIDATE_ANNOTATION = GROUP + "/do-not-consolidate"
+EMPTINESS_TIMESTAMP_ANNOTATION = GROUP + "/emptiness-timestamp"
+TERMINATION_FINALIZER = GROUP + "/termination"
+
+# Node lifecycle taints (mirrors k8s well-known taints)
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+ARCHITECTURE_AMD64 = "amd64"
+ARCHITECTURE_ARM64 = "arm64"
+OS_LINUX = "linux"
+
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+RESTRICTED_LABEL_DOMAINS = {"kubernetes.io", "k8s.io", GROUP}
+LABEL_DOMAIN_EXCEPTIONS = {"kops.k8s.io", "node.kubernetes.io"}
+
+# WellKnownLabels is deliberately mutable: providers register their own
+# well-known labels (the fake provider registers size/special/integer the same
+# way the reference's fake does in pkg/cloudprovider/fake/instancetype.go:41).
+WELL_KNOWN_LABELS = {
+    PROVISIONER_NAME_LABEL,
+    LABEL_TOPOLOGY_ZONE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_INSTANCE_TYPE,
+    LABEL_ARCH,
+    LABEL_OS,
+    LABEL_CAPACITY_TYPE,
+}
+
+RESTRICTED_LABELS = {EMPTINESS_TIMESTAMP_ANNOTATION, LABEL_HOSTNAME}
+
+NORMALIZED_LABELS = {
+    "failure-domain.beta.kubernetes.io/zone": LABEL_TOPOLOGY_ZONE,
+    "failure-domain.beta.kubernetes.io/region": LABEL_TOPOLOGY_REGION,
+    "beta.kubernetes.io/arch": LABEL_ARCH,
+    "beta.kubernetes.io/os": LABEL_OS,
+    "beta.kubernetes.io/instance-type": LABEL_INSTANCE_TYPE,
+}
+
+
+def normalize_label(key: str) -> str:
+    return NORMALIZED_LABELS.get(key, key)
+
+
+def label_domain(key: str) -> str:
+    if "/" in key:
+        return key.split("/", 1)[0]
+    return ""
+
+
+def is_restricted_node_label(key: str) -> bool:
+    """True if the framework must not inject this label onto nodes."""
+    if key in WELL_KNOWN_LABELS:
+        return True
+    domain = label_domain(key)
+    if domain in LABEL_DOMAIN_EXCEPTIONS:
+        return False
+    for restricted in RESTRICTED_LABEL_DOMAINS:
+        if domain == restricted or domain.endswith("." + restricted):
+            return True
+    return key in RESTRICTED_LABELS
+
+
+def is_restricted_label(key: str) -> bool:
+    """True if users may not set this label on provisioners/pods."""
+    if key in WELL_KNOWN_LABELS:
+        return False
+    return is_restricted_node_label(key)

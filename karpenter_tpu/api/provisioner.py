@@ -1,0 +1,128 @@
+"""Provisioner: the user-facing provisioning policy object.
+
+Equivalent of the reference's v1alpha5 Provisioner CRD
+(pkg/apis/provisioning/v1alpha5/provisioner.go:31-160): constraints (labels,
+taints, startup taints, requirements, kubelet config, provider config),
+lifecycle TTLs, resource limits, weight, and consolidation policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import labels as lbl
+from .objects import NodeSelectorRequirement, ObjectMeta, Taint
+
+
+@dataclass
+class KubeletConfiguration:
+    cluster_dns: List[str] = field(default_factory=list)
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    system_reserved: Dict[str, float] = field(default_factory=dict)
+    kube_reserved: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Consolidation:
+    enabled: bool = False
+
+
+@dataclass
+class Limits:
+    resources: Dict[str, float] = field(default_factory=dict)
+
+    def exceeded_by(self, usage: Dict[str, float]) -> Optional[str]:
+        """Returns a reason string if usage exceeds any limit, else None."""
+        for name, limit in self.resources.items():
+            if usage.get(name, 0.0) > limit + 1e-9:
+                return f"{name} resource usage of {usage.get(name, 0.0)} exceeds limit of {limit}"
+        return None
+
+
+@dataclass
+class ProvisionerSpec:
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    requirements: List[NodeSelectorRequirement] = field(default_factory=list)
+    kubelet_configuration: Optional[KubeletConfiguration] = None
+    provider: Optional[dict] = None
+    provider_ref: Optional[str] = None
+    ttl_seconds_after_empty: Optional[float] = None
+    ttl_seconds_until_expired: Optional[float] = None
+    limits: Optional[Limits] = None
+    weight: Optional[int] = None
+    consolidation: Optional[Consolidation] = None
+
+
+@dataclass
+class ProvisionerStatus:
+    resources: Dict[str, float] = field(default_factory=dict)
+    last_scale_time: Optional[float] = None
+    conditions: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Provisioner:
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="default", namespace=""))
+    spec: ProvisionerSpec = field(default_factory=ProvisionerSpec)
+    status: ProvisionerStatus = field(default_factory=ProvisionerStatus)
+
+    kind = "Provisioner"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def __hash__(self):
+        return hash(self.metadata.uid)
+
+    def __eq__(self, other):
+        return isinstance(other, Provisioner) and other.metadata.uid == self.metadata.uid
+
+
+def order_by_weight(provisioners: List[Provisioner]) -> List[Provisioner]:
+    """Sort descending by spec.weight (None == 0), mirrors provisioner.go:151."""
+    return sorted(provisioners, key=lambda p: -(p.spec.weight or 0))
+
+
+def validate_provisioner(provisioner: Provisioner) -> List[str]:
+    """Admission-style validation, equivalent of provisioner_validation.go.
+
+    Returns a list of human-readable violations (empty == valid).
+    """
+    from .objects import OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN, OP_LT, OP_NOT_IN
+
+    errs: List[str] = []
+    spec = provisioner.spec
+    for key in spec.labels:
+        if lbl.is_restricted_label(key):
+            errs.append(f"label {key} is restricted")
+    for taint in spec.taints + spec.startup_taints:
+        if not taint.key:
+            errs.append("taint key is required")
+        if taint.effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
+            errs.append(f"invalid taint effect {taint.effect!r}")
+    seen = set()
+    for req in spec.requirements:
+        if req.operator not in (OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT):
+            errs.append(f"invalid requirement operator {req.operator!r}")
+        if req.operator in (OP_IN, OP_NOT_IN) and not req.values:
+            errs.append(f"requirement {req.key} with operator {req.operator} must have values")
+        if req.operator in (OP_EXISTS, OP_DOES_NOT_EXIST) and req.values:
+            errs.append(f"requirement {req.key} with operator {req.operator} must not have values")
+        if req.operator in (OP_GT, OP_LT):
+            if len(req.values) != 1 or not req.values[0].lstrip("-").isdigit():
+                errs.append(f"requirement {req.key} with operator {req.operator} needs a single integer value")
+        if lbl.is_restricted_label(req.key):
+            errs.append(f"requirement key {req.key} is restricted")
+        seen.add(req.key)
+    if spec.ttl_seconds_after_empty is not None and spec.ttl_seconds_after_empty < 0:
+        errs.append("ttlSecondsAfterEmpty must be non-negative")
+    if spec.ttl_seconds_after_empty is not None and spec.consolidation and spec.consolidation.enabled:
+        errs.append("ttlSecondsAfterEmpty is mutually exclusive with consolidation.enabled")
+    if spec.weight is not None and not (0 <= spec.weight <= 100):
+        errs.append("weight must be within [0, 100]")
+    return errs

@@ -1,0 +1,173 @@
+"""Test object builders, equivalent of the reference's pkg/test fixtures."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    NodeCondition,
+    Volume,
+    PersistentVolumeClaimVolumeSource,
+)
+from karpenter_tpu.api.provisioner import Consolidation, Limits, Provisioner, ProvisionerSpec
+from karpenter_tpu.utils.quantity import parse_quantity
+
+_counter = itertools.count(1)
+
+
+def _parse_resources(resources: Optional[Dict[str, object]]) -> Dict[str, float]:
+    return {k: parse_quantity(v) for k, v in (resources or {}).items()}
+
+
+def make_pod(
+    name: str = "",
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    requests: Optional[Dict[str, object]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    node_requirements: Optional[List[NodeSelectorRequirement]] = None,
+    node_preferences: Optional[List[PreferredSchedulingTerm]] = None,
+    required_node_terms: Optional[List[NodeSelectorTerm]] = None,
+    pod_requirements: Optional[List[PodAffinityTerm]] = None,
+    pod_preferences: Optional[List[WeightedPodAffinityTerm]] = None,
+    pod_anti_requirements: Optional[List[PodAffinityTerm]] = None,
+    pod_anti_preferences: Optional[List[WeightedPodAffinityTerm]] = None,
+    topology_spread_constraints: Optional[List[TopologySpreadConstraint]] = None,
+    tolerations: Optional[List[Toleration]] = None,
+    host_ports: Optional[List[ContainerPort]] = None,
+    pvcs: Optional[List[str]] = None,
+    node_name: str = "",
+    phase: str = "Pending",
+    creation_timestamp: float = 0.0,
+    priority: Optional[int] = None,
+    unschedulable: bool = True,
+) -> Pod:
+    """Build a pod; by default a pending pod marked unschedulable (the
+    provisionable state, equivalent of test.UnschedulablePod)."""
+    if not name:
+        name = f"pod-{next(_counter):05d}"
+    affinity = None
+    if node_requirements or node_preferences or required_node_terms or pod_requirements or pod_preferences or pod_anti_requirements or pod_anti_preferences:
+        node_affinity = None
+        if node_requirements or node_preferences or required_node_terms:
+            required = required_node_terms or []
+            if node_requirements:
+                required = [NodeSelectorTerm(match_expressions=list(node_requirements))] + list(required)
+            node_affinity = NodeAffinity(required=required, preferred=list(node_preferences or []))
+        pod_affinity = None
+        if pod_requirements or pod_preferences:
+            pod_affinity = PodAffinity(required=list(pod_requirements or []), preferred=list(pod_preferences or []))
+        anti_affinity = None
+        if pod_anti_requirements or pod_anti_preferences:
+            anti_affinity = PodAntiAffinity(required=list(pod_anti_requirements or []), preferred=list(pod_anti_preferences or []))
+        affinity = Affinity(node_affinity=node_affinity, pod_affinity=pod_affinity, pod_anti_affinity=anti_affinity)
+
+    container = Container(
+        resources=ResourceRequirements(requests=_parse_resources(requests), limits=_parse_resources(limits)),
+        ports=list(host_ports or []),
+    )
+    volumes = [Volume(name=f"vol-{i}", persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim_name=c)) for i, c in enumerate(pvcs or [])]
+    conditions = []
+    if unschedulable and not node_name:
+        conditions.append(PodCondition(type="PodScheduled", status="False", reason="Unschedulable"))
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+            creation_timestamp=creation_timestamp,
+        ),
+        spec=PodSpec(
+            containers=[container],
+            node_selector=dict(node_selector or {}),
+            affinity=affinity,
+            tolerations=list(tolerations or []),
+            topology_spread_constraints=list(topology_spread_constraints or []),
+            node_name=node_name,
+            volumes=volumes,
+            priority=priority,
+        ),
+        status=PodStatus(phase=phase, conditions=conditions),
+    )
+
+
+def make_pods(count: int, **kwargs) -> List[Pod]:
+    return [make_pod(**kwargs) for _ in range(count)]
+
+
+def make_provisioner(
+    name: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    taints=None,
+    startup_taints=None,
+    requirements: Optional[List[NodeSelectorRequirement]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    weight: Optional[int] = None,
+    ttl_seconds_after_empty: Optional[float] = None,
+    ttl_seconds_until_expired: Optional[float] = None,
+    consolidation_enabled: Optional[bool] = None,
+    provider: Optional[dict] = None,
+) -> Provisioner:
+    spec = ProvisionerSpec(
+        labels=dict(labels or {}),
+        taints=list(taints or []),
+        startup_taints=list(startup_taints or []),
+        requirements=list(requirements or []),
+        limits=Limits(resources=_parse_resources(limits)) if limits is not None else None,
+        weight=weight,
+        ttl_seconds_after_empty=ttl_seconds_after_empty,
+        ttl_seconds_until_expired=ttl_seconds_until_expired,
+        consolidation=Consolidation(enabled=consolidation_enabled) if consolidation_enabled is not None else None,
+        provider=provider,
+    )
+    return Provisioner(metadata=ObjectMeta(name=name, namespace=""), spec=spec)
+
+
+def make_node(
+    name: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    taints=None,
+    allocatable: Optional[Dict[str, object]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    ready: bool = True,
+) -> Node:
+    if not name:
+        name = f"node-{next(_counter):05d}"
+    alloc = _parse_resources(allocatable)
+    cap = _parse_resources(capacity) or dict(alloc)
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
+        spec=NodeSpec(taints=list(taints or [])),
+        status=NodeStatus(
+            capacity=cap,
+            allocatable=alloc or dict(cap),
+            conditions=[NodeCondition(type="Ready", status="True" if ready else "False")],
+        ),
+    )

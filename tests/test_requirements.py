@@ -1,0 +1,256 @@
+"""Constraint-algebra tests, modeled on the reference's pkg/scheduling suites."""
+
+import pytest
+
+from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE
+from karpenter_tpu.api.objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    NodeSelectorRequirement,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements, Taints
+from tests.helpers import make_pod
+
+
+def req(key, op, *values):
+    return Requirement(key, op, *values)
+
+
+class TestRequirementIntersection:
+    def test_in_in(self):
+        r = req("k", OP_IN, "a", "b").intersection(req("k", OP_IN, "b", "c"))
+        assert r.operator() == OP_IN
+        assert r.allowed_values() == {"b"}
+
+    def test_in_in_empty(self):
+        r = req("k", OP_IN, "a").intersection(req("k", OP_IN, "c"))
+        assert r.operator() == OP_DOES_NOT_EXIST
+        assert len(r) == 0
+
+    def test_in_notin(self):
+        r = req("k", OP_IN, "a", "b").intersection(req("k", OP_NOT_IN, "b"))
+        assert r.operator() == OP_IN
+        assert r.allowed_values() == {"a"}
+
+    def test_notin_notin(self):
+        r = req("k", OP_NOT_IN, "a").intersection(req("k", OP_NOT_IN, "b"))
+        assert r.operator() == OP_NOT_IN
+        assert not r.has("a") and not r.has("b") and r.has("c")
+
+    def test_exists_in(self):
+        r = req("k", OP_EXISTS).intersection(req("k", OP_IN, "a"))
+        assert r.operator() == OP_IN
+        assert r.allowed_values() == {"a"}
+
+    def test_exists_exists(self):
+        r = req("k", OP_EXISTS).intersection(req("k", OP_EXISTS))
+        assert r.operator() == OP_EXISTS
+
+    def test_doesnotexist_anything(self):
+        r = req("k", OP_DOES_NOT_EXIST).intersection(req("k", OP_IN, "a"))
+        assert r.operator() == OP_DOES_NOT_EXIST
+
+    def test_gt_in(self):
+        r = req("k", OP_GT, "3").intersection(req("k", OP_IN, "2", "4", "8"))
+        assert r.allowed_values() == {"4", "8"}
+
+    def test_lt_in(self):
+        r = req("k", OP_LT, "5").intersection(req("k", OP_IN, "2", "4", "8"))
+        assert r.allowed_values() == {"2", "4"}
+
+    def test_gt_lt_empty_range(self):
+        r = req("k", OP_GT, "5").intersection(req("k", OP_LT, "5"))
+        assert r.operator() == OP_DOES_NOT_EXIST
+
+    def test_gt_lt_bounds_kept(self):
+        r = req("k", OP_GT, "1").intersection(req("k", OP_LT, "5"))
+        assert r.operator() == OP_EXISTS
+        assert r.has("3")
+        assert not r.has("1")
+        assert not r.has("5")
+        assert not r.has("abc")  # non-integers invalid once bounds exist
+
+    def test_commutative(self):
+        a = req("k", OP_NOT_IN, "x")
+        b = req("k", OP_IN, "x", "y")
+        assert a.intersection(b).allowed_values() == b.intersection(a).allowed_values() == {"y"}
+
+
+class TestRequirementBasics:
+    def test_has_complement(self):
+        r = req("k", OP_NOT_IN, "a")
+        assert not r.has("a")
+        assert r.has("b")
+
+    def test_any_value_deterministic(self):
+        r = req("k", OP_IN, "b", "a")
+        assert r.any_value() == "a"
+        r2 = req("k", OP_GT, "5")
+        assert r2.any_value() == "6"
+
+    def test_normalized_label(self):
+        r = req("failure-domain.beta.kubernetes.io/zone", OP_IN, "us-east-1a")
+        assert r.key == LABEL_TOPOLOGY_ZONE
+
+
+class TestRequirements:
+    def test_add_intersects(self):
+        rs = Requirements(req("k", OP_IN, "a", "b"))
+        rs.add(req("k", OP_IN, "b", "c"))
+        assert rs.get("k").allowed_values() == {"b"}
+
+    def test_get_undefined_is_exists(self):
+        rs = Requirements()
+        assert rs.get("whatever").operator() == OP_EXISTS
+
+    def test_compatible_well_known_open(self):
+        node = Requirements()  # node with no zone requirement
+        pod = Requirements(req(LABEL_TOPOLOGY_ZONE, OP_IN, "zone-1"))
+        assert node.compatible(pod) is None
+
+    def test_compatible_custom_label_denied_when_unknown(self):
+        node = Requirements()
+        pod = Requirements(req("custom-label", OP_IN, "x"))
+        assert node.compatible(pod) is not None
+
+    def test_compatible_custom_label_ok_when_known(self):
+        node = Requirements(req("custom-label", OP_IN, "x", "y"))
+        pod = Requirements(req("custom-label", OP_IN, "x"))
+        assert node.compatible(pod) is None
+
+    def test_compatible_custom_label_negative_operator_ok(self):
+        node = Requirements()
+        pod = Requirements(req("custom-label", OP_NOT_IN, "x"))
+        assert node.compatible(pod) is None
+
+    def test_intersects_conflict(self):
+        node = Requirements(req("k", OP_IN, "a"))
+        pod = Requirements(req("k", OP_IN, "b"))
+        assert node.intersects(pod) is not None
+
+    def test_intersects_double_negative_escape(self):
+        node = Requirements(req("k", OP_NOT_IN, "a"))
+        pod = Requirements(req("k", OP_DOES_NOT_EXIST))
+        # NotIn x DoesNotExist -> empty intersection but allowed
+        assert node.intersects(pod) is None
+
+    def test_from_pod_node_selector(self):
+        pod = make_pod(node_selector={"disk": "ssd"})
+        rs = Requirements.from_pod(pod)
+        assert rs.get("disk").allowed_values() == {"ssd"}
+
+    def test_from_pod_heaviest_preference(self):
+        from karpenter_tpu.api.objects import NodeSelectorTerm, PreferredSchedulingTerm
+
+        pod = make_pod(
+            node_preferences=[
+                PreferredSchedulingTerm(weight=1, preference=NodeSelectorTerm([NodeSelectorRequirement("a", OP_IN, ["1"])])),
+                PreferredSchedulingTerm(weight=50, preference=NodeSelectorTerm([NodeSelectorRequirement("b", OP_IN, ["2"])])),
+            ]
+        )
+        rs = Requirements.from_pod(pod)
+        assert rs.has("b")
+        assert not rs.has("a")
+
+    def test_labels_excludes_well_known(self):
+        rs = Requirements(req(LABEL_TOPOLOGY_ZONE, OP_IN, "z1"), req("team", OP_IN, "infra"))
+        labels = rs.labels()
+        assert labels == {"team": "infra"}
+
+
+class TestTaints:
+    def test_untolerated(self):
+        taints = Taints([Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        assert taints.tolerates(make_pod()) is not None
+
+    def test_tolerated_equal(self):
+        taints = Taints([Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        pod = make_pod(tolerations=[Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")])
+        assert taints.tolerates(pod) is None
+
+    def test_tolerated_exists(self):
+        taints = Taints([Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        pod = make_pod(tolerations=[Toleration(key="dedicated", operator="Exists")])
+        assert taints.tolerates(pod) is None
+
+    def test_wildcard_exists(self):
+        taints = Taints([Taint(key="anything", value="v", effect="NoSchedule")])
+        pod = make_pod(tolerations=[Toleration(operator="Exists")])
+        assert taints.tolerates(pod) is None
+
+    def test_effect_mismatch(self):
+        taints = Taints([Taint(key="k", value="v", effect="NoExecute")])
+        pod = make_pod(tolerations=[Toleration(key="k", operator="Exists", effect="NoSchedule")])
+        assert taints.tolerates(pod) is not None
+
+    def test_prefer_no_schedule_requires_toleration(self):
+        # matches reference semantics: relaxation adds the toleration later
+        taints = Taints([Taint(key="k", value="v", effect="PreferNoSchedule")])
+        assert taints.tolerates(make_pod()) is not None
+
+
+class TestQuantitiesAndResources:
+    def test_parse(self):
+        from karpenter_tpu.utils.quantity import parse_quantity
+
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("1Gi") == 2**30
+        assert parse_quantity("1.5Gi") == pytest.approx(1.5 * 2**30)
+        assert parse_quantity("500M") == 5e8
+
+    def test_pod_requests_max_of_init_and_running(self):
+        from karpenter_tpu.api.objects import Container, ResourceRequirements
+        from karpenter_tpu.utils import resources
+
+        pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+        pod.spec.init_containers = [
+            Container(resources=ResourceRequirements(requests={"cpu": 4.0}))
+        ]
+        out = resources.pod_requests(pod)
+        assert out["cpu"] == 4.0
+        assert out["memory"] == 2**30
+        assert out["pods"] == 1.0
+
+    def test_fits(self):
+        from karpenter_tpu.utils import resources
+
+        assert resources.fits({"cpu": 1.0}, {"cpu": 2.0, "memory": 100})
+        assert not resources.fits({"cpu": 3.0}, {"cpu": 2.0})
+        assert not resources.fits({"nvidia.com/gpu": 1.0}, {"cpu": 2.0})
+
+
+class TestProvisionerValidation:
+    def test_valid(self):
+        from karpenter_tpu.api.provisioner import validate_provisioner
+        from tests.helpers import make_provisioner
+
+        p = make_provisioner(requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["z1"])])
+        assert validate_provisioner(p) == []
+
+    def test_restricted_label(self):
+        from karpenter_tpu.api.provisioner import validate_provisioner
+        from tests.helpers import make_provisioner
+
+        p = make_provisioner(labels={"kubernetes.io/hostname": "x"})
+        assert validate_provisioner(p)
+
+    def test_empty_in_values(self):
+        from karpenter_tpu.api.provisioner import validate_provisioner
+        from tests.helpers import make_provisioner
+
+        p = make_provisioner(requirements=[NodeSelectorRequirement("team", OP_IN, [])])
+        assert validate_provisioner(p)
+
+    def test_ttl_exclusive_with_consolidation(self):
+        from karpenter_tpu.api.provisioner import validate_provisioner
+        from tests.helpers import make_provisioner
+
+        p = make_provisioner(ttl_seconds_after_empty=30, consolidation_enabled=True)
+        assert validate_provisioner(p)
