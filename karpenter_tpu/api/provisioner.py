@@ -105,7 +105,6 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
             errs.append("taint key is required")
         if taint.effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
             errs.append(f"invalid taint effect {taint.effect!r}")
-    seen = set()
     for req in spec.requirements:
         if req.operator not in (OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT):
             errs.append(f"invalid requirement operator {req.operator!r}")
@@ -118,7 +117,6 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
                 errs.append(f"requirement {req.key} with operator {req.operator} needs a single integer value")
         if lbl.is_restricted_label(req.key):
             errs.append(f"requirement key {req.key} is restricted")
-        seen.add(req.key)
     if spec.ttl_seconds_after_empty is not None and spec.ttl_seconds_after_empty < 0:
         errs.append("ttlSecondsAfterEmpty must be non-negative")
     if spec.ttl_seconds_after_empty is not None and spec.consolidation and spec.consolidation.enabled:

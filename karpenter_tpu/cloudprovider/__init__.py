@@ -1,0 +1,3 @@
+from .types import CloudProvider, InstanceType, Offering, NodeRequest
+
+__all__ = ["CloudProvider", "InstanceType", "Offering", "NodeRequest"]

@@ -1,0 +1,81 @@
+"""CloudProvider: the pluggable provider boundary.
+
+Equivalent of the reference's pkg/cloudprovider/types.go:41-88 — the interface
+every cloud backend implements (Create/Delete/GetInstanceTypes/Name), the
+InstanceType surface the scheduler consumes (requirements, offerings,
+resources, overhead, price), and the Offering (capacity type x zone)
+availability record.
+
+The TPU solver sits *behind* this boundary: it consumes the same InstanceType
+universe, densified into matrices (ir/encode.py), so any provider — fake, AWS,
+or otherwise — automatically gets the TPU packing path.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..api.objects import Node
+from ..api.provisioner import Provisioner
+from ..scheduling.nodetemplate import NodeTemplate
+from ..scheduling.requirements import Requirements
+
+
+@dataclass(frozen=True)
+class Offering:
+    capacity_type: str
+    zone: str
+    price: Optional[float] = None  # per-offering price override (spot markets)
+
+
+@dataclass
+class NodeRequest:
+    template: NodeTemplate
+    instance_type_options: List["InstanceType"] = field(default_factory=list)
+
+
+class InstanceType(abc.ABC):
+    """One purchasable machine shape."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def requirements(self) -> Requirements:
+        """Node labels this type would carry, as a requirement set."""
+
+    @abc.abstractmethod
+    def offerings(self) -> Sequence[Offering]: ...
+
+    @abc.abstractmethod
+    def resources(self) -> Dict[str, float]:
+        """Total allocatable-before-overhead capacity."""
+
+    @abc.abstractmethod
+    def overhead(self) -> Dict[str, float]:
+        """System/kube-reserved overhead subtracted from resources."""
+
+    @abc.abstractmethod
+    def price(self) -> float: ...
+
+    def __repr__(self) -> str:
+        return f"<InstanceType {self.name()}>"
+
+
+class CloudProvider(abc.ABC):
+    """The provider plugin boundary (types.go:41-56)."""
+
+    @abc.abstractmethod
+    def create(self, node_request: NodeRequest) -> Node:
+        """Launch capacity satisfying the request; returns the created Node."""
+
+    @abc.abstractmethod
+    def delete(self, node: Node) -> None: ...
+
+    @abc.abstractmethod
+    def get_instance_types(self, provisioner: Provisioner) -> List[InstanceType]: ...
+
+    @abc.abstractmethod
+    def name(self) -> str: ...

@@ -1,0 +1,85 @@
+"""Scheduler construction: templates, instance-type universe, topology domains.
+
+The equivalent of the wiring in the reference's
+pkg/controllers/provisioning/provisioner.go:217-277 — node templates ordered
+by provisioner weight, per-provisioner instance types, the topology domain
+universe derived from instance-type requirements + provisioner requirements,
+daemonset overhead, and topology construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from ..api.objects import OP_IN, Pod
+from ..api.provisioner import Provisioner, order_by_weight
+from ..cloudprovider.types import CloudProvider, InstanceType
+from ..scheduling.nodetemplate import NodeTemplate
+from ..utils import resources as res
+from ..utils import pod as podutils
+from .scheduler import Scheduler, SchedulerOptions
+from .topology import Topology
+
+
+def compute_domains(provisioners: Sequence[Provisioner], instance_types: Dict[str, List[InstanceType]]) -> Dict[str, Set[str]]:
+    """The universe of topology domains per label key."""
+    domains: Dict[str, Set[str]] = {}
+    for provisioner in provisioners:
+        for it in instance_types.get(provisioner.name, []):
+            for requirement in it.requirements():
+                if not requirement.complement:
+                    domains.setdefault(requirement.key, set()).update(requirement.values)
+        for req in provisioner.spec.requirements:
+            if req.operator == OP_IN:
+                domains.setdefault(req.key, set()).update(req.values)
+        for key, value in provisioner.spec.labels.items():
+            domains.setdefault(key, set()).add(value)
+    return domains
+
+
+def daemonset_overhead(daemonset_pods: Iterable[Pod], template: NodeTemplate) -> Dict[str, float]:
+    """Total requests of daemonset pods that would schedule to nodes from this
+    template (provisioner.go:339-360): tolerate the taints and be requirement
+    compatible."""
+    total: Dict[str, float] = {}
+    for pod in daemonset_pods:
+        if template.taints.tolerates(pod) is not None:
+            continue
+        from ..scheduling.requirements import Requirements
+
+        if template.requirements.compatible(Requirements.from_pod(pod)) is not None:
+            continue
+        total = res.merge(total, res.pod_requests(pod))
+    return total
+
+
+def build_scheduler(
+    provisioners: Sequence[Provisioner],
+    cloud_provider: CloudProvider,
+    pods: Sequence[Pod],
+    kube=None,
+    cluster=None,
+    state_nodes: Sequence[object] = (),
+    daemonset_pods: Sequence[Pod] = (),
+    opts: Optional[SchedulerOptions] = None,
+    recorder=None,
+    dense_solver=None,
+) -> Scheduler:
+    provisioners = order_by_weight(list(provisioners))
+    node_templates = [NodeTemplate.from_provisioner(p) for p in provisioners]
+    instance_types = {p.name: cloud_provider.get_instance_types(p) for p in provisioners}
+    domains = compute_domains(provisioners, instance_types)
+    topology = Topology(kube=kube, cluster=cluster, domains=domains, pods=list(pods))
+    overhead = {t.provisioner_name: daemonset_overhead(daemonset_pods, t) for t in node_templates}
+    return Scheduler(
+        node_templates=node_templates,
+        provisioners=provisioners,
+        topology=topology,
+        instance_types=instance_types,
+        daemon_overhead=overhead,
+        state_nodes=state_nodes,
+        opts=opts,
+        recorder=recorder,
+        cluster=cluster,
+        dense_solver=dense_solver,
+    )

@@ -1,0 +1,97 @@
+"""ExistingNodeView: scheduling against a real or in-flight node.
+
+Mirrors scheduling/existingnode.go — the same add() protocol as VirtualNode
+but against fixed capacity: remaining daemonset headroom, ephemeral taint
+filtering (not-ready/unreachable, startup taints until initialized), volume
+limits, and available-resource fit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import labels as lbl
+from ..api.objects import NO_SCHEDULE, Pod, Taint
+from ..scheduling.requirements import Requirements
+from ..scheduling.taints import Taints
+from ..utils import resources as res
+from .errors import IncompatibleError
+from .topology import Topology
+
+
+class ExistingNodeView:
+    def __init__(self, state_node, topology: Topology, startup_taints, daemon_resources: Dict[str, float]):
+        self.state_node = state_node
+        self.node = state_node.node
+        self.topology = topology
+        self.pods: List[Pod] = []
+
+        # remaining daemon resources: total expected minus already scheduled,
+        # clamped at zero (existingnode.go:46-55)
+        remaining = res.subtract(daemon_resources or {}, state_node.daemonset_requested)
+        self.requests = res.clamp_negative_to_zero(remaining)
+        self.available = dict(state_node.available)
+        self.requirements = Requirements.from_labels(self.node.metadata.labels)
+        # copy the shared trackers: tentative placements (and simulation-mode
+        # what-ifs) must never leak reservations into live cluster state
+        self.host_port_usage = state_node.host_port_usage.copy()
+        self.volume_usage = state_node.volume_usage.copy()
+        self.volume_limits = state_node.volume_limits
+
+        # ephemeral taints are ignored for scheduling; startup taints only
+        # until the node initializes (existingnode.go:67-84)
+        ephemeral = [
+            Taint(key=lbl.TAINT_NODE_NOT_READY, effect=NO_SCHEDULE),
+            Taint(key=lbl.TAINT_NODE_UNREACHABLE, effect=NO_SCHEDULE),
+        ]
+        if self.node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) != "true":
+            ephemeral += list(startup_taints or [])
+        self.taints = Taints(
+            t
+            for t in self.node.spec.taints
+            if not any(e.key == t.key and e.value == t.value and e.effect == t.effect for e in ephemeral)
+        )
+
+        hostname = self.node.metadata.labels.get(lbl.LABEL_HOSTNAME) or self.node.name
+        from ..api.objects import OP_IN
+        from ..scheduling.requirement import Requirement
+
+        self.requirements.add(Requirement(lbl.LABEL_HOSTNAME, OP_IN, hostname))
+        topology.register(lbl.LABEL_HOSTNAME, hostname)
+
+    def add(self, pod: Pod) -> None:
+        err = self.taints.tolerates(pod)
+        if err is not None:
+            raise IncompatibleError(err)
+        err = self.host_port_usage.validate(pod)
+        if err is not None:
+            raise IncompatibleError(err)
+
+        mounted = self.volume_usage.validate(pod)
+        if mounted.exceeds(self.volume_limits):
+            raise IncompatibleError("would exceed node volume limits")
+
+        requests = res.merge(self.requests, res.pod_requests(pod))
+        if not res.fits(requests, self.available):
+            raise IncompatibleError("exceeds node resources")
+
+        node_requirements = Requirements(*self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+        err = node_requirements.compatible(pod_requirements)
+        if err is not None:
+            raise IncompatibleError(err)
+        node_requirements.add(*pod_requirements.values())
+
+        topology_requirements = self.topology.add_requirements(pod_requirements, node_requirements, pod)
+        err = node_requirements.compatible(topology_requirements)
+        if err is not None:
+            raise IncompatibleError(err)
+        node_requirements.add(*topology_requirements.values())
+
+        # commit
+        self.pods.append(pod)
+        self.requests = requests
+        self.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.host_port_usage.add(pod)
+        self.volume_usage.add(pod)

@@ -1,0 +1,144 @@
+"""VirtualNode: an in-flight node being designed during scheduling.
+
+Mirrors scheduling/node.go — a constraint set plus the surviving
+instance-type options and committed pods. `add(pod)` runs the full check
+chain (taints → host ports → requirement compatibility → topology tightening
+→ instance-type filtering) and commits mutations only on success.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+from ..api import labels as lbl
+from ..api.objects import OP_IN, Pod
+from ..cloudprovider.types import InstanceType
+from ..scheduling.hostports import HostPortUsage
+from ..scheduling.nodetemplate import NodeTemplate
+from ..scheduling.requirement import Requirement
+from ..scheduling.requirements import Requirements
+from ..utils import resources as res
+from .errors import IncompatibleError
+from .topology import Topology
+
+_hostname_counter = itertools.count(1)
+
+
+class VirtualNode:
+    def __init__(
+        self,
+        template: NodeTemplate,
+        topology: Topology,
+        daemon_resources: Dict[str, float],
+        instance_types: Sequence[InstanceType],
+    ):
+        # copy template and pin a placeholder hostname so hostname-keyed
+        # topologies see this node as a domain (node.go:46-53); stripped at
+        # finalize_scheduling.
+        hostname = f"hostname-placeholder-{next(_hostname_counter):04d}"
+        topology.register(lbl.LABEL_HOSTNAME, hostname)
+        self._hostname = hostname
+        self.template = template.copy()
+        self.template.requirements.add(Requirement(lbl.LABEL_HOSTNAME, OP_IN, hostname))
+        self.topology = topology
+        self.instance_type_options: List[InstanceType] = list(instance_types)
+        self.pods: List[Pod] = []
+        self.requests: Dict[str, float] = dict(daemon_resources or {})
+        self.host_port_usage = HostPortUsage()
+
+    @property
+    def requirements(self) -> Requirements:
+        return self.template.requirements
+
+    @property
+    def provisioner_name(self) -> str:
+        return self.template.provisioner_name
+
+    def add(self, pod: Pod) -> None:
+        """Try to place the pod; raises IncompatibleError without mutating on
+        failure (node.go:64-109)."""
+        err = self.template.taints.tolerates(pod)
+        if err is not None:
+            raise IncompatibleError(err)
+        err = self.host_port_usage.validate(pod)
+        if err is not None:
+            raise IncompatibleError(err)
+
+        node_requirements = Requirements(*self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+
+        err = node_requirements.compatible(pod_requirements)
+        if err is not None:
+            raise IncompatibleError(f"incompatible requirements, {err}")
+        node_requirements.add(*pod_requirements.values())
+
+        topology_requirements = self.topology.add_requirements(pod_requirements, node_requirements, pod)
+        err = node_requirements.compatible(topology_requirements)
+        if err is not None:
+            raise IncompatibleError(err)
+        node_requirements.add(*topology_requirements.values())
+
+        requests = res.merge(self.requests, res.pod_requests(pod))
+        instance_types = filter_instance_types(self.instance_type_options, node_requirements, requests)
+        if not instance_types:
+            raise IncompatibleError(
+                f"no instance type satisfied resources {res.to_string(res.pod_requests(pod))} "
+                f"and requirements {node_requirements!r}"
+            )
+
+        # commit
+        self.pods.append(pod)
+        self.instance_type_options = instance_types
+        self.requests = requests
+        self.template.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.host_port_usage.add(pod)
+
+    def finalize_scheduling(self) -> None:
+        """Strip the placeholder hostname before launch (node.go:113-117)."""
+        self.template.requirements.delete(lbl.LABEL_HOSTNAME)
+
+    def release(self) -> None:
+        """Discard a probe node that never placed a pod: retract its
+        placeholder hostname so topology domains don't accumulate phantoms
+        across failed open-a-node attempts."""
+        assert not self.pods, "release() is only valid for empty probe nodes"
+        self.topology.unregister(lbl.LABEL_HOSTNAME, self._hostname)
+
+    def __repr__(self) -> str:
+        names = ", ".join(it.name() for it in self.instance_type_options[:5])
+        return f"<VirtualNode {len(self.pods)} pods requesting {res.to_string(self.requests)} from types {names}>"
+
+
+def filter_instance_types(
+    instance_types: Sequence[InstanceType],
+    requirements: Requirements,
+    requests: Dict[str, float],
+) -> List[InstanceType]:
+    """Survivor filter: requirement-compatible ∧ resource-fit ∧ offering
+    available in the allowed zone x capacity-type (node.go:139-161). This is
+    the per-pod O(T) hot loop that the dense solver computes as one [P, T]
+    feasibility mask on device (ops/feasibility.py)."""
+    return [
+        it
+        for it in instance_types
+        if _compatible(it, requirements) and _fits(it, requests) and _has_offering(it, requirements)
+    ]
+
+
+def _compatible(it: InstanceType, requirements: Requirements) -> bool:
+    return it.requirements().intersects(requirements) is None
+
+
+def _fits(it: InstanceType, requests: Dict[str, float]) -> bool:
+    return res.fits(res.merge(requests, it.overhead()), it.resources())
+
+
+def _has_offering(it: InstanceType, requirements: Requirements) -> bool:
+    for offering in it.offerings():
+        if (not requirements.has(lbl.LABEL_TOPOLOGY_ZONE) or requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone)) and (
+            not requirements.has(lbl.LABEL_CAPACITY_TYPE) or requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type)
+        ):
+            return True
+    return False

@@ -1,0 +1,164 @@
+"""TopologyGroup: one topology constraint shared by many owner pods.
+
+Mirrors topologygroup.go — a deduplicated (by hash) spread / pod-affinity /
+pod-anti-affinity constraint with its domain→count index and the next-domain
+selection rules:
+  spread        → min-count domain within maxSkew (kube-scheduler formula)
+  affinity      → any populated domain (with self-affinity bootstrap)
+  anti-affinity → only zero-count domains
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, Optional, Set
+
+from ..api import labels as lbl
+from ..api.objects import LabelSelector, OP_DOES_NOT_EXIST, OP_IN, Pod
+from ..scheduling.requirement import Requirement
+from ..scheduling.requirements import Requirements
+from .topologynodefilter import TopologyNodeFilter
+
+MAX_INT32 = (1 << 31) - 1
+
+
+class TopologyType(enum.Enum):
+    SPREAD = "topology spread"
+    POD_AFFINITY = "pod affinity"
+    POD_ANTI_AFFINITY = "pod anti-affinity"
+
+
+def _selector_hash_key(selector: Optional[LabelSelector]):
+    if selector is None:
+        return None
+    return (
+        tuple(sorted(selector.match_labels.items())),
+        tuple(sorted((e.key, e.operator, tuple(sorted(e.values))) for e in selector.match_expressions)),
+    )
+
+
+class TopologyGroup:
+    def __init__(
+        self,
+        topology_type: TopologyType,
+        key: str,
+        pod: Optional[Pod],
+        namespaces: Set[str],
+        selector: Optional[LabelSelector],
+        max_skew: int,
+        domains: Iterable[str],
+    ):
+        self.type = topology_type
+        self.key = key
+        self.namespaces = set(namespaces)
+        self.selector = selector
+        self.max_skew = max_skew
+        self.domains: Dict[str, int] = {domain: 0 for domain in (domains or ())}
+        self.owners: Set[str] = set()  # pod UIDs governed by this group
+        if topology_type == TopologyType.SPREAD and pod is not None:
+            self.node_filter = TopologyNodeFilter.for_spread(pod)
+        else:
+            self.node_filter = TopologyNodeFilter.always()
+
+    # -- identity ------------------------------------------------------------
+
+    def hash_key(self):
+        return (
+            self.key,
+            self.type,
+            frozenset(self.namespaces),
+            _selector_hash_key(self.selector),
+            self.max_skew,
+            self.node_filter.hash_key(),
+        )
+
+    # -- ownership / counting ------------------------------------------------
+
+    def add_owner(self, uid: str) -> None:
+        self.owners.add(uid)
+
+    def remove_owner(self, uid: str) -> None:
+        self.owners.discard(uid)
+
+    def is_owned_by(self, uid: str) -> bool:
+        return uid in self.owners
+
+    def selects(self, pod: Pod) -> bool:
+        selector = self.selector or LabelSelector()
+        return pod.namespace in self.namespaces and selector.matches(pod.metadata.labels)
+
+    def counts(self, pod: Pod, requirements: Requirements) -> bool:
+        """Would this pod, scheduled onto a node with `requirements`, count?"""
+        return self.selects(pod) and self.node_filter.matches_requirements(requirements)
+
+    def record(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains[domain] = self.domains.get(domain, 0) + 1
+
+    def register(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains.setdefault(domain, 0)
+
+    # -- next-domain selection ----------------------------------------------
+
+    def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if self.type == TopologyType.SPREAD:
+            return self._next_domain_spread(pod, pod_domains, node_domains)
+        if self.type == TopologyType.POD_AFFINITY:
+            return self._next_domain_affinity(pod, pod_domains, node_domains)
+        return self._next_domain_anti_affinity(pod_domains)
+
+    def _next_domain_spread(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        global_min = self._domain_min_count(pod_domains)
+        self_selecting = self.selects(pod)
+        min_domain = None
+        min_count = MAX_INT32
+        for domain in self.domains:
+            if node_domains.has(domain):
+                count = self.domains[domain]
+                if self_selecting:
+                    count += 1
+                # kube-scheduler skew rule: count - global_min <= maxSkew
+                if count - global_min <= self.max_skew and count < min_count:
+                    min_domain = domain
+                    min_count = count
+        if min_domain is None:
+            return Requirement(self.key, OP_DOES_NOT_EXIST)
+        return Requirement(self.key, OP_IN, min_domain)
+
+    def _domain_min_count(self, domains: Requirement) -> int:
+        # hostname topologies can always mint a fresh (zero-count) domain
+        if self.key == lbl.LABEL_HOSTNAME:
+            return 0
+        lowest = MAX_INT32
+        for domain, count in self.domains.items():
+            if domains.has(domain):
+                lowest = min(lowest, count)
+        return lowest
+
+    def _next_domain_affinity(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        options = Requirement(self.key, OP_DOES_NOT_EXIST)
+        for domain, count in self.domains.items():
+            if pod_domains.has(domain) and count > 0:
+                options.insert(domain)
+        # self-affinity bootstrap: nothing recorded yet, so seed one viable
+        # domain (preferring the node's current domain set for in-flight nodes)
+        if len(options) == 0 and self.selects(pod):
+            intersected = pod_domains.intersection(node_domains)
+            for domain in sorted(self.domains):
+                if intersected.has(domain):
+                    options.insert(domain)
+                    break
+            if len(options) == 0:
+                for domain in sorted(self.domains):
+                    if pod_domains.has(domain):
+                        options.insert(domain)
+                        break
+        return options
+
+    def _next_domain_anti_affinity(self, pod_domains: Requirement) -> Requirement:
+        options = Requirement(self.key, OP_DOES_NOT_EXIST)
+        for domain, count in self.domains.items():
+            if pod_domains.has(domain) and count == 0:
+                options.insert(domain)
+        return options

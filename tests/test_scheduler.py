@@ -1,0 +1,516 @@
+"""Host scheduler (FFD oracle) tests.
+
+Scenario catalog drawn from the reference's scheduler suite
+(pkg/controllers/provisioning/scheduling/suite_test.go): custom constraints,
+preferential fallback, topology (zonal/hostname/capacity-type, affinity,
+anti-affinity), taints, instance-type compatibility, binpacking, and limits.
+"""
+
+import pytest
+
+from karpenter_tpu.api.labels import (
+    LABEL_ARCH,
+    LABEL_CAPACITY_TYPE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE,
+    LABEL_OS,
+    LABEL_TOPOLOGY_ZONE,
+)
+from karpenter_tpu.api.objects import (
+    DO_NOT_SCHEDULE,
+    SCHEDULE_ANYWAY,
+    LabelSelector,
+    NodeSelectorRequirement,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    ContainerPort,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FakeCloudProvider,
+    default_instance_types,
+    instance_type,
+    instance_types,
+)
+from karpenter_tpu.scheduler import SchedulerOptions, build_scheduler
+from tests.helpers import make_pod, make_pods, make_provisioner
+
+
+def schedule(pods, provisioners=None, provider=None, **kwargs):
+    provisioners = provisioners or [make_provisioner()]
+    provider = provider or FakeCloudProvider()
+    scheduler = build_scheduler(provisioners, provider, pods, **kwargs)
+    return scheduler.solve(pods)
+
+
+def node_of(results, pod):
+    for node in results.new_nodes:
+        if pod in node.pods:
+            return node
+    for view in results.existing_nodes:
+        if pod in view.pods:
+            return view
+    return None
+
+
+def expect_scheduled(results, pod):
+    node = node_of(results, pod)
+    assert node is not None, f"pod {pod.name} did not schedule: {results.unschedulable.get(pod)}"
+    return node
+
+
+def expect_not_scheduled(results, pod):
+    assert node_of(results, pod) is None, f"pod {pod.name} unexpectedly scheduled"
+
+
+class TestBasicScheduling:
+    def test_single_pod_single_node(self):
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert node.instance_type_options
+
+    def test_instance_types_sorted_by_price_cheapest_first(self):
+        pods = [make_pod(requests={"cpu": "1"})]
+        results = schedule(pods, provider=FakeCloudProvider(instance_types(10)))
+        node = expect_scheduled(results, pods[0])
+        prices = [it.price() for it in node.instance_type_options]
+        assert prices == sorted(prices)
+        # cheapest surviving type can hold the pod
+        assert node.instance_type_options[0].resources()["cpu"] >= 1.0
+
+    def test_no_fit_anywhere(self):
+        pod = make_pod(requests={"cpu": "1000"})
+        results = schedule([pod])
+        expect_not_scheduled(results, pod)
+        assert pod in results.unschedulable
+
+    def test_daemon_overhead_accounted(self):
+        ds_pod = make_pod(requests={"cpu": "1"})
+        pod = make_pod(requests={"cpu": "1"})
+        provider = FakeCloudProvider([instance_type("only", cpu=2, memory="4Gi", pods=10)])
+        # 1 cpu daemon + 1 cpu pod + overhead(0.1) > 2 cpu -> no fit
+        results = schedule([pod], provider=provider, daemonset_pods=[ds_pod])
+        expect_not_scheduled(results, pod)
+
+
+class TestCustomConstraints:
+    def test_node_selector_well_known(self):
+        pod = make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-1")
+
+    def test_node_selector_unknown_zone_fails(self):
+        pod = make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "nonexistent-zone"})
+        results = schedule([pod])
+        expect_not_scheduled(results, pod)
+
+    def test_custom_label_requires_provisioner_knowledge(self):
+        pod = make_pod(node_selector={"team": "infra"})
+        results = schedule([pod])
+        expect_not_scheduled(results, pod)
+        results = schedule([pod], provisioners=[make_provisioner(labels={"team": "infra"})])
+        expect_scheduled(results, pod)
+
+    def test_arch_and_os(self):
+        pod = make_pod(node_selector={LABEL_ARCH: "arm64"})
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert all(it.architecture == "arm64" for it in node.instance_type_options)
+
+    def test_not_in_operator(self):
+        pod = make_pod(node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_NOT_IN, ["test-zone-1", "test-zone-2"])])
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-3")
+        assert not node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-1")
+
+    def test_exists_operator_on_custom_label(self):
+        pod = make_pod(node_requirements=[NodeSelectorRequirement("team", OP_EXISTS, [])])
+        results = schedule([pod], provisioners=[make_provisioner(labels={"team": "infra"})])
+        expect_scheduled(results, pod)
+
+    def test_gt_lt_on_integer_label(self):
+        from karpenter_tpu.cloudprovider.fake import INTEGER_INSTANCE_LABEL
+
+        pod = make_pod(node_requirements=[NodeSelectorRequirement(INTEGER_INSTANCE_LABEL, OP_GT, ["8"])])
+        results = schedule([pod], provider=FakeCloudProvider(instance_types(16)))
+        node = expect_scheduled(results, pod)
+        assert all(it.resources()["cpu"] > 8 for it in node.instance_type_options)
+
+    def test_provisioner_requirements_restrict(self):
+        prov = make_provisioner(requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-2"])])
+        pod = make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+        results = schedule([pod], provisioners=[prov])
+        expect_not_scheduled(results, pod)
+
+    def test_incompatible_pods_open_separate_nodes(self):
+        pods = [
+            make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"}),
+            make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"}),
+        ]
+        results = schedule(pods)
+        n1 = expect_scheduled(results, pods[0])
+        n2 = expect_scheduled(results, pods[1])
+        assert n1 is not n2
+
+
+class TestTaints:
+    def test_provisioner_taint_blocks_intolerant_pod(self):
+        prov = make_provisioner(taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        pod = make_pod()
+        results = schedule([pod], provisioners=[prov])
+        expect_not_scheduled(results, pod)
+
+    def test_provisioner_taint_tolerated(self):
+        prov = make_provisioner(taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        pod = make_pod(tolerations=[Toleration(key="dedicated", operator="Exists")])
+        results = schedule([pod], provisioners=[prov])
+        expect_scheduled(results, pod)
+
+    def test_prefer_no_schedule_relaxes(self):
+        # pods eventually tolerate PreferNoSchedule taints via relaxation
+        prov = make_provisioner(taints=[Taint(key="soft", value="true", effect="PreferNoSchedule")])
+        pod = make_pod()
+        results = schedule([pod], provisioners=[prov])
+        expect_scheduled(results, pod)
+
+
+class TestWeightedProvisioners:
+    def test_heavier_provisioner_wins(self):
+        light = make_provisioner(name="light", weight=1, labels={"tier": "light"})
+        heavy = make_provisioner(name="heavy", weight=50, labels={"tier": "heavy"})
+        pod = make_pod()
+        results = schedule([pod], provisioners=[light, heavy])
+        node = expect_scheduled(results, pod)
+        assert node.provisioner_name == "heavy"
+
+    def test_fallback_to_lighter_when_incompatible(self):
+        heavy = make_provisioner(name="heavy", weight=50, taints=[Taint(key="reserved", value="x", effect="NoSchedule")])
+        light = make_provisioner(name="light", weight=1)
+        pod = make_pod()
+        results = schedule([pod], provisioners=[light, heavy])
+        node = expect_scheduled(results, pod)
+        assert node.provisioner_name == "light"
+
+
+class TestLimits:
+    def test_limits_cap_node_count(self):
+        # each node's largest type is 4 cpu; limit of 6 cpu allows only one node
+        provider = FakeCloudProvider([instance_type("only", cpu=4, memory="16Gi", pods=2)])
+        prov = make_provisioner(limits={"cpu": "6"})
+        pods = make_pods(6, requests={"cpu": "1.5"})
+        results = schedule(pods, provisioners=[prov], provider=provider)
+        assert len(results.new_nodes) == 1
+        scheduled = [p for p in pods if node_of(results, p) is not None]
+        assert len(scheduled) == 2  # pods-per-node cap
+
+    def test_zero_limit_blocks_all(self):
+        prov = make_provisioner(limits={"cpu": "0"})
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], provisioners=[prov])
+        expect_not_scheduled(results, pod)
+
+
+class TestBinpacking:
+    def test_pods_pack_onto_one_node(self):
+        provider = FakeCloudProvider(instance_types(20))
+        pods = make_pods(10, requests={"cpu": "1", "memory": "1Gi"})
+        results = schedule(pods, provider=provider)
+        assert len(results.new_nodes) == 1
+        node = results.new_nodes[0]
+        assert len(node.pods) == 10
+        # cheapest surviving type holds 10 cpu + overhead
+        assert node.instance_type_options[0].resources()["cpu"] >= 10.0
+
+    def test_ffd_order_cpu_then_memory(self):
+        provider = FakeCloudProvider(instance_types(5))  # max 5 cpu / 10Gi
+        big = make_pod(requests={"cpu": "4"})
+        small = make_pods(8, requests={"cpu": "0.5"})
+        results = schedule([*small, big], provider=provider)
+        # big pod goes first onto the big node; smalls fill remaining capacity
+        node = expect_scheduled(results, big)
+        assert len(results.new_nodes) == 2
+
+    def test_pods_resource_respected(self):
+        provider = FakeCloudProvider([instance_type("tiny-pods", cpu=100, memory="100Gi", pods=3)])
+        pods = make_pods(7, requests={"cpu": "0.1"})
+        results = schedule(pods, provider=provider)
+        assert len(results.new_nodes) == 3  # ceil(7/3)
+        assert all(len(n.pods) <= 3 for n in results.new_nodes)
+
+    def test_many_sizes_cost_effective(self):
+        provider = FakeCloudProvider(instance_types(50))
+        pods = make_pods(4, requests={"cpu": "2", "memory": "4Gi"})
+        results = schedule(pods, provider=provider)
+        assert len(results.new_nodes) == 1
+        node = results.new_nodes[0]
+        cheapest = node.instance_type_options[0]
+        # needs >= 8 cpu + 0.1 overhead -> fake-it-8 (9 cpu) is the optimum
+        assert cheapest.resources()["cpu"] == 9.0
+
+
+class TestTopologySpread:
+    def test_zonal_spread_even(self):
+        constraint = TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(6, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "1"})
+        results = schedule(pods)
+        zones = {}
+        for pod in pods:
+            node = expect_scheduled(results, pod)
+            zone = node.requirements.get(LABEL_TOPOLOGY_ZONE).any_value()
+            zones[zone] = zones.get(zone, 0) + 1
+        assert len(zones) == 3
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_hostname_spread_makes_n_nodes(self):
+        constraint = TopologySpreadConstraint(max_skew=1, topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(4, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "1"})
+        results = schedule(pods)
+        for pod in pods:
+            expect_scheduled(results, pod)
+        assert len(results.new_nodes) == 4
+
+    def test_capacity_type_spread(self):
+        constraint = TopologySpreadConstraint(max_skew=1, topology_key=LABEL_CAPACITY_TYPE, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(4, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "1"})
+        results = schedule(pods)
+        counts = {}
+        for pod in pods:
+            node = expect_scheduled(results, pod)
+            ct = node.requirements.get(LABEL_CAPACITY_TYPE).any_value()
+            counts[ct] = counts.get(ct, 0) + 1
+        assert counts == {"spot": 2, "on-demand": 2}
+
+    def test_pod_zone_restriction_narrows_skew_domain(self):
+        # a pod restricted to one zone computes min-count over its own viable
+        # domains only (kube nodeAffinityPolicy semantics), so all schedule
+        constraint = TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "a"}))
+        pods = make_pods(
+            3,
+            labels={"app": "a"},
+            topology_spread_constraints=[constraint],
+            node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+        )
+        results = schedule(pods)
+        scheduled = [p for p in pods if node_of(results, p) is not None]
+        assert len(scheduled) == 3
+
+    def test_max_skew_violated_blocks(self):
+        # the provisioner can only make zone-1 nodes, but the pods' spread
+        # counts all 3 zones: after 2 pods in zone-1 the skew (2 - 0) > 1
+        constraint = TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "a"}))
+        prov = make_provisioner(requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])])
+        pods = make_pods(3, labels={"app": "a"}, topology_spread_constraints=[constraint])
+        results = schedule(pods, provisioners=[prov])
+        scheduled = [p for p in pods if node_of(results, p) is not None]
+        assert len(scheduled) == 1
+
+    def test_schedule_anyway_relaxes(self):
+        constraint = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=LABEL_TOPOLOGY_ZONE,
+            when_unsatisfiable=SCHEDULE_ANYWAY,
+            label_selector=LabelSelector(match_labels={"app": "a"}),
+        )
+        pods = make_pods(
+            3,
+            labels={"app": "a"},
+            topology_spread_constraints=[constraint],
+            node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+        )
+        results = schedule(pods)
+        for pod in pods:
+            expect_scheduled(results, pod)
+
+
+class TestPodAffinity:
+    def test_affinity_colocates(self):
+        term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(4, labels={"app": "web"}, pod_requirements=[term], requests={"cpu": "1"})
+        results = schedule(pods)
+        zones = set()
+        for pod in pods:
+            node = expect_scheduled(results, pod)
+            zones.add(node.requirements.get(LABEL_TOPOLOGY_ZONE).any_value())
+        assert len(zones) == 1
+
+    def test_affinity_to_other_pod_in_batch(self):
+        anchor = make_pod(labels={"app": "db"}, requests={"cpu": "1"})
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "db"}))
+        follower = make_pod(pod_requirements=[term], requests={"cpu": "1"})
+        results = schedule([anchor, follower])
+        n1 = expect_scheduled(results, anchor)
+        n2 = expect_scheduled(results, follower)
+        assert n1 is n2
+
+    def test_anti_affinity_hostname_separates(self):
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(3, labels={"app": "web"}, pod_anti_requirements=[term], requests={"cpu": "1"})
+        results = schedule(pods)
+        nodes = {id(expect_scheduled(results, p)) for p in pods}
+        assert len(nodes) == 3
+
+    def test_anti_affinity_zone_blocks_possible_domains(self):
+        # anti-affinity records ALL domains the placed pod could land in
+        # (topology.go:126-135), so an unconstrained zonal anti-affinity pod
+        # blocks every zone — only one schedules. Reference parity (its
+        # benchmark avoids zonal anti-affinity for exactly this reason).
+        term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(4, labels={"app": "web"}, pod_anti_requirements=[term], requests={"cpu": "1"})
+        results = schedule(pods)
+        scheduled = [p for p in pods if node_of(results, p) is not None]
+        assert len(scheduled) == 1
+
+    def test_anti_affinity_zone_with_zone_pinned_pods(self):
+        # pods pinned to distinct zones CAN coexist under zonal anti-affinity
+        term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = [
+            make_pod(labels={"app": "web"}, pod_anti_requirements=[term], node_selector={LABEL_TOPOLOGY_ZONE: zone})
+            for zone in ("test-zone-1", "test-zone-2", "test-zone-3")
+        ]
+        results = schedule(pods)
+        for pod in pods:
+            expect_scheduled(results, pod)
+
+
+class TestPreferentialFallback:
+    def test_preferred_node_affinity_dropped(self):
+        from karpenter_tpu.api.objects import NodeSelectorTerm, PreferredSchedulingTerm
+
+        pod = make_pod(
+            node_preferences=[
+                PreferredSchedulingTerm(
+                    weight=100,
+                    preference=NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["no-such-zone"])]),
+                )
+            ]
+        )
+        results = schedule([pod])
+        expect_scheduled(results, pod)
+
+    def test_required_or_terms_fall_through(self):
+        from karpenter_tpu.api.objects import NodeSelectorTerm
+
+        pod = make_pod(
+            required_node_terms=[
+                NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["no-such-zone"])]),
+                NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-2"])]),
+            ]
+        )
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-2")
+
+    def test_impossible_required_term_fails(self):
+        pod = make_pod(node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["no-such-zone"])])
+        results = schedule([pod])
+        expect_not_scheduled(results, pod)
+
+
+class TestHostPorts:
+    def test_conflicting_host_ports_separate_nodes(self):
+        pods = [
+            make_pod(host_ports=[ContainerPort(host_port=8080)]),
+            make_pod(host_ports=[ContainerPort(host_port=8080)]),
+        ]
+        results = schedule(pods)
+        n1 = expect_scheduled(results, pods[0])
+        n2 = expect_scheduled(results, pods[1])
+        assert n1 is not n2
+
+    def test_different_ports_share(self):
+        provider = FakeCloudProvider(instance_types(20))
+        pods = [
+            make_pod(host_ports=[ContainerPort(host_port=8080)], requests={"cpu": "1"}),
+            make_pod(host_ports=[ContainerPort(host_port=8081)], requests={"cpu": "1"}),
+        ]
+        results = schedule(pods, provider=provider)
+        assert len(results.new_nodes) == 1
+
+
+class TestGPU:
+    def test_gpu_pod_gets_gpu_node(self):
+        pod = make_pod(requests={"cpu": "1", "nvidia.com/gpu": 1})
+        results = schedule([pod])
+        node = expect_scheduled(results, pod)
+        assert all(it.resources().get("nvidia.com/gpu", 0) >= 1 for it in node.instance_type_options)
+
+    def test_gpu_pods_do_not_mix_with_amd(self):
+        nvidia = make_pod(requests={"nvidia.com/gpu": 1})
+        amd = make_pod(requests={"amd.com/gpu": 1})
+        results = schedule([nvidia, amd])
+        n1 = expect_scheduled(results, nvidia)
+        n2 = expect_scheduled(results, amd)
+        assert n1 is not n2
+
+
+class TestSolverHygiene:
+    def test_relaxation_does_not_mutate_caller_pods(self):
+        from karpenter_tpu.api.objects import NodeSelectorTerm, PreferredSchedulingTerm
+
+        pref = PreferredSchedulingTerm(
+            weight=100,
+            preference=NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["no-such-zone"])]),
+        )
+        pod = make_pod(node_preferences=[pref])
+        results = schedule([pod])
+        expect_scheduled(results, pod)
+        # the caller's pod still carries its preference after the solve
+        assert pod.spec.affinity.node_affinity.preferred == [pref]
+
+    def test_affinity_chain_unblocked_by_progress(self):
+        # C requires B's label domain, B requires A's: FFD order may pop them
+        # before their anchors; successful placements must reset the attempts
+        # budget so the chain resolves
+        a = make_pod(name="a", labels={"app": "a"}, requests={"cpu": "0.1"})
+        b = make_pod(
+            name="b",
+            labels={"app": "b"},
+            requests={"cpu": "0.2"},
+            pod_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "a"}))],
+        )
+        c = make_pod(
+            name="c",
+            requests={"cpu": "0.3"},
+            pod_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "b"}))],
+        )
+        results = schedule([c, b, a])
+        for pod in (a, b, c):
+            expect_scheduled(results, pod)
+
+    def test_simulation_mode_does_not_pollute_host_ports(self):
+        # two sequential schedulers over the same state: the first (simulated)
+        # placing a host-port pod must not reserve the port in shared state
+        from karpenter_tpu.scheduling.hostports import HostPortUsage
+        from karpenter_tpu.scheduling.volumelimits import VolumeCount, VolumeLimits
+
+        class StateNode:
+            def __init__(self, node):
+                self.node = node
+                self.available = {"cpu": 4.0, "memory": 8 * 2**30, "pods": 10.0}
+                self.daemonset_requested = {}
+                self.host_port_usage = HostPortUsage()
+                self.volume_usage = VolumeLimits()
+                self.volume_limits = VolumeCount()
+
+        from karpenter_tpu.api.labels import PROVISIONER_NAME_LABEL
+        from tests.helpers import make_node
+
+        node = make_node(labels={PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": "4", "memory": "8Gi", "pods": "10"})
+        state_node = StateNode(node)
+        pod1 = make_pod(host_ports=[ContainerPort(host_port=9000)])
+        provider = FakeCloudProvider()
+        prov = make_provisioner()
+        s1 = build_scheduler([prov], provider, [pod1], state_nodes=[state_node], opts=SchedulerOptions(simulation_mode=True))
+        r1 = s1.solve([pod1])
+        expect_scheduled(r1, pod1)
+        assert state_node.host_port_usage.validate(make_pod(host_ports=[ContainerPort(host_port=9000)])) is None
