@@ -1,0 +1,425 @@
+"""Dense problem IR: the pods x instance-types constraint matrices.
+
+This is the bridge between the host object model and the TPU solver. The key
+architectural split (vs. the reference's per-pod sequential filtering in
+scheduling/node.go:139-161):
+
+- **Label/taint/offering algebra runs on host, but only G times, not P times.**
+  Pods are deduplicated by *constraint signature* (node selector, affinity
+  terms, tolerations, spread constraints, labels); real batches collapse from
+  10k pods to a handful of groups. Each group's instance-type compatibility
+  row is computed with the *exact same host algebra* the FFD oracle uses —
+  zero semantic drift between the dense path and the host path.
+
+- **Everything P-scale ships to the device as dense matrices**: requests
+  [P, R], capacities [T, R], prices [T], compat [G, T], offering masks
+  [T, Z] / [T, C]. Resource fit, domain assignment, packing, and
+  verification reductions are tensor programs (ops/, solver/).
+
+Groups whose constraints the dense path can't express (multi-term affinity,
+volume limits, host ports, inverse anti-affinity interference, ...) are
+classified HOST and fall back to the exact sequential loop.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..api import labels as lbl
+from ..api.objects import DO_NOT_SCHEDULE, OP_IN, Pod
+from ..cloudprovider.types import InstanceType
+from ..scheduling.nodetemplate import NodeTemplate
+from ..scheduling.requirements import Requirements
+from ..utils import resources as res
+
+# Fixed resource axis. Extended resources beyond these fall back to host
+# (rare); the axis is padded so compiled shapes stay stable.
+RESOURCE_AXIS: Tuple[str, ...] = (
+    res.CPU,
+    res.MEMORY,
+    res.PODS,
+    res.EPHEMERAL_STORAGE,
+    res.NVIDIA_GPU,
+    res.AMD_GPU,
+    res.AWS_NEURON,
+    res.AWS_POD_ENI,
+)
+R = len(RESOURCE_AXIS)
+_RESOURCE_INDEX = {name: i for i, name in enumerate(RESOURCE_AXIS)}
+
+# A huge capacity stands in for "resource not limited by this type" when the
+# type doesn't define the resource but also can't satisfy it — fit handles it
+# by treating missing capacity as zero, same as resources.fits().
+
+
+class GroupKind(enum.Enum):
+    PLAIN = "plain"  # resource + label constraints only
+    SPREAD = "spread"  # one DoNotSchedule spread over zone/hostname/capacity-type
+    AFFINITY = "affinity"  # one required self-affinity over zone/hostname
+    ANTI_HOST = "anti-host"  # hostname anti-affinity: dedicated nodes
+    HOST = "host"  # not dense-expressible: exact host loop
+
+
+SPREAD_KEYS = (lbl.LABEL_TOPOLOGY_ZONE, lbl.LABEL_HOSTNAME, lbl.LABEL_CAPACITY_TYPE)
+
+
+@dataclass
+class GroupInfo:
+    kind: GroupKind
+    pods: List[Pod] = field(default_factory=list)
+    requirements: Optional[Requirements] = None  # pod-derived requirements
+    template_index: int = -1
+    # spread/affinity descriptor
+    topology_key: str = ""
+    max_skew: int = 1
+    selector_signature: tuple = ()
+    # dense row indices
+    index: int = -1
+
+
+def resource_vector(rl: Dict[str, float]) -> Optional[np.ndarray]:
+    """Project a resource list onto the fixed axis; None if it names a
+    resource outside the axis (host fallback)."""
+    vec = np.zeros((R,), dtype=np.float64)
+    for name, value in (rl or {}).items():
+        idx = _RESOURCE_INDEX.get(name)
+        if idx is None:
+            if value > 0:
+                return None
+            continue
+        vec[idx] = value
+    return vec
+
+
+def _toleration_signature(pod: Pod) -> tuple:
+    return tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations))
+
+
+def _selector_signature(selector) -> tuple:
+    if selector is None:
+        return ()
+    return (
+        tuple(sorted(selector.match_labels.items())),
+        tuple(sorted((e.key, e.operator, tuple(sorted(e.values))) for e in selector.match_expressions)),
+    )
+
+
+def constraint_signature(pod: Pod) -> tuple:
+    """Everything that affects where a pod may go (and how it groups)."""
+    spec = pod.spec
+    affinity_sig: tuple = ()
+    if spec.affinity is not None:
+        a = spec.affinity
+        node_sig = ()
+        if a.node_affinity is not None:
+            node_sig = (
+                tuple(
+                    tuple(sorted((r.key, r.operator, tuple(sorted(r.values))) for r in term.match_expressions))
+                    for term in a.node_affinity.required
+                ),
+                tuple(
+                    (t.weight, tuple(sorted((r.key, r.operator, tuple(sorted(r.values))) for r in t.preference.match_expressions)))
+                    for t in a.node_affinity.preferred
+                ),
+            )
+        pod_aff_sig = ()
+        if a.pod_affinity is not None:
+            pod_aff_sig = (
+                tuple((t.topology_key, _selector_signature(t.label_selector), tuple(sorted(t.namespaces))) for t in a.pod_affinity.required),
+                tuple((wt.weight, wt.pod_affinity_term.topology_key, _selector_signature(wt.pod_affinity_term.label_selector)) for wt in a.pod_affinity.preferred),
+            )
+        anti_sig = ()
+        if a.pod_anti_affinity is not None:
+            anti_sig = (
+                tuple((t.topology_key, _selector_signature(t.label_selector), tuple(sorted(t.namespaces))) for t in a.pod_anti_affinity.required),
+                tuple((wt.weight, wt.pod_affinity_term.topology_key, _selector_signature(wt.pod_affinity_term.label_selector)) for wt in a.pod_anti_affinity.preferred),
+            )
+        affinity_sig = (node_sig, pod_aff_sig, anti_sig)
+    spread_sig = tuple(
+        (c.max_skew, c.topology_key, c.when_unsatisfiable, _selector_signature(c.label_selector))
+        for c in spec.topology_spread_constraints
+    )
+    ports_sig = tuple(
+        sorted(
+            (p.host_ip, p.host_port, p.protocol)
+            for c in list(spec.containers) + list(spec.init_containers)
+            for p in c.ports
+            if p.host_port
+        )
+    )
+    return (
+        pod.namespace,
+        tuple(sorted(pod.metadata.labels.items())),
+        tuple(sorted(spec.node_selector.items())),
+        affinity_sig,
+        spread_sig,
+        _toleration_signature(pod),
+        ports_sig,
+        bool(spec.volumes),
+    )
+
+
+def classify_group(pod: Pod) -> Tuple[GroupKind, str, int, tuple]:
+    """Decide whether this constraint shape is dense-expressible.
+
+    Returns (kind, topology_key, max_skew, selector_signature).
+    """
+    spec = pod.spec
+    # volumes and host ports need per-node stateful checks -> host
+    if spec.volumes:
+        return (GroupKind.HOST, "", 0, ())
+    if any(p.host_port for c in list(spec.containers) + list(spec.init_containers) for p in c.ports):
+        return (GroupKind.HOST, "", 0, ())
+
+    spreads = spec.topology_spread_constraints
+    a = spec.affinity
+    has_node_pref = bool(a and a.node_affinity and a.node_affinity.preferred)
+    multi_required_terms = bool(a and a.node_affinity and len(a.node_affinity.required) > 1)
+    if has_node_pref or multi_required_terms:
+        # relaxation ladder territory -> host
+        return (GroupKind.HOST, "", 0, ())
+    pod_aff = a.pod_affinity if a else None
+    pod_anti = a.pod_anti_affinity if a else None
+    n_constraints = (
+        len(spreads)
+        + (len(pod_aff.required) + len(pod_aff.preferred) if pod_aff else 0)
+        + (len(pod_anti.required) + len(pod_anti.preferred) if pod_anti else 0)
+    )
+    if n_constraints == 0:
+        return (GroupKind.PLAIN, "", 0, ())
+    if n_constraints > 1:
+        return (GroupKind.HOST, "", 0, ())
+
+    if len(spreads) == 1:
+        c = spreads[0]
+        if c.when_unsatisfiable != DO_NOT_SCHEDULE:
+            return (GroupKind.HOST, "", 0, ())  # ScheduleAnyway enters relaxation
+        if c.topology_key not in SPREAD_KEYS:
+            return (GroupKind.HOST, "", 0, ())
+        # dense spread requires the constraint to select the pod itself
+        # (the usual deployment shape); otherwise counting is cross-group
+        if c.label_selector is None or not c.label_selector.matches(pod.metadata.labels):
+            return (GroupKind.HOST, "", 0, ())
+        return (GroupKind.SPREAD, c.topology_key, c.max_skew, _selector_signature(c.label_selector))
+
+    if pod_aff and len(pod_aff.required) == 1 and not pod_aff.preferred and not pod_anti:
+        term = pod_aff.required[0]
+        if term.topology_key not in (lbl.LABEL_TOPOLOGY_ZONE, lbl.LABEL_HOSTNAME):
+            return (GroupKind.HOST, "", 0, ())
+        if term.namespace_selector is not None or term.namespaces:
+            return (GroupKind.HOST, "", 0, ())
+        # dense affinity requires self-selection (the pod is in its own
+        # affinity cluster) so components close over the group
+        if term.label_selector is None or not term.label_selector.matches(pod.metadata.labels):
+            return (GroupKind.HOST, "", 0, ())
+        return (GroupKind.AFFINITY, term.topology_key, 0, _selector_signature(term.label_selector))
+
+    if pod_anti and len(pod_anti.required) == 1 and not pod_anti.preferred and not pod_aff:
+        term = pod_anti.required[0]
+        if term.topology_key != lbl.LABEL_HOSTNAME:
+            # zonal anti-affinity blocks whole zones; keep exact host semantics
+            return (GroupKind.HOST, "", 0, ())
+        if term.namespace_selector is not None or term.namespaces:
+            return (GroupKind.HOST, "", 0, ())
+        if term.label_selector is None or not term.label_selector.matches(pod.metadata.labels):
+            return (GroupKind.HOST, "", 0, ())
+        return (GroupKind.ANTI_HOST, lbl.LABEL_HOSTNAME, 0, _selector_signature(term.label_selector))
+
+    return (GroupKind.HOST, "", 0, ())
+
+
+@dataclass
+class DenseProblem:
+    """The full dense encoding of one provisioning batch."""
+
+    # axes
+    resource_names: Tuple[str, ...]
+    zones: List[str]
+    capacity_types: List[str]
+    # pods (dense-eligible, original order)
+    pods: List[Pod]
+    requests: np.ndarray  # [P, R] float64 (host math is exact; device casts to f32)
+    group_ids: np.ndarray  # [P] int32
+    groups: List[GroupInfo]  # G entries
+    # instance types (single template for now; index into template list)
+    template: NodeTemplate
+    instance_types: List[InstanceType]
+    caps: np.ndarray  # [T, R] float64 (resources - overhead, missing -> 0)
+    prices: np.ndarray  # [T] float64
+    type_zone: np.ndarray  # [T, Z] bool
+    type_ct: np.ndarray  # [T, C] bool
+    compat: np.ndarray  # [G, T] bool
+    group_zone_allowed: np.ndarray  # [G, Z] bool
+    group_ct_allowed: np.ndarray  # [G, C] bool
+    daemon_overhead: np.ndarray  # [R] float64
+    # pods that must take the exact host path
+    host_pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def P(self) -> int:
+        return len(self.pods)
+
+    @property
+    def T(self) -> int:
+        return len(self.instance_types)
+
+    @property
+    def G(self) -> int:
+        return len(self.groups)
+
+
+def encode_problem(
+    pods: Sequence[Pod],
+    template: NodeTemplate,
+    instance_types: Sequence[InstanceType],
+    daemon_overhead: Optional[Dict[str, float]] = None,
+    zones: Optional[Sequence[str]] = None,
+    capacity_types: Optional[Sequence[str]] = None,
+) -> DenseProblem:
+    """Encode a batch against one node template's instance-type universe."""
+    from ..scheduler.node import filter_instance_types
+
+    # -- axes ---------------------------------------------------------------
+    zone_set: Set[str] = set(zones or ())
+    ct_set: Set[str] = set(capacity_types or ())
+    for it in instance_types:
+        for offering in it.offerings():
+            zone_set.add(offering.zone)
+            ct_set.add(offering.capacity_type)
+    zone_list = sorted(zone_set)
+    ct_list = sorted(ct_set)
+    zone_index = {z: i for i, z in enumerate(zone_list)}
+    ct_index = {c: i for i, c in enumerate(ct_list)}
+
+    # -- instance-type matrices --------------------------------------------
+    T = len(instance_types)
+    caps = np.zeros((T, R), dtype=np.float64)
+    prices = np.zeros((T,), dtype=np.float64)
+    type_zone = np.zeros((T, len(zone_list)), dtype=bool)
+    type_ct = np.zeros((T, len(ct_list)), dtype=bool)
+    for t, it in enumerate(instance_types):
+        cap_vec = resource_vector(it.resources())
+        over_vec = resource_vector(it.overhead())
+        if cap_vec is None or over_vec is None:
+            cap_vec = cap_vec if cap_vec is not None else np.zeros((R,), np.float64)
+            over_vec = over_vec if over_vec is not None else np.zeros((R,), np.float64)
+        caps[t] = np.maximum(cap_vec - over_vec, 0.0)
+        prices[t] = it.price()
+        for offering in it.offerings():
+            type_zone[t, zone_index[offering.zone]] = True
+            type_ct[t, ct_index[offering.capacity_type]] = True
+
+    overhead_vec = resource_vector(daemon_overhead or {})
+    if overhead_vec is None:
+        overhead_vec = np.zeros((R,), np.float64)
+
+    # -- group pods by constraint signature ---------------------------------
+    groups: List[GroupInfo] = []
+    group_by_sig: Dict[tuple, GroupInfo] = {}
+    host_pods: List[Pod] = []
+    dense_pods: List[Pod] = []
+    dense_group_of_pod: List[int] = []
+    request_rows: List[np.ndarray] = []
+
+    for pod in pods:
+        req_vec = resource_vector(res.pod_requests(pod))
+        if req_vec is None:
+            host_pods.append(pod)
+            continue
+        sig = constraint_signature(pod)
+        group = group_by_sig.get(sig)
+        if group is None:
+            kind, key, max_skew, sel_sig = classify_group(pod)
+            group = GroupInfo(kind=kind, topology_key=key, max_skew=max_skew, selector_signature=sel_sig)
+            if kind != GroupKind.HOST:
+                group.requirements = Requirements.from_pod(pod)
+                group.index = len(groups)
+                groups.append(group)
+            group_by_sig[sig] = group
+        if group.kind == GroupKind.HOST:
+            host_pods.append(pod)
+            continue
+        group.pods.append(pod)
+        dense_pods.append(pod)
+        dense_group_of_pod.append(group.index)
+        request_rows.append(req_vec)
+
+    G = len(groups)
+    compat = np.zeros((G, T), dtype=bool)
+    group_zone_allowed = np.ones((G, len(zone_list)), dtype=bool)
+    group_ct_allowed = np.ones((G, len(ct_list)), dtype=bool)
+
+    # -- per-group compatibility via the exact host algebra ------------------
+    type_list = list(instance_types)
+    type_position = {id(it): i for i, it in enumerate(type_list)}
+    for group in groups:
+        pod = group.pods[0]
+        # taints: template taints must be tolerated
+        if template.taints.tolerates(pod) is not None:
+            group.kind = GroupKind.HOST
+            continue
+        node_requirements = Requirements(*template.requirements.values())
+        err = node_requirements.compatible(group.requirements)
+        if err is not None:
+            # incompatible with this template: dense path has a single
+            # template, so these pods are host-path (other templates there)
+            group.kind = GroupKind.HOST
+            continue
+        node_requirements.add(*group.requirements.values())
+        survivors = filter_instance_types(type_list, node_requirements, {})
+        for it in survivors:
+            compat[group.index, type_position[id(it)]] = True
+        zone_req = node_requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
+        group_zone_allowed[group.index] = [zone_req.has(z) for z in zone_list]
+        ct_req = node_requirements.get(lbl.LABEL_CAPACITY_TYPE)
+        group_ct_allowed[group.index] = [ct_req.has(c) for c in ct_list]
+
+    # groups demoted to HOST during compat: move their pods to host_pods
+    if any(g.kind == GroupKind.HOST for g in groups):
+        keep = [g for g in groups if g.kind != GroupKind.HOST]
+        old_to_new = {}
+        for new_index, g in enumerate(keep):
+            old_to_new[g.index] = new_index
+        new_dense_pods, new_group_ids, new_rows = [], [], []
+        for pod, gid, row in zip(dense_pods, dense_group_of_pod, request_rows):
+            if gid in old_to_new:
+                new_dense_pods.append(pod)
+                new_group_ids.append(old_to_new[gid])
+                new_rows.append(row)
+            else:
+                host_pods.append(pod)
+        compat = compat[[g.index for g in keep]] if keep else np.zeros((0, T), dtype=bool)
+        group_zone_allowed = group_zone_allowed[[g.index for g in keep]] if keep else np.ones((0, len(zone_list)), bool)
+        group_ct_allowed = group_ct_allowed[[g.index for g in keep]] if keep else np.ones((0, len(ct_list)), bool)
+        for g in keep:
+            g.index = old_to_new[g.index]
+        groups = keep
+        dense_pods, dense_group_of_pod, request_rows = new_dense_pods, new_group_ids, new_rows
+
+    requests = np.stack(request_rows) if request_rows else np.zeros((0, R), np.float64)
+    group_ids = np.asarray(dense_group_of_pod, dtype=np.int32)
+
+    return DenseProblem(
+        resource_names=RESOURCE_AXIS,
+        zones=zone_list,
+        capacity_types=ct_list,
+        pods=dense_pods,
+        requests=requests,
+        group_ids=group_ids,
+        groups=groups,
+        template=template,
+        instance_types=type_list,
+        caps=caps,
+        prices=prices,
+        type_zone=type_zone,
+        type_ct=type_ct,
+        compat=compat,
+        group_zone_allowed=group_zone_allowed,
+        group_ct_allowed=group_ct_allowed,
+        daemon_overhead=overhead_vec,
+        host_pods=host_pods,
+    )

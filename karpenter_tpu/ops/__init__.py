@@ -1,0 +1,4 @@
+from .feasibility import bucket_type_cost, feasibility_mask, resource_fit
+from .packing import audit_layout, segment_usage
+
+__all__ = ["bucket_type_cost", "feasibility_mask", "resource_fit", "audit_layout", "segment_usage"]

@@ -1,0 +1,74 @@
+"""Feasibility kernels: the dense [P, T] masks.
+
+This is the tensor reformulation of the reference's per-pod instance-type
+survivor filter (scheduling/node.go:139-161): instead of filtering a Go slice
+per pod, the whole pods x types feasibility surface is one broadcasted
+compare-reduce that XLA tiles onto the VPU/MXU. Label/taint/offering
+compatibility arrives pre-reduced to [G, T] rows over constraint-signature
+groups (ir/encode.py) and is gathered per pod.
+
+Shapes are padded to fixed tiles by the solver so recompilation doesn't
+happen per batch (compiled-shape bucketing).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def resource_fit(requests: jax.Array, caps: jax.Array) -> jax.Array:
+    """[P, T] bool: pod p fits an *empty* node of type t.
+
+    requests: [P, R] effective pod requests (daemon overhead NOT included —
+    the caller bakes overhead into caps).
+    caps: [T, R] effective capacities (resources - overhead - daemonset).
+    """
+    # [P, 1, R] <= [1, T, R] -> all over R
+    return jnp.all(requests[:, None, :] <= caps[None, :, :] + 1e-6, axis=-1)
+
+
+@jax.jit
+def feasibility_mask(requests: jax.Array, caps: jax.Array, compat: jax.Array, group_ids: jax.Array) -> jax.Array:
+    """[P, T] bool: resource fit AND label/taint/offering compatibility.
+
+    compat: [G, T] bool group compatibility rows; group_ids: [P] int32.
+    """
+    rows = jnp.take(compat, group_ids, axis=0)  # [P, T]
+    return resource_fit(requests, caps) & rows
+
+
+@jax.jit
+def bucket_type_cost(sum_requests: jax.Array, max_requests: jax.Array, caps: jax.Array, prices: jax.Array, allowed: jax.Array):
+    """Vectorized bucket -> instance-type choice.
+
+    For each pack bucket b (a set of pods that will share nodes):
+      bins[b, t]  = max_r ceil(sum_requests[b, r] / caps[t, r])   (how many
+                    nodes of type t the bucket needs)
+      frac[b, t]  = max_r (sum_requests[b, r] / caps[t, r])       (fractional
+                    lower bound)
+    feasible iff allowed AND the largest single pod fits the type.
+    Choice key minimizes fractional cost first (the continuous optimum —
+    favors large types whose last bin gets downsized at commit), then bin
+    count, then price.
+
+    Returns (tstar [B] int32, bins [B] int32, feasible_any [B] bool).
+    """
+    eps = 1e-9
+    safe_caps = jnp.maximum(caps, eps)  # [T, R]
+    ratio = sum_requests[:, None, :] / safe_caps[None, :, :]  # [B, T, R]
+    # resources the type simply doesn't have (cap==0) but the bucket needs
+    impossible = (caps[None, :, :] <= eps) & (sum_requests[:, None, :] > eps)
+    frac = jnp.max(jnp.where(impossible, jnp.inf, ratio), axis=-1)  # [B, T]
+    bins = jnp.ceil(jnp.maximum(frac, eps))
+    pod_fits = jnp.all(max_requests[:, None, :] <= caps[None, :, :] + 1e-6, axis=-1)  # [B, T]
+    ok = allowed & pod_fits & jnp.isfinite(frac)
+    frac_cost = frac * prices[None, :]
+    # composite lexicographic-ish key; verified exactly at commit time
+    key = frac_cost + bins * 1e-4 + prices[None, :] * 1e-7
+    key = jnp.where(ok, key, jnp.inf)
+    tstar = jnp.argmin(key, axis=1).astype(jnp.int32)
+    chosen_bins = jnp.take_along_axis(bins, tstar[:, None].astype(jnp.int32), axis=1)[:, 0]
+    feasible_any = jnp.any(ok, axis=1)
+    return tstar, chosen_bins.astype(jnp.int32), feasible_any

@@ -1,0 +1,3 @@
+from .dense import DenseSolver, DenseSolveStats
+
+__all__ = ["DenseSolver", "DenseSolveStats"]

@@ -1,0 +1,433 @@
+"""DenseSolver: the TPU fast path for provisioning solves.
+
+Pipeline (one call per batch, attached to Scheduler via `dense_solver=`):
+
+  1. encode    — ir/encode.py: dedupe pods into constraint groups, compute
+                 exact [G, T] compatibility with host algebra, build dense
+                 matrices.
+  2. domains   — water-fill spread groups across their topology domains,
+                 pin affinity components, mark dedicated/single-bin buckets.
+  3. device    — ops/: bucket→type choice ([B, T] fractional-cost argmin) and
+                 the bounded-space FFD packing scan over the sorted pod
+                 stream; both jitted, shapes padded to tile buckets.
+  4. verify    — vectorized numpy feasibility audit of the proposed layout
+                 (per-bin capacity, compat, offerings, skew); any bucket that
+                 fails is evicted wholesale to the host loop.
+  5. commit    — construct VirtualNodes directly (no per-pod search) and
+                 record topology domains, so host-path pods that follow see
+                 consistent counts.
+
+Pods whose constraints the dense IR can't express — and all pods whenever
+existing in-flight nodes, provisioner limits, or inverse anti-affinities are
+in play (round-1 scope) — return to the caller for the exact host loop.
+Correct-by-construction: the host loop re-checks nothing that was committed,
+but everything committed was verified against the same invariants the host
+protocol enforces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as lbl
+from ..api.objects import OP_IN, Pod
+from ..ir.encode import DenseProblem, GroupKind, encode_problem
+from ..scheduling.requirement import Requirement
+from ..utils import resources as res
+
+_PAD = 128  # pad the pod axis to multiples of this for compile caching
+
+
+@dataclass
+class DenseSolveStats:
+    batches: int = 0
+    pods_in: int = 0
+    pods_committed: int = 0
+    pods_to_host: int = 0
+    nodes_created: int = 0
+    encode_seconds: float = 0.0
+    device_seconds: float = 0.0
+    commit_seconds: float = 0.0
+
+
+@dataclass
+class _Bucket:
+    group_index: int
+    zone: Optional[str] = None  # pinned zone
+    capacity_type: Optional[str] = None  # pinned capacity type
+    dedicated: bool = False
+    single_bin: bool = False
+    pod_rows: List[int] = field(default_factory=list)  # rows into problem arrays
+
+
+class DenseSolver:
+    """Attachable TPU presolver for Scheduler (scheduler.py)."""
+
+    def __init__(self, min_batch: int = 32, num_slots: int = 8):
+        self.min_batch = min_batch
+        self.num_slots = num_slots
+        self.stats = DenseSolveStats()
+
+    # -- Scheduler hook ------------------------------------------------------
+
+    def presolve(self, scheduler, pods: Sequence[Pod]) -> List[Pod]:
+        """Commit dense-expressible placements into `scheduler`; returns the
+        pods that still need the exact host loop."""
+        pods = list(pods)
+        if len(pods) < self.min_batch:
+            return pods
+        if scheduler.existing_nodes:
+            return pods  # in-flight node fill is host-path in round 1
+        if scheduler.remaining_resources:
+            return pods  # provisioner limits need the sequential invariant
+        if scheduler.topology.inverse_topologies:
+            return pods  # existing anti-affinity pods can block arbitrary pods
+        if not scheduler.node_templates:
+            return pods
+        self.stats.batches += 1
+        self.stats.pods_in += len(pods)
+
+        template = scheduler.node_templates[0]
+        instance_types = scheduler.instance_types.get(template.provisioner_name, [])
+        if not instance_types:
+            return pods
+
+        t0 = time.perf_counter()
+        problem = encode_problem(
+            pods,
+            template,
+            instance_types,
+            daemon_overhead=scheduler.daemon_overhead.get(template.provisioner_name, {}),
+            zones=scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ()),
+            capacity_types=scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ()),
+        )
+        leftover = list(problem.host_pods)
+        if problem.P == 0:
+            self.stats.pods_to_host += len(leftover)
+            return leftover
+
+        buckets = self._build_buckets(problem, scheduler.topology)
+        t1 = time.perf_counter()
+        assignment = self._device_solve(problem, buckets)
+        t2 = time.perf_counter()
+        committed, fallback_rows = self._verify_and_commit(scheduler, problem, buckets, assignment)
+        t3 = time.perf_counter()
+
+        self.stats.encode_seconds += t1 - t0
+        self.stats.device_seconds += t2 - t1
+        self.stats.commit_seconds += t3 - t2
+        leftover.extend(problem.pods[row] for row in fallback_rows)
+        self.stats.pods_committed += committed
+        self.stats.pods_to_host += len(leftover)
+        return leftover
+
+    # -- step 2: domain assignment / bucket construction ---------------------
+
+    def _build_buckets(self, problem: DenseProblem, topology) -> List[_Bucket]:
+        buckets: List[_Bucket] = []
+        rows_by_group: Dict[int, List[int]] = {}
+        for row, gid in enumerate(problem.group_ids):
+            rows_by_group.setdefault(int(gid), []).append(row)
+
+        self._demote_cross_selecting_groups(problem)
+        for group in problem.groups:
+            rows = rows_by_group.get(group.index, [])
+            if not rows:
+                continue
+            g = group.index
+            if group.kind == GroupKind.PLAIN:
+                buckets.append(_Bucket(group_index=g, pod_rows=rows))
+            elif group.kind == GroupKind.SPREAD:
+                if group.topology_key == lbl.LABEL_HOSTNAME:
+                    # every hostname is a fresh domain: one pod per node
+                    buckets.append(_Bucket(group_index=g, dedicated=True, pod_rows=rows))
+                elif group.topology_key == lbl.LABEL_TOPOLOGY_ZONE:
+                    buckets.extend(self._water_fill(problem, topology, group, rows, problem.zones, problem.group_zone_allowed[g], "zone"))
+                else:  # capacity type
+                    buckets.extend(self._water_fill(problem, topology, group, rows, problem.capacity_types, problem.group_ct_allowed[g], "ct"))
+            elif group.kind == GroupKind.AFFINITY:
+                if group.topology_key == lbl.LABEL_HOSTNAME:
+                    # whole component shares one node
+                    buckets.append(_Bucket(group_index=g, single_bin=True, pod_rows=rows))
+                else:
+                    zone = self._pick_affinity_zone(problem, topology, group)
+                    if zone is None:
+                        # no viable zone: host loop will produce the error
+                        buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
+                    else:
+                        buckets.append(_Bucket(group_index=g, zone=zone, pod_rows=rows))
+            elif group.kind == GroupKind.ANTI_HOST:
+                buckets.append(_Bucket(group_index=g, dedicated=True, pod_rows=rows))
+            elif group.kind == GroupKind.HOST:
+                # demoted after encode (cross-selection): route to host loop
+                buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
+        return buckets
+
+    def _demote_cross_selecting_groups(self, problem: DenseProblem) -> None:
+        """A zone/capacity-type spread group whose selector also matches pods
+        in a *different* group that pins the same key cannot be water-filled
+        independently — the other group's pinned placements change its domain
+        counts mid-solve. Those groups take the exact host loop.
+
+        This mirrors the reference's Record rule (topology.go:126-135): only
+        placements whose requirement collapses to a single domain are counted,
+        so unpinned (plain) groups never interfere; hostname-keyed dense
+        shapes are dedicated/single-bin and therefore safe by construction;
+        zone-pinned affinity components stay valid because their own pods
+        populate the chosen domain.
+        """
+        pinned_by_key: Dict[str, List] = {}
+        for g in problem.groups:
+            if g.kind == GroupKind.SPREAD and g.topology_key in (lbl.LABEL_TOPOLOGY_ZONE, lbl.LABEL_CAPACITY_TYPE):
+                pinned_by_key.setdefault(g.topology_key, []).append(g)
+            elif g.kind == GroupKind.AFFINITY and g.topology_key == lbl.LABEL_TOPOLOGY_ZONE:
+                pinned_by_key.setdefault(lbl.LABEL_TOPOLOGY_ZONE, []).append(g)
+
+        for group in problem.groups:
+            if group.kind != GroupKind.SPREAD or group.topology_key not in (lbl.LABEL_TOPOLOGY_ZONE, lbl.LABEL_CAPACITY_TYPE):
+                continue
+            selector = group.pods[0].spec.topology_spread_constraints[0].label_selector
+            me = group.pods[0]
+            for other in pinned_by_key.get(group.topology_key, []):
+                if other.index == group.index:
+                    continue
+                rep = other.pods[0]
+                if rep.namespace == me.namespace and selector.matches(rep.metadata.labels):
+                    group.kind = GroupKind.HOST
+                    break
+
+    def _existing_counts(self, topology, group, key: str, domains: Sequence[str]) -> np.ndarray:
+        """Current per-domain counts from any matching topology group."""
+        counts = np.zeros((len(domains),), dtype=np.int64)
+        pod = group.pods[0]
+        for tg in topology.topologies.values():
+            if tg.key == key and tg.is_owned_by(pod.uid):
+                for i, domain in enumerate(domains):
+                    counts[i] += tg.domains.get(domain, 0)
+        return counts
+
+    def _water_fill(self, problem, topology, group, rows: List[int], domains: List[str], allowed: np.ndarray, pin_kind: str) -> List[_Bucket]:
+        """Distribute the group's pods across allowed domains, lowest current
+        count first (water filling) — the closed-form of the reference's
+        per-pod min-count domain choice (topologygroup.go:157-184)."""
+        allowed_idx = [i for i in range(len(domains)) if allowed[i]]
+        if not allowed_idx:
+            return [_Bucket(group_index=group.index, pod_rows=rows, zone="__infeasible__")]
+        counts = self._existing_counts(topology, group, group.topology_key, domains)[allowed_idx].astype(np.float64)
+        n = len(rows)
+        # fill lowest-count domains first; target[i] - counts[i] pods go to i
+        order = np.argsort(counts, kind="stable")
+        counts_sorted = counts[order]
+        targets = counts_sorted.copy()
+        remaining = n
+        # raise the water level step by step (vectorized over ~few domains)
+        for level_idx in range(1, len(targets) + 1):
+            if remaining <= 0:
+                break
+            if level_idx < len(targets):
+                gap = (counts_sorted[level_idx] - targets[:level_idx]).sum()
+                take = min(remaining, gap)
+            else:
+                take = remaining
+            if take > 0:
+                per = int(take // level_idx)
+                extra = int(take - per * level_idx)
+                targets[:level_idx] += per
+                targets[:extra] += 1
+                remaining -= take
+        adds = (targets - counts_sorted).astype(np.int64)
+        buckets = []
+        cursor = 0
+        for pos, count in zip(order, adds):
+            if count <= 0:
+                continue
+            chunk = rows[cursor : cursor + int(count)]
+            cursor += int(count)
+            domain = domains[allowed_idx[pos]]
+            if pin_kind == "zone":
+                buckets.append(_Bucket(group_index=group.index, zone=domain, pod_rows=chunk))
+            else:
+                buckets.append(_Bucket(group_index=group.index, capacity_type=domain, pod_rows=chunk))
+        if cursor < len(rows):  # shouldn't happen; be safe
+            buckets.append(_Bucket(group_index=group.index, pod_rows=rows[cursor:], zone="__infeasible__"))
+        return buckets
+
+    def _pick_affinity_zone(self, problem, topology, group) -> Optional[str]:
+        g = group.index
+        allowed = [z for i, z in enumerate(problem.zones) if problem.group_zone_allowed[g][i]]
+        if not allowed:
+            return None
+        counts = self._existing_counts(topology, group, lbl.LABEL_TOPOLOGY_ZONE, allowed)
+        populated = [z for z, c in zip(allowed, counts) if c > 0]
+        return populated[0] if populated else allowed[0]
+
+    # -- step 3: device solve -------------------------------------------------
+
+    def _device_solve(self, problem: DenseProblem, buckets: List[_Bucket]):
+        """Bucket→type choice on device; packing via counts (see
+        pack_counts.py for why the per-pod scan is the wrong shape for TPU).
+
+        Returns per-pod row→bin assignment plus per-bin metadata.
+        """
+        import jax.numpy as jnp
+
+        from ..ops.feasibility import bucket_type_cost
+        from .pack_counts import assign_bins, dedupe_sizes, pack_counts
+
+        B = len(buckets)
+        zone_index = {z: i for i, z in enumerate(problem.zones)}
+        ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
+
+        # bucket aggregates (numpy, bucket-scale)
+        sum_req = np.zeros((B, problem.requests.shape[1]), np.float64)
+        max_req = np.zeros_like(sum_req)
+        allowed = np.zeros((B, problem.T), dtype=bool)
+        for b, bucket in enumerate(buckets):
+            rows = bucket.pod_rows
+            sum_req[b] = problem.requests[rows].sum(axis=0)
+            max_req[b] = problem.requests[rows].max(axis=0)
+            mask = problem.compat[bucket.group_index].copy()
+            if bucket.zone == "__infeasible__":
+                mask[:] = False
+            else:
+                if bucket.zone is not None:
+                    mask &= problem.type_zone[:, zone_index[bucket.zone]]
+                if bucket.capacity_type is not None:
+                    mask &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+            allowed[b] = mask
+
+        # host math stays float64 (exact vs resources.fits); the device sees
+        # f32 — its choice is advisory, commit-time checks are authoritative
+        caps_eff = np.maximum(problem.caps - problem.daemon_overhead[None, :], 0.0)
+
+        tstar, _, feasible = bucket_type_cost(
+            jnp.asarray(sum_req, dtype=jnp.float32),
+            jnp.asarray(max_req, dtype=jnp.float32),
+            jnp.asarray(caps_eff, dtype=jnp.float32),
+            jnp.asarray(problem.prices, dtype=jnp.float32),
+            jnp.asarray(allowed),
+        )
+        tstar = np.asarray(tstar)
+        feasible = np.asarray(feasible)
+
+        bin_of_row = np.full((problem.P,), -1, np.int64)
+        bin_bucket: List[int] = []
+        next_bin = 0
+        for b, bucket in enumerate(buckets):
+            rows = np.asarray(bucket.pod_rows, dtype=np.int64)
+            if not feasible[b]:
+                continue  # all pods of this bucket fall back to the host loop
+            cap = caps_eff[tstar[b]]
+            reqs = problem.requests[rows]
+            if bucket.dedicated:
+                fits = np.all(reqs <= cap[None, :] + res.tolerance(cap)[None, :], axis=1)
+                ids = np.where(fits, next_bin + np.cumsum(fits) - 1, -1)
+                bin_of_row[rows] = ids
+                opened = int(fits.sum())
+                bin_bucket.extend([b] * opened)
+                next_bin += opened
+            elif bucket.single_bin:
+                # fill one bin greedily, largest first, exact resource check
+                order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+                free = cap.astype(np.float64).copy()
+                taken = []
+                for i in order:
+                    if np.all(reqs[i] <= free + res.tolerance(free)):
+                        free -= reqs[i]
+                        taken.append(i)
+                if taken:
+                    bin_of_row[rows[np.asarray(taken)]] = next_bin
+                    bin_bucket.append(b)
+                    next_bin += 1
+            else:
+                quantum = None
+                # bound the distinct-size count for continuous distributions
+                if len(rows) > 4096:
+                    quantum = np.maximum(cap, 1e-9) / 4096.0
+                unique, counts, inverse = dedupe_sizes(reqs, quantum)
+                patterns, unplaced = pack_counts(unique, counts, cap)
+                ids, next_bin2 = assign_bins(inverse, patterns, unplaced, next_bin)
+                bin_of_row[rows] = ids
+                bin_bucket.extend([b] * (next_bin2 - next_bin))
+                next_bin = next_bin2
+
+        return {
+            "buckets": buckets,
+            "tstar": tstar,
+            "bin_of_row": bin_of_row,
+            "bin_bucket": np.asarray(bin_bucket, dtype=np.int64),
+            "num_bins": next_bin,
+        }
+
+    # -- steps 4+5: verify & commit ------------------------------------------
+
+    def _verify_and_commit(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol) -> Tuple[int, List[int]]:
+        from ..scheduler.node import VirtualNode
+
+        bin_of_row = sol["bin_of_row"]
+        bin_bucket = sol["bin_bucket"]
+        num_bins = sol["num_bins"]
+
+        fallback_rows: List[int] = [int(r) for r in np.nonzero(bin_of_row < 0)[0]]
+
+        if num_bins == 0:
+            return 0, fallback_rows
+
+        # per-bin aggregates (vectorized over the pod axis)
+        usage = np.zeros((num_bins, problem.requests.shape[1]), np.float64)
+        placed = bin_of_row >= 0
+        np.add.at(usage, bin_of_row[placed], problem.requests[placed])
+        bin_rows: List[List[int]] = [[] for _ in range(num_bins)]
+        for row in np.nonzero(placed)[0]:
+            bin_rows[int(bin_of_row[row])].append(int(row))
+
+        caps_full = problem.caps  # [T, R]
+        overhead = problem.daemon_overhead
+        zone_index = {z: i for i, z in enumerate(problem.zones)}
+        ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
+
+        committed = 0
+        for bid in range(num_bins):
+            bucket = buckets[int(bin_bucket[bid])]
+            group = problem.groups[bucket.group_index]
+            need = usage[bid] + overhead
+
+            # audit: surviving instance-type options for this bin (same
+            # tolerance rule as resources.fits so audits can't disagree)
+            mask = problem.compat[bucket.group_index] & np.all(need[None, :] <= caps_full + res.tolerance(caps_full), axis=1)
+            if bucket.zone is not None and bucket.zone != "__infeasible__":
+                mask &= problem.type_zone[:, zone_index[bucket.zone]]
+            if bucket.capacity_type is not None:
+                mask &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+            if not mask.any():
+                fallback_rows.extend(bin_rows[bid])
+                continue
+
+            options = [problem.instance_types[t] for t in np.nonzero(mask)[0]]
+            node = VirtualNode(problem.template, scheduler.topology, dict(scheduler.daemon_overhead.get(problem.template.provisioner_name, {})), options)
+            reqs = node.template.requirements
+            if group.requirements is not None:
+                err = reqs.compatible(group.requirements)
+                if err is not None:
+                    node.release()
+                    fallback_rows.extend(bin_rows[bid])
+                    continue
+                reqs.add(*group.requirements.values())
+            if bucket.zone is not None and bucket.zone != "__infeasible__":
+                reqs.add(Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, bucket.zone))
+            if bucket.capacity_type is not None:
+                reqs.add(Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, bucket.capacity_type))
+
+            node.pods = [problem.pods[row] for row in bin_rows[bid]]
+            node.requests = res.merge(
+                node.requests, {name: float(v) for name, v in zip(problem.resource_names, usage[bid]) if v > 0}
+            )
+            scheduler.nodes.append(node)
+            committed += len(node.pods)
+            for pod in node.pods:
+                scheduler.topology.record(pod, reqs)
+        return committed, fallback_rows

@@ -50,6 +50,18 @@ def max_resources(*resource_lists: Mapping[str, float]) -> ResourceList:
     return out
 
 
+def tolerance(total):
+    """Comparison tolerance for resource arithmetic: absolute for cpu-scale
+    values plus relative for byte-scale values; effectively zero when the
+    capacity itself is zero (a nonzero request for an absent resource never
+    fits). Elementwise-safe: accepts floats or numpy arrays. Shared by
+    fits(), the dense packer (pack_counts.py), and the commit audit
+    (solver/dense.py) so their verdicts can never disagree."""
+    import numpy as np
+
+    return np.where(np.asarray(total) > 0, 1e-6 + 1e-9 * np.abs(total), 1e-12)
+
+
 def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
     """True if candidate <= total for every resource named in candidate.
 
@@ -57,7 +69,8 @@ def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
     resource requested but absent from `total` only fits if the request is 0.
     """
     for name, value in (candidate or {}).items():
-        if value > (total or {}).get(name, 0.0) + 1e-9:
+        limit = (total or {}).get(name, 0.0)
+        if value > limit + float(tolerance(limit)):
             return False
     return True
 

@@ -1,0 +1,216 @@
+"""Differential tests: TPU dense solver vs the exact host FFD oracle.
+
+The contract is NOT assignment-for-assignment equality (the dense solver is a
+different algorithm) but:
+  - every dense placement is feasible (audited independently here),
+  - nothing schedulable is dropped (same set of scheduled pods as the oracle),
+  - total node cost is within a small factor of the oracle's,
+  - constraint semantics (spread skew, affinity colocation, anti-affinity
+    separation) hold on the dense output.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+)
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    NodeSelectorRequirement,
+    OP_IN,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.utils import resources as res
+from tests.helpers import make_pod, make_pods, make_provisioner
+
+RNG = np.random.default_rng(42)
+
+
+def solve_both(pods, provisioners=None, provider=None):
+    provisioners = provisioners or [make_provisioner()]
+    provider = provider or FakeCloudProvider(instance_types(50))
+    host = build_scheduler(provisioners, provider, pods).solve(pods)
+    dense = build_scheduler(
+        provisioners, provider, pods, dense_solver=DenseSolver(min_batch=1)
+    ).solve(pods)
+    return host, dense
+
+
+def total_cost(results):
+    return sum(n.instance_type_options[0].price() for n in results.new_nodes)
+
+
+def scheduled_names(results):
+    return {p.name for n in results.new_nodes for p in n.pods}
+
+
+def audit_feasible(results):
+    """Independent audit: per-node resource sums within the cheapest option."""
+    for node in results.new_nodes:
+        assert node.instance_type_options, "node with no type options"
+        it = node.instance_type_options[0]
+        need = res.merge(node.requests, it.overhead())
+        assert res.fits(need, it.resources()), (
+            f"node overflows its cheapest type {it.name()}: need={need} cap={it.resources()}"
+        )
+        for it in node.instance_type_options:
+            need = res.merge(node.requests, it.overhead())
+            assert res.fits(need, it.resources())
+
+
+def make_random_pods(count, seed=0):
+    rng = np.random.default_rng(seed)
+    cpus = [0.1, 0.25, 0.5, 1.0, 1.5]
+    mems = [100, 256, 512, 1024, 2048, 4096]
+    return [
+        make_pod(
+            requests={"cpu": cpus[rng.integers(len(cpus))], "memory": f"{mems[rng.integers(len(mems))]}Mi"},
+            labels={"my-label": "abcdefg"[rng.integers(7)]},
+        )
+        for _ in range(count)
+    ]
+
+
+class TestDenseVsOracle:
+    def test_homogeneous_batch(self):
+        pods = make_pods(40, requests={"cpu": "1", "memory": "1Gi"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        assert total_cost(dense) <= total_cost(host) * 1.25 + 1e-6
+
+    def test_mixed_sizes(self):
+        pods = make_random_pods(200, seed=1)
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        assert total_cost(dense) <= total_cost(host) * 1.25 + 1e-6
+
+    def test_selectors_and_taints(self):
+        prov = make_provisioner(taints=[Taint(key="team", value="infra", effect="NoSchedule")])
+        toleration = Toleration(key="team", operator="Exists")
+        pods = [
+            make_pod(
+                requests={"cpu": "0.5"},
+                tolerations=[toleration],
+                node_selector={LABEL_TOPOLOGY_ZONE: ["test-zone-1", "test-zone-2"][i % 2]},
+            )
+            for i in range(60)
+        ]
+        host, dense = solve_both(pods, provisioners=[prov])
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        # zone selectors must be honored
+        for node in dense.new_nodes:
+            zone_req = node.requirements.get(LABEL_TOPOLOGY_ZONE)
+            assert len(zone_req.values) == 1
+
+    def test_zonal_spread(self):
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"})
+        )
+        pods = make_pods(30, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        zone_counts = {}
+        for node in dense.new_nodes:
+            zone = node.requirements.get(LABEL_TOPOLOGY_ZONE).any_value()
+            zone_counts[zone] = zone_counts.get(zone, 0) + len(node.pods)
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+        assert len(zone_counts) == 3
+
+    def test_hostname_spread_dedicated(self):
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "web"})
+        )
+        pods = make_pods(12, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        # every pod on its own node
+        assert all(len(n.pods) == 1 for n in dense.new_nodes if n.pods and n.pods[0].metadata.labels.get("app") == "web")
+
+    def test_capacity_type_spread(self):
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_CAPACITY_TYPE, label_selector=LabelSelector(match_labels={"app": "web"})
+        )
+        pods = make_pods(20, labels={"app": "web"}, topology_spread_constraints=[constraint], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        ct_counts = {}
+        for node in dense.new_nodes:
+            ct = node.requirements.get(LABEL_CAPACITY_TYPE).any_value()
+            ct_counts[ct] = ct_counts.get(ct, 0) + len(node.pods)
+        assert abs(ct_counts.get("spot", 0) - ct_counts.get("on-demand", 0)) <= 1
+
+    def test_zonal_self_affinity(self):
+        term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "db"}))
+        pods = make_pods(15, labels={"app": "db"}, pod_requirements=[term], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        zones = set()
+        for node in dense.new_nodes:
+            if node.pods:
+                zones.add(node.requirements.get(LABEL_TOPOLOGY_ZONE).any_value())
+        assert len(zones) == 1
+
+    def test_hostname_self_affinity_single_node(self):
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "db"}))
+        pods = make_pods(5, labels={"app": "db"}, pod_requirements=[term], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        assert len([n for n in dense.new_nodes if n.pods]) == 1
+
+    def test_hostname_anti_affinity(self):
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "web"}))
+        pods = make_pods(6, labels={"app": "web"}, pod_anti_requirements=[term], requests={"cpu": "0.5"})
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        web_nodes = [n for n in dense.new_nodes if n.pods]
+        assert all(len(n.pods) == 1 for n in web_nodes)
+
+    def test_unschedulable_pods_agree(self):
+        pods = make_pods(10, requests={"cpu": "0.5"}) + [make_pod(name="monster", requests={"cpu": "5000"})]
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        assert "monster" in {p.name for p in dense.unschedulable}
+
+    def test_mixed_workload_cost_parity(self):
+        spread = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "spread"})
+        )
+        anti = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "anti"}))
+        pods = (
+            make_random_pods(100, seed=7)
+            + make_pods(20, labels={"app": "spread"}, topology_spread_constraints=[spread], requests={"cpu": "0.5"})
+            + make_pods(8, labels={"app": "anti"}, pod_anti_requirements=[anti], requests={"cpu": "0.5"})
+        )
+        host, dense = solve_both(pods)
+        audit_feasible(dense)
+        assert scheduled_names(dense) == scheduled_names(host)
+        assert total_cost(dense) <= total_cost(host) * 1.3 + 1e-6
+
+    def test_dense_stats_report_usage(self):
+        provider = FakeCloudProvider(instance_types(50))
+        solver = DenseSolver(min_batch=1)
+        pods = make_pods(50, requests={"cpu": "1"})
+        scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+        scheduler.solve(pods)
+        assert solver.stats.pods_committed == 50
+        assert solver.stats.pods_to_host == 0
+        assert solver.stats.nodes_created >= 0
