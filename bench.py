@@ -1,0 +1,146 @@
+"""Benchmark: the headline provisioning solve on real hardware.
+
+Measures the full Scheduler.solve wall-clock — dense encode, device solve,
+verify, commit — for the BASELINE.json headline config: 10k pending pods
+against 500 instance types with a mixed constraint workload (generic sizes,
+zonal topology spread, zonal self-affinity, hostname anti-affinity; the
+constraint mix mirrors the reference benchmark's, with self-consistent
+selectors as real deployments have).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
+
+vs_baseline is the speedup over the reference's enforced scheduler floor of
+100 pods/sec (pkg/controllers/provisioning/scheduling/
+scheduling_benchmark_test.go:46,173-177): 10k pods / 100 pods-per-sec =
+100,000 ms baseline wall-clock.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PODS = 10_000
+TYPES = 500
+BASELINE_PODS_PER_SEC = 100.0
+TRIALS = 3
+
+
+def build_workload(count: int, seed: int = 42):
+    from karpenter_tpu.api.labels import LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm, TopologySpreadConstraint
+    from tests.helpers import make_pod
+
+    rng = np.random.default_rng(seed)
+    cpus = [0.1, 0.25, 0.5, 1.0, 1.5]
+    mems = ["100Mi", "256Mi", "512Mi", "1Gi", "2Gi", "4Gi"]
+    values = "abcdefg"
+
+    def size():
+        return {"cpu": cpus[rng.integers(len(cpus))], "memory": mems[rng.integers(len(mems))]}
+
+    pods = []
+    seventh = count // 7
+    # 1/7 zonal spread (self-selecting, 7 label cohorts)
+    for i in range(seventh):
+        label = {"spread": values[rng.integers(7)]}
+        pods.append(
+            make_pod(
+                labels=label,
+                requests=size(),
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=label))
+                ],
+            )
+        )
+    # 1/7 zonal self-affinity cohorts
+    for i in range(seventh):
+        label = {"affinity": values[rng.integers(7)]}
+        pods.append(
+            make_pod(
+                labels=label,
+                requests=size(),
+                pod_requirements=[PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=label))],
+            )
+        )
+    # 1/7 hostname anti-affinity cohorts
+    for i in range(seventh):
+        label = {"anti": values[rng.integers(7)]}
+        pods.append(
+            make_pod(
+                labels=label,
+                requests=size(),
+                pod_anti_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=label))],
+            )
+        )
+    # remainder generic
+    while len(pods) < count:
+        pods.append(make_pod(labels={"app": values[rng.integers(7)]}, requests=size()))
+    return pods
+
+
+def run_once(pods, provider, provisioner, solver):
+    from karpenter_tpu.scheduler import build_scheduler
+    from karpenter_tpu.solver import DenseSolveStats
+
+    solver.stats = DenseSolveStats()
+    scheduler = build_scheduler([provisioner], provider, pods, dense_solver=solver)
+    t0 = time.perf_counter()
+    results = scheduler.solve(pods)
+    elapsed = time.perf_counter() - t0
+    scheduled = sum(len(n.pods) for n in results.new_nodes)
+    cost = sum(n.instance_type_options[0].price() for n in results.new_nodes)
+    return elapsed, scheduled, len(results.new_nodes), cost, solver.stats
+
+
+def main() -> None:
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from tests.helpers import make_provisioner
+
+    from karpenter_tpu.solver import DenseSolver
+
+    provider = FakeCloudProvider(instance_types(TYPES))
+    provisioner = make_provisioner()
+    pods = build_workload(PODS)
+
+    # one long-lived solver, as the provisioning controller holds in practice
+    # (retains the uploaded device catalog between solves)
+    solver = DenseSolver(min_batch=1)
+
+    # warmup: compile + tunnel setup + catalog upload
+    run_once(pods, provider, provisioner, solver)
+
+    times = []
+    scheduled = nodes = 0
+    cost = 0.0
+    for _ in range(TRIALS):
+        elapsed, scheduled, nodes, cost, stats = run_once(pods, provider, provisioner, solver)
+        times.append(elapsed)
+        print(
+            f"trial: {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f} device {stats.device_seconds*1000:.0f} "
+            f"commit {stats.commit_seconds*1000:.0f}) scheduled={scheduled} nodes={nodes} cost={cost:.1f}",
+            file=sys.stderr,
+        )
+
+    value_ms = float(np.median(times) * 1000)
+    baseline_ms = PODS / BASELINE_PODS_PER_SEC * 1000
+    if scheduled < PODS * 0.99:
+        print(f"WARNING: only {scheduled}/{PODS} pods scheduled", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": f"solve_wall_clock_{PODS}_pods_x_{TYPES}_types",
+                "value": round(value_ms, 1),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / value_ms, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

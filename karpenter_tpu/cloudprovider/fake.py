@@ -83,6 +83,27 @@ class FakeInstanceType(InstanceType):
         return price
 
     def requirements(self) -> Requirements:
+        # memoized: the scheduler probes requirements once per (group, type)
+        # and rebuilding the set algebra dominates encode time otherwise.
+        # Keyed on the contributing fields so tests that mutate a fake type
+        # (e.g. dropping an offering to simulate capacity loss) see fresh
+        # requirements.
+        key = (
+            self._name,
+            self.architecture,
+            tuple(self.operating_systems),
+            tuple(self._offerings),
+            self._resources.get("cpu"),
+            self._resources.get("memory"),
+        )
+        cached = getattr(self, "_requirements_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        requirements = self._build_requirements()
+        object.__setattr__(self, "_requirements_cache", (key, requirements))
+        return requirements
+
+    def _build_requirements(self) -> Requirements:
         requirements = Requirements(
             Requirement(lbl.LABEL_INSTANCE_TYPE, OP_IN, self._name),
             Requirement(lbl.LABEL_ARCH, OP_IN, self.architecture),

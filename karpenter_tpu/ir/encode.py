@@ -281,8 +281,6 @@ def encode_problem(
     capacity_types: Optional[Sequence[str]] = None,
 ) -> DenseProblem:
     """Encode a batch against one node template's instance-type universe."""
-    from ..scheduler.node import filter_instance_types
-
     # -- axes ---------------------------------------------------------------
     zone_set: Set[str] = set(zones or ())
     ct_set: Set[str] = set(capacity_types or ())
@@ -354,8 +352,12 @@ def encode_problem(
     group_ct_allowed = np.ones((G, len(ct_list)), dtype=bool)
 
     # -- per-group compatibility via the exact host algebra ------------------
+    from ..scheduler.node import type_is_compatible, type_has_offering
+
     type_list = list(instance_types)
-    type_position = {id(it): i for i, it in enumerate(type_list)}
+    # overhead-fits-resources holds independently of the group (requests are
+    # checked per bin later); precompute once per catalog
+    empty_fit = np.array([res.fits(it.overhead(), it.resources()) for it in type_list], dtype=bool)
     for group in groups:
         pod = group.pods[0]
         # taints: template taints must be tolerated
@@ -370,9 +372,9 @@ def encode_problem(
             group.kind = GroupKind.HOST
             continue
         node_requirements.add(*group.requirements.values())
-        survivors = filter_instance_types(type_list, node_requirements, {})
-        for it in survivors:
-            compat[group.index, type_position[id(it)]] = True
+        for t, it in enumerate(type_list):
+            if empty_fit[t] and type_is_compatible(it, node_requirements) and type_has_offering(it, node_requirements):
+                compat[group.index, t] = True
         zone_req = node_requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
         group_zone_allowed[group.index] = [zone_req.has(z) for z in zone_list]
         ct_req = node_requirements.get(lbl.LABEL_CAPACITY_TYPE)

@@ -40,6 +40,16 @@ def feasibility_mask(requests: jax.Array, caps: jax.Array, compat: jax.Array, gr
 
 
 @jax.jit
+def bucket_type_cost_packed(bucket_stats: jax.Array, caps: jax.Array, prices: jax.Array, allowed: jax.Array) -> jax.Array:
+    """Transfer-minimal wrapper: bucket_stats = stack([sum, max]) [2, B, R];
+    returns one packed int32 [3, B] = (tstar, bins, feasible). One upload of
+    per-batch data, one download — dispatch latency over the host<->device
+    link dominates at this problem size, so round trips are the budget."""
+    tstar, bins, feasible = bucket_type_cost(bucket_stats[0], bucket_stats[1], caps, prices, allowed)
+    return jnp.stack([tstar, bins, feasible.astype(jnp.int32)])
+
+
+@jax.jit
 def bucket_type_cost(sum_requests: jax.Array, max_requests: jax.Array, caps: jax.Array, prices: jax.Array, allowed: jax.Array):
     """Vectorized bucket -> instance-type choice.
 

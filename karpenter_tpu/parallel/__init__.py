@@ -1,0 +1,4 @@
+from .mesh import solver_mesh, pod_sharding, type_sharding, replicated
+from .sharded import sharded_solve_step
+
+__all__ = ["solver_mesh", "pod_sharding", "type_sharding", "replicated", "sharded_solve_step"]

@@ -1,0 +1,58 @@
+"""Device mesh + sharding layout for the distributed solver.
+
+The problem's parallel structure (SURVEY.md section 2.10): the pods x types
+feasibility/packing surface is embarrassingly parallel over pods and
+reducible over types. The mesh maps that directly:
+
+  axis "pods"  — data-parallel shards of the pod axis (requests, group ids,
+                 per-pod outputs). Scales with batch size over ICI.
+  axis "types" — model-parallel shards of the instance-type axis (caps,
+                 prices, compat columns). Reductions over types (argmin cost,
+                 any-feasible) become XLA collectives over this axis.
+
+Multi-host: the same mesh spans hosts; XLA routes the "types" reductions and
+"pods" all-gathers over ICI within a host and DCN across hosts, which is the
+right locality because types-axis traffic (argmin combines) is tiny compared
+to pods-axis activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1) -> Mesh:
+    """Build a (pods x types) mesh over the first n devices.
+
+    types_parallel devices shard the type axis; the rest shard pods.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = np.asarray(devices[:n])
+    if n % types_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by types_parallel={types_parallel}")
+    grid = devices.reshape(n // types_parallel, types_parallel)
+    return Mesh(grid, axis_names=("pods", "types"))
+
+
+def pod_sharding(mesh: Mesh) -> NamedSharding:
+    """[P, ...] arrays: shard the leading pod axis."""
+    return NamedSharding(mesh, P("pods"))
+
+
+def type_sharding(mesh: Mesh) -> NamedSharding:
+    """[T, ...] arrays: shard the leading type axis."""
+    return NamedSharding(mesh, P("types"))
+
+
+def pod_by_type_sharding(mesh: Mesh) -> NamedSharding:
+    """[P, T] arrays: 2D-sharded over both mesh axes."""
+    return NamedSharding(mesh, P("pods", "types"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

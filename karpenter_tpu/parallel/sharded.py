@@ -1,0 +1,92 @@
+"""The sharded solve step: one jitted program over the (pods x types) mesh.
+
+This is the multi-chip formulation of the dense solve's device portion:
+feasibility masks sharded [pods x types], per-pod cheapest-feasible-type
+argmin reduced over the types axis (XLA inserts the cross-shard argmin
+combine over ICI), the bucket->instance-type cost choice reduced likewise,
+and per-bin segment reductions sharded over pods. Everything is expressed
+with sharding annotations on a single jit — no hand-written collectives —
+per the standard mesh/pjit recipe: annotate in/out shardings, let XLA place
+psum/all-gather where the math demands them.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import pod_sharding, replicated, type_sharding
+
+
+@lru_cache(maxsize=16)
+def make_sharded_solve_step(mesh: Mesh, num_bins: int):
+    """Build the jitted sharded solve step for a given mesh and bin budget.
+
+    Signature of the returned fn:
+      (requests [P, R], group_ids [P], compat [G, T], caps [T, R],
+       prices [T], allowed [B, T], bucket_sum [B, R], bucket_max [B, R],
+       bin_ids [P], num_bins static)
+        -> (feasible_any [P], best_type [P], tstar [B], bins [B],
+            bin_usage [num_bins, R], bin_counts [num_bins])
+    """
+    in_shardings = (
+        pod_sharding(mesh),  # requests
+        pod_sharding(mesh),  # group_ids
+        replicated(mesh),  # compat (G is tiny)
+        type_sharding(mesh),  # caps
+        type_sharding(mesh),  # prices
+        NamedSharding(mesh, P(None, "types")),  # allowed [B, T]
+        replicated(mesh),  # bucket_sum
+        replicated(mesh),  # bucket_max
+        pod_sharding(mesh),  # bin_ids
+    )
+    out_shardings = (
+        pod_sharding(mesh),
+        pod_sharding(mesh),
+        replicated(mesh),
+        replicated(mesh),
+        replicated(mesh),
+        replicated(mesh),
+    )
+
+    @partial(jax.jit, in_shardings=in_shardings, out_shardings=out_shardings)
+    def solve_step(requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids):
+        # --- [P, T] feasibility: resource fit x compat row. 2D-sharded
+        # compute; XLA broadcasts pod shards against type shards over ICI.
+        fit = jnp.all(requests[:, None, :] <= caps[None, :, :] + 1e-6, axis=-1)
+        rows = jnp.take(compat, group_ids, axis=0)
+        feasible = fit & rows  # [P, T] sharded (pods, types)
+
+        feasible_any = jnp.any(feasible, axis=1)  # reduction over types axis
+        cost = jnp.where(feasible, prices[None, :], jnp.inf)
+        best_type = jnp.argmin(cost, axis=1).astype(jnp.int32)  # types-axis argmin
+
+        # --- bucket -> type choice (ops/feasibility.py:bucket_type_cost
+        # inlined so the whole step is one program): types axis sharded.
+        eps = 1e-9
+        safe_caps = jnp.maximum(caps, eps)
+        ratio = bucket_sum[:, None, :] / safe_caps[None, :, :]  # [B, T, R]
+        impossible = (caps[None, :, :] <= eps) & (bucket_sum[:, None, :] > eps)
+        frac = jnp.max(jnp.where(impossible, jnp.inf, ratio), axis=-1)
+        bins = jnp.ceil(jnp.maximum(frac, eps))
+        pod_fits = jnp.all(bucket_max[:, None, :] <= caps[None, :, :] + 1e-6, axis=-1)
+        ok = allowed & pod_fits & jnp.isfinite(frac)
+        key = jnp.where(ok, frac * prices[None, :] + bins * 1e-4 + prices[None, :] * 1e-7, jnp.inf)
+        tstar = jnp.argmin(key, axis=1).astype(jnp.int32)
+        chosen_bins = jnp.take_along_axis(bins, tstar[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+        # --- audit reductions over the pod shards
+        safe_ids = jnp.where(bin_ids < 0, num_bins, bin_ids)
+        usage = jax.ops.segment_sum(requests, safe_ids, num_segments=num_bins + 1)[:num_bins]
+        counts = jax.ops.segment_sum(jnp.ones_like(bin_ids), safe_ids, num_segments=num_bins + 1)[:num_bins]
+        return feasible_any, best_type, tstar, chosen_bins, usage, counts
+
+    return solve_step
+
+
+def sharded_solve_step(mesh: Mesh, requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids, num_bins: int):
+    fn = make_sharded_solve_step(mesh, num_bins)
+    return fn(requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids)

@@ -123,19 +123,24 @@ def filter_instance_types(
     return [
         it
         for it in instance_types
-        if _compatible(it, requirements) and _fits(it, requests) and _has_offering(it, requirements)
+        if type_is_compatible(it, requirements) and type_fits(it, requests) and type_has_offering(it, requirements)
     ]
 
 
-def _compatible(it: InstanceType, requirements: Requirements) -> bool:
+# The three predicates are public: the dense encoder (ir/encode.py) applies
+# them factored apart (compat per group, fit per bin) — one definition serves
+# both the host loop and the dense path so their semantics cannot drift.
+
+
+def type_is_compatible(it: InstanceType, requirements: Requirements) -> bool:
     return it.requirements().intersects(requirements) is None
 
 
-def _fits(it: InstanceType, requests: Dict[str, float]) -> bool:
+def type_fits(it: InstanceType, requests: Dict[str, float]) -> bool:
     return res.fits(res.merge(requests, it.overhead()), it.resources())
 
 
-def _has_offering(it: InstanceType, requirements: Requirements) -> bool:
+def type_has_offering(it: InstanceType, requirements: Requirements) -> bool:
     for offering in it.offerings():
         if (not requirements.has(lbl.LABEL_TOPOLOGY_ZONE) or requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone)) and (
             not requirements.has(lbl.LABEL_CAPACITY_TYPE) or requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type)

@@ -11,7 +11,7 @@ counting happens. The `cluster` provides `for_pods_with_anti_affinity`.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 from ..api import labels as lbl
 from ..api.objects import LabelSelector, OP_EXISTS, Pod
@@ -189,18 +189,29 @@ class Topology:
 
     def record(self, pod: Pod, requirements: Requirements) -> None:
         """Commit domain counts after a successful placement."""
+        self.record_cohort([pod], requirements)
+
+    def record_cohort(self, pods: Sequence[Pod], requirements: Requirements) -> None:
+        """Commit domain counts for a cohort of pods placed together with
+        identical requirements (one dense bin). Group membership checks run
+        once per cohort instead of per pod — cohort pods share namespace and
+        labels by construction (ir/encode.py groups by signature)."""
+        if not pods:
+            return
+        representative = pods[0]
+        n = len(pods)
         for group in self.topologies.values():
-            if group.counts(pod, requirements):
+            if group.counts(representative, requirements):
                 domains = requirements.get(group.key)
                 if group.type == TopologyType.POD_ANTI_AFFINITY:
-                    # block out every domain the pod *could* land in
-                    group.record(*domains.values)
-                else:
-                    if len(domains) == 1 and not domains.complement:
-                        group.record(next(iter(domains.values)))
+                    # block out every domain the pods *could* land in
+                    group.record(*domains.values, count=n)
+                elif len(domains) == 1 and not domains.complement:
+                    group.record(next(iter(domains.values)), count=n)
         for group in self.inverse_topologies.values():
-            if group.is_owned_by(pod.uid):
-                group.record(*requirements.get(group.key).values)
+            for pod in pods:
+                if group.is_owned_by(pod.uid):
+                    group.record(*requirements.get(group.key).values)
 
     def register(self, topology_key: str, domain: str) -> None:
         """Make a new domain (e.g. a fresh hostname) visible to all groups."""

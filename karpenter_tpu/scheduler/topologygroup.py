@@ -91,9 +91,9 @@ class TopologyGroup:
         """Would this pod, scheduled onto a node with `requirements`, count?"""
         return self.selects(pod) and self.node_filter.matches_requirements(requirements)
 
-    def record(self, *domains: str) -> None:
+    def record(self, *domains: str, count: int = 1) -> None:
         for domain in domains:
-            self.domains[domain] = self.domains.get(domain, 0) + 1
+            self.domains[domain] = self.domains.get(domain, 0) + count
 
     def register(self, *domains: str) -> None:
         for domain in domains:

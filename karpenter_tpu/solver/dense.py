@@ -71,6 +71,10 @@ class DenseSolver:
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # per-catalog device arrays (caps/prices), uploaded once and reused
+        # across solves — host->device transfers over the tunnel are the
+        # dominant per-dispatch cost, so only per-batch data moves per solve
+        self._device_catalog: Dict[tuple, tuple] = {}
 
     # -- Scheduler hook ------------------------------------------------------
 
@@ -84,8 +88,14 @@ class DenseSolver:
             return pods  # in-flight node fill is host-path in round 1
         if scheduler.remaining_resources:
             return pods  # provisioner limits need the sequential invariant
-        if scheduler.topology.inverse_topologies:
-            return pods  # existing anti-affinity pods can block arbitrary pods
+        # Inverse anti-affinity from *already-placed* cluster pods (non-zero
+        # recorded domains) can block arbitrary dense placements -> host path.
+        # Inverse groups from pods of this batch start with zero counts and
+        # are handled by commit-order recording: dense pods commit first and
+        # the host loop sees their domains when placing the anti pods.
+        for inverse_group in scheduler.topology.inverse_topologies.values():
+            if any(count > 0 for count in inverse_group.domains.values()):
+                return pods
         if not scheduler.node_templates:
             return pods
         self.stats.batches += 1
@@ -275,7 +285,7 @@ class DenseSolver:
         """
         import jax.numpy as jnp
 
-        from ..ops.feasibility import bucket_type_cost
+        from ..ops.feasibility import bucket_type_cost_packed
         from .pack_counts import assign_bins, dedupe_sizes, pack_counts
 
         B = len(buckets)
@@ -304,15 +314,20 @@ class DenseSolver:
         # f32 — its choice is advisory, commit-time checks are authoritative
         caps_eff = np.maximum(problem.caps - problem.daemon_overhead[None, :], 0.0)
 
-        tstar, _, feasible = bucket_type_cost(
-            jnp.asarray(sum_req, dtype=jnp.float32),
-            jnp.asarray(max_req, dtype=jnp.float32),
-            jnp.asarray(caps_eff, dtype=jnp.float32),
-            jnp.asarray(problem.prices, dtype=jnp.float32),
-            jnp.asarray(allowed),
-        )
-        tstar = np.asarray(tstar)
-        feasible = np.asarray(feasible)
+        catalog_key = (caps_eff.tobytes(), problem.prices.tobytes())
+        device_catalog = self._device_catalog.get(catalog_key)
+        if device_catalog is None:
+            device_catalog = (
+                jnp.asarray(caps_eff, dtype=jnp.float32),
+                jnp.asarray(problem.prices, dtype=jnp.float32),
+            )
+            self._device_catalog.clear()  # one catalog at a time is enough
+            self._device_catalog[catalog_key] = device_catalog
+        caps_dev, prices_dev = device_catalog
+
+        bucket_stats = np.stack([sum_req, max_req]).astype(np.float32)  # [2, B, R]
+        packed = np.asarray(bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed)))
+        tstar, feasible = packed[0], packed[2].astype(bool)
 
         bin_of_row = np.full((problem.P,), -1, np.int64)
         bin_bucket: List[int] = []
@@ -390,24 +405,36 @@ class DenseSolver:
         zone_index = {z: i for i, z in enumerate(problem.zones)}
         ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
 
+        # bulk audit: surviving instance-type options for every bin at once
+        # (same tolerance rule as resources.fits so audits can't disagree)
+        need_all = usage + overhead[None, :]  # [num_bins, R]
+        cap_tol = caps_full + res.tolerance(caps_full)  # [T, R]
+        fit_all = np.all(need_all[:, None, :] <= cap_tol[None, :, :], axis=2)  # [num_bins, T]
+        group_of_bin = np.asarray([buckets[int(b)].group_index for b in bin_bucket], dtype=np.int64)
+        mask_all = fit_all & problem.compat[group_of_bin]
+        for bid in range(num_bins):
+            bucket = buckets[int(bin_bucket[bid])]
+            if bucket.zone is not None and bucket.zone != "__infeasible__":
+                mask_all[bid] &= problem.type_zone[:, zone_index[bucket.zone]]
+            if bucket.capacity_type is not None:
+                mask_all[bid] &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+
+        # identical dedicated bins share options lists; cache by content
+        options_cache: Dict[bytes, list] = {}
         committed = 0
         for bid in range(num_bins):
             bucket = buckets[int(bin_bucket[bid])]
             group = problem.groups[bucket.group_index]
-            need = usage[bid] + overhead
-
-            # audit: surviving instance-type options for this bin (same
-            # tolerance rule as resources.fits so audits can't disagree)
-            mask = problem.compat[bucket.group_index] & np.all(need[None, :] <= caps_full + res.tolerance(caps_full), axis=1)
-            if bucket.zone is not None and bucket.zone != "__infeasible__":
-                mask &= problem.type_zone[:, zone_index[bucket.zone]]
-            if bucket.capacity_type is not None:
-                mask &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+            mask = mask_all[bid]
             if not mask.any():
                 fallback_rows.extend(bin_rows[bid])
                 continue
 
-            options = [problem.instance_types[t] for t in np.nonzero(mask)[0]]
+            mask_key = mask.tobytes()
+            options = options_cache.get(mask_key)
+            if options is None:
+                options = [problem.instance_types[t] for t in np.nonzero(mask)[0]]
+                options_cache[mask_key] = options
             node = VirtualNode(problem.template, scheduler.topology, dict(scheduler.daemon_overhead.get(problem.template.provisioner_name, {})), options)
             reqs = node.template.requirements
             if group.requirements is not None:
@@ -428,6 +455,5 @@ class DenseSolver:
             )
             scheduler.nodes.append(node)
             committed += len(node.pods)
-            for pod in node.pods:
-                scheduler.topology.record(pod, reqs)
+            scheduler.topology.record_cohort(node.pods, reqs)
         return committed, fallback_rows

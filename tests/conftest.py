@@ -3,12 +3,19 @@
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths compile
 and execute without TPU hardware (the driver separately dry-runs the sharded
 path; real-chip benching happens via bench.py).
+
+Note: the environment may pre-register a TPU PJRT plugin at interpreter boot
+(sitecustomize) and set JAX_PLATFORMS for it, so a plain setdefault isn't
+enough — force the config after import too.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
