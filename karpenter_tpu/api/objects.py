@@ -409,6 +409,22 @@ class Namespace:
     kind = "Namespace"
 
 
+@dataclass
+class DaemonSet:
+    """A daemonset: its pod template contributes per-node overhead during
+    scheduling (provisioner.go:339-360)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec_template: Optional["Pod"] = None  # the pod template
+
+    kind = "DaemonSet"
+
+    def pod_template(self) -> "Pod":
+        if self.spec_template is None:
+            return Pod()
+        return self.spec_template
+
+
 def resource_list(**kwargs) -> Dict[str, float]:
     """Convenience builder: resource_list(cpu='100m', memory='1Gi') -> floats.
 

@@ -64,6 +64,24 @@ class InstanceType(abc.ABC):
         return f"<InstanceType {self.name()}>"
 
 
+def lookup_instance_type(cloud_provider: "CloudProvider", node: Node, provisioners: Sequence[Provisioner]) -> Optional["InstanceType"]:
+    """Resolve a node's instance type from its labels — the one shared
+    implementation used by cluster-state capacity fallback, initialization's
+    extended-resource wait, and consolidation pricing."""
+    from ..api import labels as lbl
+
+    type_name = node.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE)
+    if not type_name or cloud_provider is None:
+        return None
+    provisioner_name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+    ordered = sorted(provisioners, key=lambda p: p.name != provisioner_name)  # matching provisioner first
+    for provisioner in ordered:
+        for it in cloud_provider.get_instance_types(provisioner):
+            if it.name() == type_name:
+                return it
+    return None
+
+
 class CloudProvider(abc.ABC):
     """The provider plugin boundary (types.go:41-56)."""
 

@@ -1,0 +1,50 @@
+"""Live-tunable global configuration (pkg/config).
+
+The reference watches a `karpenter-global-settings` ConfigMap for batch
+window tuning with change-handler fan-out; here the Config object is directly
+mutable with the same change-notification contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+DEFAULT_BATCH_MAX_DURATION = 10.0
+DEFAULT_BATCH_IDLE_DURATION = 1.0
+
+
+class Config:
+    def __init__(self, batch_max_duration: float = DEFAULT_BATCH_MAX_DURATION, batch_idle_duration: float = DEFAULT_BATCH_IDLE_DURATION):
+        self._lock = threading.Lock()
+        self._batch_max_duration = batch_max_duration
+        self._batch_idle_duration = batch_idle_duration
+        self._handlers: List[Callable[["Config"], None]] = []
+
+    @property
+    def batch_max_duration(self) -> float:
+        with self._lock:
+            return self._batch_max_duration
+
+    @property
+    def batch_idle_duration(self) -> float:
+        with self._lock:
+            return self._batch_idle_duration
+
+    def on_change(self, handler: Callable[["Config"], None]) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def update(self, batch_max_duration=None, batch_idle_duration=None) -> None:
+        changed = False
+        with self._lock:
+            if batch_max_duration is not None and batch_max_duration != self._batch_max_duration:
+                self._batch_max_duration = batch_max_duration
+                changed = True
+            if batch_idle_duration is not None and batch_idle_duration != self._batch_idle_duration:
+                self._batch_idle_duration = batch_idle_duration
+                changed = True
+            handlers = list(self._handlers)
+        if changed:
+            for handler in handlers:
+                handler(self)

@@ -1,0 +1,3 @@
+from .controller import ConsolidationController, ConsolidationAction
+
+__all__ = ["ConsolidationController", "ConsolidationAction"]

@@ -1,0 +1,272 @@
+"""Consolidation controller: delete or replace underutilized nodes.
+
+Mirrors pkg/controllers/consolidation/controller.go — a polling loop gated on
+cluster-epoch change and a stabilization window; candidates are initialized,
+consolidation-enabled, non-nominated, non-annotated nodes; empty nodes are
+deleted in one action; otherwise candidates are tried in ascending disruption
+cost with a **simulated scheduling run** that excludes the node
+(SchedulerOptions(simulation_mode=True, exclude_nodes=[node])):
+
+  - all pods fit on other (existing/in-flight) nodes      -> DELETE
+  - pods need exactly one new, cheaper node               -> REPLACE
+    (price-filtered; spot->spot replacement is blocked since the spot
+     market already chose this node)
+
+This is the second consumer of the same scheduler core — and of the same TPU
+dense path — proving the packer-plugin seam the reference establishes
+(consolidation/controller.go:430-498).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from ...api import labels as lbl
+from ...api.objects import Node
+from ...cloudprovider.types import CloudProvider, NodeRequest
+from ...events import Recorder
+from ...kube.cluster import KubeCluster
+from ...scheduler import SchedulerOptions
+from ...utils import pod as podutils
+from ..state.cluster import Cluster, StateNode
+from .helpers import disruption_cost, lifetime_remaining
+from .pdblimits import PDBLimits
+
+
+class ActionType(enum.Enum):
+    DELETE = "delete"
+    DELETE_EMPTY = "delete-empty"
+    REPLACE = "replace"
+    NO_ACTION = "no-action"
+
+
+@dataclass
+class ConsolidationAction:
+    type: ActionType
+    nodes: List[Node] = field(default_factory=list)
+    replacement_name: Optional[str] = None
+    reason: str = ""
+    replacement: object = None  # the VirtualNode to launch for REPLACE
+
+
+@dataclass
+class ConsolidationMetrics:
+    evaluations: int = 0
+    nodes_terminated: int = 0
+    nodes_created: int = 0
+    actions: List[str] = field(default_factory=list)
+
+
+class ConsolidationController:
+    STABILIZATION_WINDOW = 300.0  # max settle wait (controller.go:573-580)
+    POLL_INTERVAL = 10.0
+
+    def __init__(
+        self,
+        kube: KubeCluster,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        provisioner_controller,
+        recorder: Optional[Recorder] = None,
+        clock=None,
+    ):
+        from ...utils.clock import Clock
+
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.provisioner_controller = provisioner_controller
+        self.recorder = recorder or Recorder()
+        self.clock = clock or kube.clock or Clock()
+        self.metrics = ConsolidationMetrics()
+        self._last_epoch = -1
+        self._pending_replace: Optional[ConsolidationAction] = None
+
+    # -- gating ---------------------------------------------------------------
+
+    SETTLE_SECONDS = 30.0
+
+    def should_run(self) -> bool:
+        epoch = self.cluster.consolidation_epoch()
+        if epoch == self._last_epoch and self._pending_replace is None:
+            return False
+        # stabilization: wait for the cluster to settle after any node churn
+        # (creation OR deletion) before disrupting more capacity, capped at
+        # the stabilization window (controller.go:573-580)
+        now = self.clock.now()
+        last_churn = max(self.cluster.last_node_creation_time(), self.cluster.last_node_deletion_time())
+        settle = min(self.SETTLE_SECONDS, self.STABILIZATION_WINDOW)
+        if last_churn > 0 and now - last_churn < settle:
+            return False
+        self._last_epoch = epoch
+        return True
+
+    # -- the pass --------------------------------------------------------------
+
+    def process_cluster(self) -> ConsolidationAction:
+        self.metrics.evaluations += 1
+        # finish a replacement that was waiting on readiness
+        pending = self._pending_replace
+        if pending is not None:
+            replacement = self.kube.get_node(pending.replacement_name) if pending.replacement_name else None
+            if replacement is None:
+                self._pending_replace = None  # replacement vanished; re-evaluate
+            elif replacement.ready():
+                self._pending_replace = None
+                self._terminate(pending)
+                return pending
+            else:
+                self.recorder.waiting_on_readiness(replacement)
+                return ConsolidationAction(ActionType.NO_ACTION, reason="waiting on replacement readiness")
+        candidates = self.candidate_nodes()
+        if not candidates:
+            return ConsolidationAction(ActionType.NO_ACTION, reason="no candidates")
+
+        # fast path: delete all empty candidates at once (controller.go:135-142)
+        empty = [c for c in candidates if self._is_empty(c)]
+        if empty:
+            action = ConsolidationAction(ActionType.DELETE_EMPTY, nodes=[c.node for c in empty], reason="empty nodes")
+            self.perform(action)
+            return action
+
+        pdb = PDBLimits(self.kube)
+        scored = sorted(candidates, key=lambda c: self._disruption_cost(c))
+        for candidate in scored:
+            pods = self.kube.pods_on_node(candidate.name)
+            reason = self._can_terminate(candidate, pods, pdb)
+            if reason is not None:
+                continue
+            action = self._replace_or_delete(candidate, pods)
+            if action.type != ActionType.NO_ACTION:
+                self.perform(action)
+                return action
+        return ConsolidationAction(ActionType.NO_ACTION, reason="no beneficial action")
+
+    def candidate_nodes(self) -> List[StateNode]:
+        out: List[StateNode] = []
+
+        def visit(state: StateNode) -> bool:
+            node = state.node
+            name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+            if name is None:
+                return True
+            provisioner = self.kube.get("Provisioner", name, namespace="")
+            if provisioner is None or provisioner.spec.consolidation is None or not provisioner.spec.consolidation.enabled:
+                return True
+            if node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) != "true":
+                return True
+            if node.metadata.annotations.get(lbl.DO_NOT_CONSOLIDATE_ANNOTATION) == "true":
+                return True
+            if self.cluster.is_node_nominated(node.name):
+                return True
+            if node.metadata.deletion_timestamp is not None:
+                return True
+            out.append(state)
+            return True
+
+        self.cluster.for_each_node(visit)
+        return out
+
+    def _is_empty(self, state: StateNode) -> bool:
+        return podutils.is_node_empty(self.kube.pods_on_node(state.name))
+
+    def _disruption_cost(self, state: StateNode) -> float:
+        name = state.node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
+        provisioner = self.kube.get("Provisioner", name, namespace="") if name else None
+        ttl = provisioner.spec.ttl_seconds_until_expired if provisioner else None
+        pods = self.kube.pods_on_node(state.name)
+        return disruption_cost(pods, lifetime_remaining(self.clock, state.node, ttl))
+
+    def _can_terminate(self, state: StateNode, pods, pdb: PDBLimits) -> Optional[str]:
+        reason = pdb.can_evict(pods)
+        if reason is not None:
+            return reason
+        for pod in pods:
+            if podutils.has_do_not_evict(pod):
+                return f"pod {pod.name} has do-not-evict"
+            if not podutils.is_owned(pod) and not podutils.is_owned_by_daemonset(pod):
+                return f"pod {pod.name} has no controller owner"
+        return None
+
+    # -- the simulated scheduling decision --------------------------------------
+
+    def _replace_or_delete(self, candidate: StateNode, pods) -> ConsolidationAction:
+        """Simulate scheduling the node's pods with the node gone
+        (controller.go:430-498)."""
+        reschedulable = [p for p in pods if not podutils.is_owned_by_daemonset(p) and not podutils.is_terminal(p)]
+        state_nodes = self.cluster.nodes_snapshot()
+        results = self.provisioner_controller.schedule(
+            reschedulable,
+            state_nodes,
+            opts=SchedulerOptions(simulation_mode=True, exclude_nodes=[candidate.name]),
+        )
+        if results.unschedulable:
+            return ConsolidationAction(ActionType.NO_ACTION, reason="pods would not reschedule")
+        if not results.new_nodes or all(not n.pods for n in results.new_nodes):
+            return ConsolidationAction(ActionType.DELETE, nodes=[candidate.node], reason="pods fit on other nodes")
+        populated = [n for n in results.new_nodes if n.pods]
+        if len(populated) > 1:
+            return ConsolidationAction(ActionType.NO_ACTION, reason="would need multiple replacement nodes")
+
+        replacement = populated[0]
+        current_price = self._node_price(candidate)
+        if current_price is None:
+            return ConsolidationAction(ActionType.NO_ACTION, reason="unknown node price")
+        # only consider strictly cheaper types (price filter, :475)
+        cheaper = [it for it in replacement.instance_type_options if it.price() < current_price]
+        if not cheaper:
+            return ConsolidationAction(ActionType.NO_ACTION, reason="no cheaper replacement")
+        # spot -> spot replacement is blocked: the spot market already picked
+        # this allocation and churn risks capacity (:483-487)
+        if candidate.node.metadata.labels.get(lbl.LABEL_CAPACITY_TYPE) == lbl.CAPACITY_TYPE_SPOT:
+            ct = replacement.requirements.get(lbl.LABEL_CAPACITY_TYPE)
+            if ct.has(lbl.CAPACITY_TYPE_SPOT):
+                return ConsolidationAction(ActionType.NO_ACTION, reason="spot-to-spot replacement blocked")
+        replacement.instance_type_options = cheaper
+        return ConsolidationAction(
+            ActionType.REPLACE,
+            nodes=[candidate.node],
+            reason=f"replace with cheaper node ({cheaper[0].name()})",
+            replacement=replacement,
+        )
+
+    def _node_price(self, state: StateNode) -> Optional[float]:
+        from ...cloudprovider.types import lookup_instance_type
+
+        it = lookup_instance_type(self.cloud_provider, state.node, self.kube.list_provisioners())
+        return it.price() if it is not None else None
+
+    # -- execution ----------------------------------------------------------------
+
+    def perform(self, action: ConsolidationAction) -> None:
+        if action.type == ActionType.NO_ACTION:
+            return
+        if action.type == ActionType.REPLACE:
+            replacement = action.replacement
+            node = self.cloud_provider.create(
+                NodeRequest(template=replacement.template, instance_type_options=replacement.instance_type_options)
+            )
+            self.kube.create(node)
+            action.replacement_name = node.name
+            self.metrics.nodes_created += 1
+            # nominate so emptiness/other consolidation passes don't reap the
+            # replacement before the old node's pods migrate to it
+            self.cluster.nominate_node_for_pod(node.name)
+            # wait for the replacement to go Ready before disrupting the old
+            # node (controller.go:304-352); fake/capacity-backed nodes are
+            # Ready on creation, real providers converge via node events —
+            # the action parks as pending and process_cluster finishes it
+            if not node.ready():
+                self.recorder.waiting_on_readiness(node)
+                self._pending_replace = action
+                return
+        self._terminate(action)
+
+    def _terminate(self, action: ConsolidationAction) -> None:
+        for node in action.nodes:
+            self.recorder.terminating_node(node, f"consolidation: {action.reason}")
+            self.kube.delete(node)
+            self.metrics.nodes_terminated += 1
+        self.metrics.actions.append(action.type.value)

@@ -1,0 +1,33 @@
+"""PDB limits: can a node's pods all be evicted right now?
+
+Mirrors pkg/controllers/consolidation/pdblimits.go — per-selector disruption
+budgets checked against a candidate node's pod set before attempting
+consolidation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ...api.objects import Pod
+from ...kube.cluster import KubeCluster
+
+
+class PDBLimits:
+    def __init__(self, kube: KubeCluster):
+        self.kube = kube
+        self.pdbs = kube.list("PodDisruptionBudget")
+
+    def can_evict(self, pods: Iterable[Pod]) -> Optional[str]:
+        """None if all pods are currently evictable; else a reason."""
+        needed: dict = {}
+        for pod in pods:
+            for pdb in self.pdbs:
+                if pdb.metadata.namespace != pod.namespace:
+                    continue
+                if pdb.selector is not None and pdb.selector.matches(pod.metadata.labels):
+                    key = (pdb.metadata.namespace, pdb.metadata.name)
+                    needed[key] = needed.get(key, 0) + 1
+                    if needed[key] > pdb.disruptions_allowed:
+                        return f"pdb {pdb.metadata.name} prevents pod evictions"
+        return None

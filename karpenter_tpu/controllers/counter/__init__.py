@@ -1,0 +1,3 @@
+from .controller import CounterController
+
+__all__ = ["CounterController"]

@@ -1,0 +1,3 @@
+from .controller import NodeController
+
+__all__ = ["NodeController"]

@@ -1,0 +1,25 @@
+"""Provisioning reconciler: pod-watch trigger feeding the batcher.
+
+Mirrors pkg/controllers/provisioning/controller.go:57-85 — every pod event
+for a provisionable pod pulls the batcher trigger; the orchestrator loop
+does the rest.
+"""
+
+from __future__ import annotations
+
+from ...kube.cluster import DELETED, KubeCluster, WatchEvent
+from ...utils import pod as podutils
+from .provisioner import ProvisionerController
+
+
+class ProvisioningReconciler:
+    def __init__(self, kube: KubeCluster, provisioner: ProvisionerController):
+        self.kube = kube
+        self.provisioner = provisioner
+        kube.watch("Pod", self._on_pod_event)
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        if event.type == DELETED:
+            return
+        if podutils.is_provisionable(event.obj):
+            self.provisioner.trigger()

@@ -1,0 +1,211 @@
+"""ProvisionerController: the provisioning orchestrator.
+
+Mirrors pkg/controllers/provisioning/provisioner.go — wait for a batch
+window, wait for cluster-state sync, snapshot state nodes, collect pending
+provisionable pods (validating PVCs and injecting volume topology), run the
+scheduler (TPU dense path + host oracle), and launch the resulting nodes
+through the cloud provider, nominating pods onto them.
+
+Like the reference, this controller does NOT bind pods — the cluster's
+scheduler does that once the node joins; nomination events plus the
+cluster-state nomination TTL prevent double-provisioning in the meantime.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ...api import labels as lbl
+from ...api.objects import Pod
+from ...api.provisioner import Provisioner, order_by_weight
+from ...cloudprovider.types import CloudProvider, NodeRequest
+from ...config import Config
+from ...events import Recorder
+from ...kube.cluster import Conflict, KubeCluster
+from ...scheduler import SchedulerOptions, build_scheduler
+from ...scheduler.scheduler import SchedulingResults
+from ...utils import pod as podutils
+from ...utils import resources as res
+from ..state.cluster import Cluster
+from .batcher import Batcher
+from .volumetopology import VolumeTopology
+
+
+class ProvisionerController:
+    def __init__(
+        self,
+        kube: KubeCluster,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        config: Optional[Config] = None,
+        recorder: Optional[Recorder] = None,
+        dense_solver=None,
+        wait_for_cluster_sync: bool = True,
+        clock=None,
+    ):
+        from ...utils.clock import Clock
+
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.config = config or Config()
+        self.recorder = recorder or Recorder()
+        self.dense_solver = dense_solver
+        self.wait_for_cluster_sync = wait_for_cluster_sync
+        self.clock = clock or kube.clock or Clock()
+        self.batcher = Batcher(self.config, self.clock)
+        self.volume_topology = VolumeTopology(kube)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_results: Optional[SchedulingResults] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="provisioner", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.trigger_immediate()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.batcher.wait()
+            if self._stop.is_set():
+                return
+            try:
+                self.provision()
+            except Exception:  # noqa: BLE001 - the loop is self-healing
+                import traceback
+
+                traceback.print_exc()
+
+    def trigger(self) -> None:
+        self.batcher.trigger()
+
+    def trigger_and_wait(self) -> SchedulingResults:
+        """Deterministic test path: run one full provisioning round now."""
+        return self.provision()
+
+    # -- the provisioning round ------------------------------------------------
+
+    def provision(self) -> SchedulingResults:
+        if self.wait_for_cluster_sync:
+            deadline = self.clock.now() + 10.0
+            while not self.cluster.synchronized():
+                if self.clock.now() > deadline:
+                    raise TimeoutError("cluster state failed to synchronize")
+                self.clock.sleep(0.05)
+
+        state_nodes = self.cluster.nodes_snapshot()
+        pods = self.get_pods()
+        results = self.schedule(pods, state_nodes)
+        self.launch_nodes(results)
+        self.last_results = results
+        return results
+
+    def get_pods(self) -> List[Pod]:
+        """Pending provisionable pods, PVC-validated, topology-injected.
+
+        Volume-topology injection operates on a copy: the stored pod object
+        is user state and must not accumulate injected requirements across
+        rounds (the pod stays pending if a round fails)."""
+        import copy
+
+        pods = []
+        for pod in self.kube.list_pods():
+            if not podutils.is_provisionable(pod):
+                continue
+            err = self.volume_topology.validate_persistent_volume_claims(pod)
+            if err is not None:
+                self.recorder.pod_failed_to_schedule(pod, err)
+                continue
+            if self.volume_topology.needs_injection(pod):
+                pod = copy.deepcopy(pod)
+                self.volume_topology.inject(pod)
+            pods.append(pod)
+        return pods
+
+    def schedule(self, pods: Sequence[Pod], state_nodes: Sequence[object], opts: Optional[SchedulerOptions] = None) -> SchedulingResults:
+        provisioners = [p for p in self.kube.list_provisioners()]
+        scheduler = build_scheduler(
+            provisioners,
+            self.cloud_provider,
+            pods,
+            kube=self.kube,
+            cluster=self.cluster,
+            state_nodes=state_nodes,
+            daemonset_pods=self.daemonset_pods(),
+            opts=opts,
+            recorder=self.recorder,
+            dense_solver=self.dense_solver,
+        )
+        return scheduler.solve(pods)
+
+    def daemonset_pods(self) -> List[Pod]:
+        """Pod templates of every DaemonSet, for per-template overhead."""
+        return [ds.pod_template() for ds in self.kube.list("DaemonSet")]
+
+    # -- launching ---------------------------------------------------------------
+
+    def launch_nodes(self, results: SchedulingResults) -> List[str]:
+        launched: List[str] = []
+        provisioners = {p.name: p for p in self.kube.list_provisioners()}
+        for virtual_node in results.new_nodes:
+            if not virtual_node.pods:
+                continue
+            name = self._launch(virtual_node, provisioners)
+            if name is not None:
+                launched.append(name)
+        # nominate pods onto existing nodes they were scheduled against
+        for view in results.existing_nodes:
+            if view.pods:
+                self.cluster.nominate_node_for_pod(view.node.name)
+                for pod in view.pods:
+                    self.recorder.nominate_pod(pod, view.node)
+        return launched
+
+    def _launch(self, virtual_node, provisioners: Dict[str, Provisioner]) -> Optional[str]:
+        provisioner = provisioners.get(virtual_node.provisioner_name)
+        if provisioner is not None and provisioner.spec.limits is not None:
+            usage = self._provisioner_usage(virtual_node.provisioner_name)
+            reason = provisioner.spec.limits.exceeded_by(usage)
+            if reason is not None:
+                for pod in virtual_node.pods:
+                    self.recorder.pod_failed_to_schedule(pod, f"limits exceeded: {reason}")
+                return None
+        try:
+            node = self.cloud_provider.create(
+                NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
+            )
+        except Exception as e:  # noqa: BLE001 - capacity errors self-heal next batch
+            for pod in virtual_node.pods:
+                self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
+            return None
+        try:
+            self.kube.create(node)
+        except Conflict:
+            pass  # idempotent create (provisioner.go:317-328)
+        self.recorder.launching_node(node, f"for {len(virtual_node.pods)} pod(s)")
+        self.cluster.nominate_node_for_pod(node.name)
+        for pod in virtual_node.pods:
+            self.recorder.nominate_pod(pod, node)
+        return node.name
+
+    def _provisioner_usage(self, provisioner_name: str) -> Dict[str, float]:
+        """Current provisioned capacity for the provisioner, from cluster
+        state so in-flight nodes count immediately (counter semantics)."""
+        usage: Dict[str, float] = {}
+
+        def visit(state) -> bool:
+            nonlocal usage
+            if state.node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner_name:
+                usage = res.merge(usage, state.capacity)
+            return True
+
+        self.cluster.for_each_node(visit)
+        return usage

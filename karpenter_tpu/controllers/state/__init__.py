@@ -1,0 +1,3 @@
+from .cluster import Cluster, StateNode
+
+__all__ = ["Cluster", "StateNode"]

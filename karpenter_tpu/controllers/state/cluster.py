@@ -1,0 +1,278 @@
+"""Cluster state cache: the incremental mirror every solve reads.
+
+Equivalent of pkg/controllers/state/cluster.go — nodes plus pod→node bindings
+maintained from watch events, with per-node available resources, daemonset
+accounting, host-port/volume usage, a nominated-node TTL cache (so freshly
+scheduled pods aren't double-placed before their binding lands), an
+anti-affinity pod index, a consolidation-state epoch, and the `synchronized`
+guard that blocks provisioning until the cache has caught up with the API
+server.
+
+In the dense-solver world this cache is also the source of the ClusterState
+matrices ([N, R] available, [N, K] labels) for existing-node fill and
+whole-cluster repack.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ...api import labels as lbl
+from ...api.objects import Node, Pod
+from ...cloudprovider.types import CloudProvider
+from ...kube.cluster import ADDED, DELETED, MODIFIED, KubeCluster, WatchEvent
+from ...scheduling.hostports import HostPortUsage
+from ...scheduling.volumelimits import VolumeCount, VolumeLimits, limits_from_csi_node
+from ...utils import pod as podutils
+from ...utils import resources as res
+
+
+class StateNode:
+    def __init__(self, cluster: "Cluster", node: Node):
+        self.cluster = cluster
+        self.node = node
+        self.capacity: Dict[str, float] = dict(node.status.capacity)
+        self.allocatable: Dict[str, float] = dict(node.status.allocatable)
+        self.available: Dict[str, float] = dict(self.allocatable)
+        self.daemonset_requested: Dict[str, float] = {}
+        self.daemonset_limits: Dict[str, float] = {}
+        self.pod_requests: Dict[str, Dict[str, float]] = {}  # pod key -> requests
+        self.pod_limits: Dict[str, Dict[str, float]] = {}
+        self.host_port_usage = HostPortUsage()
+        self.volume_usage = VolumeLimits(cluster.kube)
+        self.volume_limits: VolumeCount = VolumeCount()
+        self.marked_for_deletion = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def owned(self) -> bool:
+        return lbl.PROVISIONER_NAME_LABEL in self.node.metadata.labels
+
+    def initialized(self) -> bool:
+        return self.node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+
+    def pod_count(self) -> int:
+        return len(self.pod_requests)
+
+    def snapshot(self) -> "StateNode":
+        """Deep-enough copy for a scheduling pass (provisioner.go:139-143):
+        trackers the scheduler mutates are copied, the rest shared."""
+        out = StateNode.__new__(StateNode)
+        out.cluster = self.cluster
+        out.node = self.node
+        out.capacity = dict(self.capacity)
+        out.allocatable = dict(self.allocatable)
+        out.available = dict(self.available)
+        out.daemonset_requested = dict(self.daemonset_requested)
+        out.daemonset_limits = dict(self.daemonset_limits)
+        out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
+        out.pod_limits = {k: dict(v) for k, v in self.pod_limits.items()}
+        out.host_port_usage = self.host_port_usage.copy()
+        out.volume_usage = self.volume_usage.copy()
+        out.volume_limits = VolumeCount(self.volume_limits)
+        out.marked_for_deletion = self.marked_for_deletion
+        return out
+
+
+class Cluster:
+    def __init__(self, kube: KubeCluster, cloud_provider: Optional[CloudProvider] = None, clock=None, nomination_ttl: float = 20.0):
+        from ...utils.clock import Clock
+
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.clock = clock or kube.clock or Clock()
+        self.nomination_ttl = nomination_ttl
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, StateNode] = {}
+        self._bindings: Dict[str, str] = {}  # pod uid -> node name
+        self._pods: Dict[str, Pod] = {}  # pod uid -> pod (bound pods)
+        self._anti_affinity_pods: Dict[str, Pod] = {}
+        self._nominated: Dict[str, float] = {}  # node name -> expiry
+        self._consolidation_epoch = 0
+        self._last_node_deletion = 0.0
+        self._last_node_creation = 0.0
+        kube.watch("Node", self._on_node_event)
+        kube.watch("Pod", self._on_pod_event)
+
+    # -- event ingestion -----------------------------------------------------
+
+    def _on_node_event(self, event: WatchEvent) -> None:
+        node: Node = event.obj
+        with self._lock:
+            if event.type == DELETED:
+                self._nodes.pop(node.name, None)
+                self._last_node_deletion = self.clock.now()
+                self._bump_epoch()
+                return
+            self._update_node(node)
+
+    def _update_node(self, node: Node) -> None:
+        existing = self._nodes.get(node.name)
+        state = StateNode(self, node)
+        self._populate_capacity(state)
+        self._populate_volume_limits(state)
+        state.marked_for_deletion = node.metadata.deletion_timestamp is not None
+        # re-apply pod bindings we know about
+        for uid, node_name in self._bindings.items():
+            if node_name == node.name and uid in self._pods:
+                self._apply_pod(state, self._pods[uid])
+        if existing is None:
+            self._last_node_creation = self.clock.now()
+        self._nodes[node.name] = state
+        self._bump_epoch()
+
+    def _populate_capacity(self, state: StateNode) -> None:
+        """Uninitialized nodes may not report capacity yet; fall back to the
+        instance-type data (cluster.go:203-245)."""
+        if state.allocatable or self.cloud_provider is None:
+            if not state.available:
+                state.available = dict(state.allocatable)
+            return
+        from ...cloudprovider.types import lookup_instance_type
+
+        it = lookup_instance_type(self.cloud_provider, state.node, self.kube.list_provisioners())
+        if it is not None:
+            state.capacity = dict(it.resources())
+            state.allocatable = res.clamp_negative_to_zero(res.subtract(it.resources(), it.overhead()))
+            state.available = dict(state.allocatable)
+
+    def _populate_volume_limits(self, state: StateNode) -> None:
+        csi = self.kube.get_csi_node(state.name)
+        state.volume_limits = limits_from_csi_node(csi)
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        pod: Pod = event.obj
+        with self._lock:
+            if event.type == DELETED or podutils.is_terminal(pod):
+                self._remove_pod(pod)
+                return
+            self._update_pod(pod)
+
+    def _update_pod(self, pod: Pod) -> None:
+        old_node = self._bindings.get(pod.uid)
+        new_node = pod.spec.node_name or None
+        if old_node and old_node != new_node:
+            self._remove_pod(pod)
+        if new_node is None:
+            if podutils.has_required_pod_anti_affinity(pod):
+                # pending anti-affinity pods matter once bound; track pod only
+                pass
+            return
+        self._bindings[pod.uid] = new_node
+        self._pods[pod.uid] = pod
+        if podutils.has_required_pod_anti_affinity(pod):
+            self._anti_affinity_pods[pod.uid] = pod
+        state = self._nodes.get(new_node)
+        if state is not None and pod.uid not in state.pod_requests:
+            self._apply_pod(state, pod)
+        self._bump_epoch()
+
+    def _apply_pod(self, state: StateNode, pod: Pod) -> None:
+        requests = res.pod_requests(pod)
+        limits = res.pod_limits(pod)
+        state.pod_requests[pod.uid] = requests
+        state.pod_limits[pod.uid] = limits
+        state.available = res.subtract(state.available, requests)
+        if podutils.is_owned_by_daemonset(pod):
+            state.daemonset_requested = res.merge(state.daemonset_requested, requests)
+            state.daemonset_limits = res.merge(state.daemonset_limits, limits)
+        state.host_port_usage.add(pod)
+        state.volume_usage.add(pod)
+
+    def _remove_pod(self, pod: Pod) -> None:
+        node_name = self._bindings.pop(pod.uid, None)
+        self._pods.pop(pod.uid, None)
+        self._anti_affinity_pods.pop(pod.uid, None)
+        if node_name is None:
+            return
+        state = self._nodes.get(node_name)
+        if state is not None:
+            requests = state.pod_requests.pop(pod.uid, None)
+            limits = state.pod_limits.pop(pod.uid, None)
+            if requests is not None:
+                state.available = res.merge(state.available, requests)
+                if podutils.is_owned_by_daemonset(pod):
+                    state.daemonset_requested = res.subtract(state.daemonset_requested, requests)
+                    state.daemonset_limits = res.subtract(state.daemonset_limits, limits or {})
+            state.host_port_usage.delete_pod(pod.uid)
+            state.volume_usage.delete_pod(pod.uid)
+        self._bump_epoch()
+
+    # -- read interface --------------------------------------------------------
+
+    def for_each_node(self, fn: Callable[[StateNode], bool]) -> None:
+        with self._lock:
+            nodes = sorted(self._nodes.values(), key=lambda s: s.name)
+        for state in nodes:
+            if not fn(state):
+                return
+
+    def nodes_snapshot(self) -> List[StateNode]:
+        with self._lock:
+            return [state.snapshot() for state in self._nodes.values()]
+
+    def get_state_node(self, name: str) -> Optional[StateNode]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def pods_on_node(self, name: str) -> List[Pod]:
+        with self._lock:
+            return [self._pods[uid] for uid, node in self._bindings.items() if node == name and uid in self._pods]
+
+    def for_pods_with_anti_affinity(self, fn: Callable[[Pod, Optional[Node]], bool]) -> None:
+        with self._lock:
+            pods = list(self._anti_affinity_pods.values())
+        for pod in pods:
+            node = self.kube.get_node(pod.spec.node_name)
+            if not fn(pod, node):
+                return
+
+    # -- nominations ------------------------------------------------------------
+
+    def nominate_node_for_pod(self, node_name: str) -> None:
+        with self._lock:
+            self._nominated[node_name] = self.clock.now() + self.nomination_ttl
+
+    def is_node_nominated(self, node_name: str) -> bool:
+        with self._lock:
+            expiry = self._nominated.get(node_name)
+            if expiry is None:
+                return False
+            if expiry < self.clock.now():
+                del self._nominated[node_name]
+                return False
+            return True
+
+    # -- consolidation bookkeeping ----------------------------------------------
+
+    def _bump_epoch(self) -> None:
+        self._consolidation_epoch += 1
+
+    def consolidation_epoch(self) -> int:
+        with self._lock:
+            return self._consolidation_epoch
+
+    def last_node_deletion_time(self) -> float:
+        return self._last_node_deletion
+
+    def last_node_creation_time(self) -> float:
+        return self._last_node_creation
+
+    # -- consistency guard --------------------------------------------------------
+
+    def synchronized(self) -> bool:
+        """True when every node/bound pod in the API is reflected here —
+        the over-provisioning guard (cluster.go:490-510)."""
+        with self._lock:
+            known_nodes = set(self._nodes)
+            known_pods = set(self._bindings)
+        for node in self.kube.list_nodes():
+            if node.name not in known_nodes:
+                return False
+        for pod in self.kube.list_pods():
+            if pod.spec.node_name and not podutils.is_terminal(pod) and pod.uid not in known_pods:
+                return False
+        return True

@@ -1,0 +1,4 @@
+from .controller import TerminationController
+from .eviction import EvictionQueue
+
+__all__ = ["TerminationController", "EvictionQueue"]

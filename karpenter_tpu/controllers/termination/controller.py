@@ -1,0 +1,100 @@
+"""Termination controller: graceful node teardown.
+
+Mirrors pkg/controllers/termination — when a framework-owned node carries a
+deletion timestamp: cordon (terminate.go:55-68), drain by evicting pods
+through the PDB-aware queue (critical pods last, do-not-evict blocks unless
+terminal, stuck-terminating pods skipped, :122-168), then delete the cloud
+instance and strip the finalizer so the API object is garbage collected
+(:101-119).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ...api import labels as lbl
+from ...api.objects import NO_SCHEDULE, Node, Taint
+from ...cloudprovider.types import CloudProvider
+from ...events import Recorder
+from ...kube.cluster import KubeCluster
+from ...utils import pod as podutils
+from .eviction import EvictionQueue
+
+
+class TerminationController:
+    def __init__(self, kube: KubeCluster, cloud_provider: CloudProvider, recorder: Optional[Recorder] = None, clock=None):
+        from ...utils.clock import Clock
+
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder or Recorder()
+        self.clock = clock or kube.clock or Clock()
+        self.eviction_queue = EvictionQueue(kube, self.recorder)
+        self.termination_durations: List[float] = []  # metrics summary source
+
+    def reconcile_all(self) -> None:
+        for node in list(self.kube.list_nodes()):
+            if node.metadata.deletion_timestamp is not None:
+                self.reconcile(node)
+
+    def reconcile(self, node: Node) -> None:
+        if lbl.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return
+        self.cordon(node)
+        if not self.drain(node):
+            return  # pods still evicting; re-reconcile later
+        self.cloud_provider.delete(node)
+        self.kube.finalize(node)
+        if node.metadata.deletion_timestamp is not None:
+            self.termination_durations.append(self.clock.now() - node.metadata.deletion_timestamp)
+        self.recorder.terminating_node(node, "deleted node and cloud instance")
+
+    def cordon(self, node: Node) -> None:
+        if node.spec.unschedulable:
+            return
+        node.spec.unschedulable = True
+        if not any(t.key == lbl.TAINT_NODE_UNSCHEDULABLE for t in node.spec.taints):
+            node.spec.taints.append(Taint(key=lbl.TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE))
+        self.kube.update(node)
+
+    def drain(self, node: Node) -> bool:
+        """Queue evictable pods; True once the node is fully drained."""
+        pods = self.kube.pods_on_node(node.name)
+        evictable = []
+        critical = []
+        for pod in pods:
+            if podutils.is_owned_by_node(pod) or podutils.is_owned_by_daemonset(pod):
+                continue  # daemonsets/static pods don't block termination
+            if podutils.is_terminal(pod):
+                continue
+            if podutils.is_terminating(pod):
+                # already being deleted; wait, but don't re-evict
+                evictable.append(None)
+                continue
+            if podutils.has_do_not_evict(pod):
+                self.recorder.node_failed_to_drain(node, f"pod {pod.name} has do-not-evict")
+                return False
+            if self._is_critical(pod):
+                critical.append(pod)
+            else:
+                evictable.append(pod)
+        # evict regular pods first; critical (system) pods only once every
+        # regular pod is gone — including ones still terminating
+        # (terminate.go:138-159)
+        regular = [p for p in evictable if p is not None]
+        if regular:
+            self.eviction_queue.add(*regular)
+        elif critical and not evictable:
+            self.eviction_queue.add(*critical)
+        self.eviction_queue.drain_once()
+        remaining = [
+            p
+            for p in self.kube.pods_on_node(node.name)
+            if not (podutils.is_owned_by_node(p) or podutils.is_owned_by_daemonset(p) or podutils.is_terminal(p))
+        ]
+        return not remaining
+
+    @staticmethod
+    def _is_critical(pod) -> bool:
+        return pod.spec.priority_class_name in ("system-cluster-critical", "system-node-critical")

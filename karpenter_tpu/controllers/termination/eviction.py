@@ -1,0 +1,62 @@
+"""EvictionQueue: async pod eviction with PDB-aware retry.
+
+Mirrors pkg/controllers/termination/eviction.go:41-117 — evictions are
+queued, attempted through the Eviction API, and re-queued when a
+PodDisruptionBudget rejects them (the 429 path); callers poll for drain
+completion rather than blocking on individual evictions.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Optional, Set
+
+from ...api.objects import Pod
+from ...events import Recorder
+from ...kube.cluster import KubeCluster
+
+
+class EvictionQueue:
+    def __init__(self, kube: KubeCluster, recorder: Optional[Recorder] = None):
+        self.kube = kube
+        self.recorder = recorder or Recorder()
+        self._lock = threading.Lock()
+        self._queue: Deque[Pod] = deque()
+        self._queued: Set[str] = set()
+
+    def add(self, *pods: Pod) -> None:
+        with self._lock:
+            for pod in pods:
+                if pod.uid not in self._queued:
+                    self._queued.add(pod.uid)
+                    self._queue.append(pod)
+
+    def drain_once(self, budget: int = 1000) -> int:
+        """Attempt up to `budget` queued evictions; PDB-blocked pods re-queue.
+        Returns the number evicted."""
+        evicted = 0
+        for _ in range(budget):
+            with self._lock:
+                if not self._queue:
+                    break
+                pod = self._queue.popleft()
+            if self.kube.get("Pod", pod.name, pod.namespace) is None:
+                with self._lock:
+                    self._queued.discard(pod.uid)
+                continue
+            if self.kube.evict_pod(pod):
+                self.recorder.evict_pod(pod)
+                with self._lock:
+                    self._queued.discard(pod.uid)
+                evicted += 1
+            else:
+                # PDB rejected (429): back off by re-queuing at the tail
+                with self._lock:
+                    self._queue.append(pod)
+                break
+        return evicted
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)

@@ -1,0 +1,3 @@
+from .cluster import KubeCluster, WatchEvent
+
+__all__ = ["KubeCluster", "WatchEvent"]

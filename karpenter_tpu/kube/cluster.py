@@ -1,0 +1,207 @@
+"""KubeCluster: the in-memory cluster API.
+
+Stand-in for the kube-apiserver + client-go stack the reference builds on:
+a keyed object store with synchronous watch dispatch, the field lookups the
+controllers need (pods by node, persistent volumes, CSI nodes), and the small
+write verbs (bind, evict, patch-like updates). The reference's envtest trick —
+nodes are pure API objects, no kubelets, so multi-node behavior is simulated
+entirely through the API — carries over directly (SURVEY.md section 4).
+
+Watches dispatch synchronously on the mutating thread, which makes controller
+tests deterministic (the reference needs TriggerAndWait plumbing for the same
+reason).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..api.objects import CSINode, Namespace, Node, PersistentVolume, PersistentVolumeClaim, Pod, PodDisruptionBudget, StorageClass
+from ..api.provisioner import Provisioner
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    obj: object
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+class NotFound(RuntimeError):
+    pass
+
+
+def _key(obj) -> tuple:
+    return (obj.metadata.namespace, obj.metadata.name)
+
+
+class KubeCluster:
+    def __init__(self, clock=None):
+        from ..utils.clock import Clock
+
+        self.clock = clock or Clock()
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[tuple, object]] = {}
+        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
+        self._version = 0
+
+    # -- verbs ---------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        with self._lock:
+            store = self._objects.setdefault(obj.kind, {})
+            key = _key(obj)
+            if key in store:
+                raise Conflict(f"{obj.kind} {key} already exists")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self.clock.now()
+            store[key] = obj
+        self._dispatch(obj.kind, WatchEvent(ADDED, obj))
+        return obj
+
+    def update(self, obj) -> object:
+        with self._lock:
+            store = self._objects.setdefault(obj.kind, {})
+            key = _key(obj)
+            if key not in store:
+                raise NotFound(f"{obj.kind} {key} not found")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            store[key] = obj
+        self._dispatch(obj.kind, WatchEvent(MODIFIED, obj))
+        return obj
+
+    def apply(self, obj) -> object:
+        """create-or-update convenience (like server-side apply)."""
+        with self._lock:
+            store = self._objects.setdefault(obj.kind, {})
+            exists = _key(obj) in store
+        return self.update(obj) if exists else self.create(obj)
+
+    def delete(self, obj, grace: bool = True) -> None:
+        """Start (or finish) deletion. Objects with finalizers get a deletion
+        timestamp and stay until finalizers clear, like the real API."""
+        with self._lock:
+            store = self._objects.get(obj.kind, {})
+            key = _key(obj)
+            current = store.get(key)
+            if current is None:
+                return
+            if grace and current.metadata.finalizers:
+                if current.metadata.deletion_timestamp is None:
+                    current.metadata.deletion_timestamp = self.clock.now()
+                    event = WatchEvent(MODIFIED, current)
+                else:
+                    return  # already terminating
+            else:
+                del store[key]
+                event = WatchEvent(DELETED, current)
+        self._dispatch(obj.kind, event)
+
+    def finalize(self, obj) -> None:
+        """Remove all finalizers; if terminating, the object is removed."""
+        with self._lock:
+            store = self._objects.get(obj.kind, {})
+            key = _key(obj)
+            current = store.get(key)
+            if current is None:
+                return
+            current.metadata.finalizers = []
+            if current.metadata.deletion_timestamp is not None:
+                del store[key]
+                event = WatchEvent(DELETED, current)
+            else:
+                event = WatchEvent(MODIFIED, current)
+        self._dispatch(obj.kind, event)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            return self._objects.get(kind, {}).get((namespace, name))
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+        with self._lock:
+            objs = list(self._objects.get(kind, {}).values())
+        if namespace is None:
+            return objs
+        return [o for o in objs if o.metadata.namespace == namespace]
+
+    # -- watches -------------------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[WatchEvent], None], replay: bool = True) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+            existing = list(self._objects.get(kind, {}).values()) if replay else []
+        for obj in existing:
+            handler(WatchEvent(ADDED, obj))
+
+    def _dispatch(self, kind: str, event: WatchEvent) -> None:
+        for handler in list(self._watchers.get(kind, [])):
+            handler(event)
+
+    # -- typed conveniences ---------------------------------------------------
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        return self.list("Pod", namespace)
+
+    def list_nodes(self) -> List[Node]:
+        return self.list("Node")
+
+    def list_provisioners(self) -> List[Provisioner]:
+        return self.list("Provisioner")
+
+    def list_namespaces(self) -> List[Namespace]:
+        return self.list("Namespace")
+
+    def get_node(self, name: str) -> Optional[Node]:
+        if not name:
+            return None
+        return self.get("Node", name, namespace="")
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.list_pods() if p.spec.node_name == node_name]
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.list_pods() if not p.spec.node_name]
+
+    def bind_pod(self, pod: Pod, node_name: str) -> None:
+        """Bind (schedule) a pod onto a node — the kube-scheduler's verb; the
+        test environment uses it the way expectations.ExpectScheduled does."""
+        pod.spec.node_name = node_name
+        pod.status.phase = "Running"
+        pod.status.conditions = [c for c in pod.status.conditions if c.type != "PodScheduled"]
+        self.update(pod)
+
+    def evict_pod(self, pod: Pod) -> bool:
+        """Eviction API: respects PDBs; returns False (429 analog) if a
+        matching PDB has no disruptions allowed."""
+        for pdb in self.list("PodDisruptionBudget", pod.namespace):
+            if pdb.selector is not None and pdb.selector.matches(pod.metadata.labels):
+                if pdb.disruptions_allowed <= 0:
+                    return False
+                pdb.disruptions_allowed -= 1
+        self.delete(pod, grace=False)
+        return True
+
+    # volume topology lookups (scheduling/volumelimits.py protocol)
+    def get_persistent_volume_claim(self, namespace: str, name: str) -> Optional[PersistentVolumeClaim]:
+        return self.get("PersistentVolumeClaim", name, namespace)
+
+    def get_persistent_volume(self, name: str) -> Optional[PersistentVolume]:
+        return self.get("PersistentVolume", name, namespace="")
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        return self.get("StorageClass", name, namespace="")
+
+    def get_csi_node(self, node_name: str) -> Optional[CSINode]:
+        return self.get("CSINode", node_name, namespace="")

@@ -57,6 +57,17 @@ def _owned_by(pod: Pod, kind: str) -> bool:
     return any(ref.kind == kind for ref in pod.metadata.owner_references)
 
 
+def is_reschedulable(pod: Pod) -> bool:
+    """Counts toward node emptiness / needs rescheduling on disruption."""
+    return not is_owned_by_daemonset(pod) and not is_terminal(pod)
+
+
+def is_node_empty(pods) -> bool:
+    """The shared emptiness predicate used by the emptiness TTL and the
+    consolidation empty-node fast path — one definition so they agree."""
+    return not any(is_reschedulable(p) for p in pods)
+
+
 def has_do_not_evict(pod: Pod) -> bool:
     return pod.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true"
 

@@ -1,0 +1,332 @@
+"""Node lifecycle, termination, and consolidation tests.
+
+Scenario catalog from the reference's node (initialization/emptiness/
+expiration/finalizer), termination (cordon/drain/evict), and consolidation
+(delete/replace/empty/special-cases) suites.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import LabelSelector, ObjectMeta, OwnerReference, PodDisruptionBudget, Taint
+from karpenter_tpu.cloudprovider.fake import instance_type, instance_types
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.controllers.consolidation.controller import ActionType
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.termination import TerminationController
+from tests.env import Environment
+from tests.helpers import make_pod, make_pods, make_provisioner
+
+
+class DeprovEnv(Environment):
+    def __init__(self, provisioners=None, instance_types_list=None):
+        super().__init__(instance_types=instance_types_list)
+        for prov in provisioners or [make_provisioner()]:
+            self.kube.create(prov)
+        self.node_controller = NodeController(self.kube, self.cluster, self.provider, clock=self.clock)
+        self.termination_controller = TerminationController(self.kube, self.provider, self.recorder, clock=self.clock)
+        self.counter_controller = CounterController(self.kube, self.cluster)
+        self.consolidation = ConsolidationController(
+            self.kube, self.cluster, self.provider, self.provisioner_controller, self.recorder, clock=self.clock
+        )
+
+    def launch_node_with_pods(self, *pods, requests=None):
+        for pod in pods:
+            self.kube.create(pod)
+        self.provision()
+        self.bind_nominated()
+        self.node_controller.reconcile_all()
+        # let nomination TTLs lapse: emptiness/consolidation skip nominated
+        # nodes by design (cluster.go:68-86)
+        self.clock.step(self.cluster.nomination_ttl + 1)
+        return self.kube.list_nodes()
+
+
+def owned_pod(**kwargs):
+    pod = make_pod(**kwargs)
+    pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+    return pod
+
+
+class TestNodeLifecycle:
+    def test_initialization_marks_ready_node(self):
+        env = DeprovEnv()
+        nodes = env.launch_node_with_pods(make_pod(requests={"cpu": "1"}))
+        assert nodes[0].metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+
+    def test_initialization_waits_for_startup_taints(self):
+        env = DeprovEnv(provisioners=[make_provisioner(startup_taints=[Taint(key="cilium", value="x", effect="NoSchedule")])])
+        env.kube.create(make_pod(tolerations=[]))
+        env.provision()
+        env.node_controller.reconcile_all()
+        node = env.kube.list_nodes()[0]
+        assert node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) != "true"
+        # kubelet removes the startup taint once ready
+        node.spec.taints = [t for t in node.spec.taints if t.key != "cilium"]
+        env.kube.update(node)
+        env.node_controller.reconcile_all()
+        assert env.kube.list_nodes()[0].metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+
+    def test_finalizer_and_owner_ref_added(self):
+        env = DeprovEnv()
+        nodes = env.launch_node_with_pods(make_pod())
+        node = nodes[0]
+        assert lbl.TERMINATION_FINALIZER in node.metadata.finalizers
+        assert any(ref.kind == "Provisioner" for ref in node.metadata.owner_references)
+
+    def test_emptiness_ttl_deletes(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        pod = make_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.node_controller.reconcile_all()  # stamps emptiness
+        node = env.kube.list_nodes()[0]
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in node.metadata.annotations
+        env.clock.step(31)
+        env.node_controller.reconcile_all()  # deletes after TTL
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+    def test_emptiness_cleared_when_pod_arrives(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        pod = make_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in env.kube.list_nodes()[0].metadata.annotations
+        env.kube.create(make_pod(node_name=nodes[0].name, unschedulable=False))
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in env.kube.list_nodes()[0].metadata.annotations
+
+    def test_expiration_ttl_deletes(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        env.launch_node_with_pods(make_pod())
+        env.clock.step(3601)
+        env.node_controller.reconcile_all()
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+
+class TestTermination:
+    def test_cordon_drain_delete(self):
+        env = DeprovEnv()
+        pod = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(nodes[0])
+        env.termination_controller.reconcile_all()
+        # pod evicted, instance deleted, finalizer removed -> node gone
+        assert env.kube.list_nodes() == []
+        assert env.provider.delete_calls
+        assert env.recorder.of("EvictPod")
+
+    def test_do_not_evict_blocks_drain(self):
+        env = DeprovEnv()
+        pod = owned_pod(annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(nodes[0])
+        env.termination_controller.reconcile_all()
+        assert len(env.kube.list_nodes()) == 1  # still draining (blocked)
+        assert env.recorder.of("FailedDraining")
+
+    def test_pdb_blocks_then_allows(self):
+        env = DeprovEnv()
+        pod = owned_pod(labels={"app": "guarded"}, requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="guard", namespace="default"),
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            disruptions_allowed=0,
+        )
+        env.kube.create(pdb)
+        env.kube.delete(nodes[0])
+        env.termination_controller.reconcile_all()
+        assert len(env.kube.list_nodes()) == 1  # eviction 429'd
+        pdb.disruptions_allowed = 1
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+    def test_daemonset_pods_do_not_block(self):
+        env = DeprovEnv()
+        pod = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        ds_pod = make_pod(node_name=nodes[0].name, unschedulable=False)
+        ds_pod.metadata.owner_references.append(OwnerReference(kind="DaemonSet", name="ds"))
+        env.kube.create(ds_pod)
+        env.kube.delete(nodes[0])
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+
+def consolidatable_provisioner(**kwargs):
+    return make_provisioner(consolidation_enabled=True, **kwargs)
+
+
+class TestConsolidation:
+    def test_empty_nodes_deleted(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+    def test_delete_when_pods_fit_elsewhere(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()], instance_types_list=instance_types(20))
+        # node 1 sized for 2 cpu of pods but one pod later shrinks, leaving
+        # slack that can absorb node 2's small pod
+        p1, p2 = owned_pod(requests={"cpu": "2"}), owned_pod(requests={"cpu": "2"})
+        env.launch_node_with_pods(p1)
+        env.launch_node_with_pods(p2)
+        assert len(env.kube.list_nodes()) == 2
+        # p2 shrinks; it now fits node 1's slack, so node 2 can go
+        p2.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(p2)
+        action = env.consolidation.process_cluster()
+        assert action.type in (ActionType.DELETE, ActionType.REPLACE)
+
+    def test_replace_with_cheaper(self):
+        from karpenter_tpu.cloudprovider.types import Offering
+
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        env = DeprovEnv(
+            provisioners=[consolidatable_provisioner()],
+            instance_types_list=[
+                instance_type("big", cpu=16, memory="32Gi", price=10.0, offerings=od),
+                instance_type("small", cpu=2, memory="4Gi", price=1.0, offerings=od),
+            ],
+        )
+        pod = owned_pod(requests={"cpu": "8"})
+        env.launch_node_with_pods(pod)
+        # shrink the pod so a smaller node suffices
+        pod.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(pod)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.REPLACE
+        assert action.replacement_name is not None
+        # old node deleted, replacement exists
+        names = [n.name for n in env.kube.list_nodes()]
+        assert action.replacement_name in names
+
+    def test_do_not_consolidate_annotation(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        pod = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        nodes[0].metadata.annotations[lbl.DO_NOT_CONSOLIDATE_ANNOTATION] = "true"
+        env.kube.update(nodes[0])
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+
+    def test_not_enabled_no_action(self):
+        env = DeprovEnv(provisioners=[make_provisioner()])  # consolidation off
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+
+    def test_ownerless_pod_blocks(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()], instance_types_list=instance_types(20))
+        naked = make_pod(requests={"cpu": "0.5"})  # no owner references
+        env.launch_node_with_pods(naked)
+        env.launch_node_with_pods(owned_pod(requests={"cpu": "0.5"}))
+        action = env.consolidation.process_cluster()
+        # the naked-pod node must not be chosen for delete
+        if action.type != ActionType.NO_ACTION:
+            assert all(naked.name not in [p.name for p in env.kube.pods_on_node(n.name)] for n in action.nodes)
+
+    def test_pdb_blocks_consolidation(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()], instance_types_list=instance_types(20))
+        guarded = owned_pod(labels={"app": "db"}, requests={"cpu": "0.5"})
+        env.launch_node_with_pods(guarded)
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="db-pdb", namespace="default"),
+                selector=LabelSelector(match_labels={"app": "db"}),
+                disruptions_allowed=0,
+            )
+        )
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+
+    def test_spot_to_spot_blocked(self):
+        from karpenter_tpu.cloudprovider.types import Offering
+
+        spot_only = [
+            instance_type("spot-big", cpu=16, memory="32Gi", price=5.0, offerings=[Offering(capacity_type="spot", zone="test-zone-1")]),
+            instance_type("spot-small", cpu=2, memory="4Gi", price=0.5, offerings=[Offering(capacity_type="spot", zone="test-zone-1")]),
+        ]
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()], instance_types_list=spot_only)
+        pod = owned_pod(requests={"cpu": "8"})
+        env.launch_node_with_pods(pod)
+        pod.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(pod)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+
+    def test_epoch_gating(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        env.clock.step(400)
+        assert env.consolidation.should_run()
+        assert not env.consolidation.should_run()  # same epoch
+        env.kube.create(make_pod(node_name="x", unschedulable=False))  # bump epoch
+        assert env.consolidation.should_run()
+
+
+class TestCounter:
+    def test_rollup(self):
+        env = DeprovEnv()
+        env.launch_node_with_pods(make_pod(requests={"cpu": "1"}))
+        env.counter_controller.reconcile_all()
+        prov = env.kube.list_provisioners()[0]
+        assert prov.status.resources.get("cpu", 0) > 0
+
+
+class TestReplacementReadiness:
+    def test_replace_waits_for_replacement_ready(self):
+        from karpenter_tpu.cloudprovider.types import Offering
+
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        env = DeprovEnv(
+            provisioners=[consolidatable_provisioner()],
+            instance_types_list=[
+                instance_type("big", cpu=16, memory="32Gi", price=10.0, offerings=od),
+                instance_type("small", cpu=2, memory="4Gi", price=1.0, offerings=od),
+            ],
+        )
+        pod = owned_pod(requests={"cpu": "8"})
+        old_nodes = env.launch_node_with_pods(pod)
+        pod.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(pod)
+
+        # make launched nodes come up NotReady (real-provider behavior)
+        original = env.provider.create
+
+        def create_not_ready(request):
+            node = original(request)
+            node.status.conditions = []
+            return node
+
+        env.provider.create = create_not_ready
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.REPLACE
+        # old node still present; replacement parked pending readiness
+        assert old_nodes[0].name in [n.name for n in env.kube.list_nodes()]
+        replacement = env.kube.get_node(action.replacement_name)
+        assert replacement is not None
+        # replacement is nominated, so it is not an emptiness/consolidation target
+        assert env.cluster.is_node_nominated(replacement.name)
+        # next pass: still waiting
+        assert env.consolidation.process_cluster().type == ActionType.NO_ACTION
+        # replacement goes Ready -> old node finally terminates
+        from karpenter_tpu.api.objects import NodeCondition
+
+        replacement.status.conditions = [NodeCondition(type="Ready", status="True")]
+        env.kube.update(replacement)
+        done = env.consolidation.process_cluster()
+        assert done.type == ActionType.REPLACE
+        env.termination_controller.reconcile_all()
+        assert old_nodes[0].name not in [n.name for n in env.kube.list_nodes()]

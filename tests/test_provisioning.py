@@ -1,0 +1,196 @@
+"""End-to-end provisioning pipeline tests.
+
+Modeled on the reference's provisioning suite (provisioning/suite_test.go):
+pending pods trigger a batch, the scheduler computes nodes, the provider
+launches them, pods get nominated, cluster state absorbs the new capacity,
+and subsequent rounds reuse in-flight nodes.
+"""
+
+import pytest
+
+from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE, PROVISIONER_NAME_LABEL
+from karpenter_tpu.api.objects import DaemonSet, PersistentVolumeClaim, StorageClass, ObjectMeta, Volume, PersistentVolumeClaimVolumeSource
+from karpenter_tpu.cloudprovider.fake import instance_type, instance_types
+from karpenter_tpu.solver import DenseSolver
+from tests.env import Environment
+from tests.helpers import make_pod, make_pods, make_provisioner
+
+
+def env_with(provisioners=None, instance_types_list=None, dense=False):
+    env = Environment(instance_types=instance_types_list, dense_solver=DenseSolver(min_batch=1) if dense else None)
+    for prov in provisioners or [make_provisioner()]:
+        env.kube.create(prov)
+    return env
+
+
+class TestProvisioningPipeline:
+    def test_pending_pod_launches_node(self):
+        env = env_with()
+        pod = make_pod(requests={"cpu": "1"})
+        env.kube.create(pod)
+        results = env.provision()
+        assert len(results.new_nodes) == 1
+        nodes = env.kube.list_nodes()
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[PROVISIONER_NAME_LABEL] == "default"
+        assert env.provider.create_calls
+        # pod nominated onto the new node
+        assert env.recorder.of("NominatePod")
+
+    def test_no_provisioner_no_node(self):
+        env = Environment()
+        env.kube.create(make_pod())
+        results = env.provision()
+        assert env.kube.list_nodes() == []
+        assert results.unschedulable
+
+    def test_bound_pods_ignored(self):
+        env = env_with()
+        pod = make_pod(node_name="existing-node", unschedulable=False)
+        env.kube.create(pod)
+        env.provision()
+        assert env.kube.list_nodes() == []
+
+    def test_batch_packs_pods_together(self):
+        env = env_with(instance_types_list=instance_types(20))
+        for pod in make_pods(10, requests={"cpu": "1"}):
+            env.kube.create(pod)
+        env.provision()
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_second_round_uses_inflight_node(self):
+        env = env_with(instance_types_list=instance_types(20))
+        env.kube.create(make_pod(requests={"cpu": "1"}))
+        env.provision()
+        assert len(env.kube.list_nodes()) == 1
+        env.bind_nominated()
+        # a second small pod fits the in-flight node's remaining 0.9 cpu;
+        # no new node launches
+        env.kube.create(make_pod(requests={"cpu": "0.5"}))
+        env.provision()
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_nominated_node_capacity_respected_before_binding(self):
+        # nomination without binding: cluster state knows nothing was bound,
+        # but the node exists; the next round schedules against it
+        env = env_with(instance_types_list=[instance_type("small", cpu=2, memory="4Gi", pods=2)])
+        env.kube.create(make_pod(requests={"cpu": "1.5"}))
+        env.provision()
+        env.bind_nominated()
+        env.kube.create(make_pod(requests={"cpu": "1.5"}))
+        env.provision()
+        # second pod can't fit the first node (1.5+1.5+overhead > 2)
+        assert len(env.kube.list_nodes()) == 2
+
+    def test_daemonset_overhead_reserved(self):
+        env = env_with(instance_types_list=[instance_type("only", cpu=3, memory="8Gi", pods=10)])
+        ds_pod = make_pod(requests={"cpu": "1"}, unschedulable=False)
+        env.kube.create(DaemonSet(metadata=ObjectMeta(name="logging"), spec_template=ds_pod))
+        env.kube.create(make_pod(requests={"cpu": "2.5"}))
+        results = env.provision()
+        # 2.5 + 1 (daemon) + 0.1 overhead > 3 -> unschedulable
+        assert results.unschedulable
+        assert env.kube.list_nodes() == []
+
+    def test_limits_block_launch(self):
+        env = env_with(provisioners=[make_provisioner(limits={"cpu": "3"})],
+                       instance_types_list=[instance_type("big", cpu=16, memory="32Gi")])
+        env.kube.create(make_pod(requests={"cpu": "1"}))
+        env.provision()
+        # scheduling filtered types by remaining limits; 16-cpu type exceeds
+        assert env.kube.list_nodes() == []
+
+    def test_missing_pvc_blocks_pod(self):
+        env = env_with()
+        pod = make_pod(pvcs=["no-such-claim"])
+        env.kube.create(pod)
+        results = env.provision()
+        assert env.kube.list_nodes() == []
+        assert env.recorder.of("FailedScheduling")
+
+    def test_volume_topology_zone_injected(self):
+        env = env_with()
+        env.kube.create(StorageClass(metadata=ObjectMeta(name="zonal", namespace=""), provisioner="csi", zones=["test-zone-2"]))
+        env.kube.create(PersistentVolumeClaim(metadata=ObjectMeta(name="data", namespace="default"), storage_class_name="zonal"))
+        pod = make_pod(pvcs=["data"])
+        env.kube.create(pod)
+        results = env.provision()
+        node = next(n for n in results.new_nodes if n.pods)
+        assert node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-2")
+        assert not node.requirements.get(LABEL_TOPOLOGY_ZONE).has("test-zone-1")
+
+    def test_weighted_provisioner_order(self):
+        env = env_with(provisioners=[
+            make_provisioner(name="light", weight=1),
+            make_provisioner(name="heavy", weight=100),
+        ])
+        env.kube.create(make_pod())
+        env.provision()
+        node = env.kube.list_nodes()[0]
+        assert node.metadata.labels[PROVISIONER_NAME_LABEL] == "heavy"
+
+    def test_launch_failure_self_heals(self):
+        env = env_with()
+        env.provider.next_create_error = RuntimeError("insufficient capacity")
+        env.kube.create(make_pod())
+        env.provision()
+        assert env.kube.list_nodes() == []
+        assert env.recorder.of("FailedScheduling")
+        # next round succeeds (error consumed)
+        env.provision()
+        assert len(env.kube.list_nodes()) == 1
+
+    def test_dense_path_e2e(self):
+        env = env_with(instance_types_list=instance_types(30), dense=True)
+        for pod in make_pods(64, requests={"cpu": "0.5", "memory": "512Mi"}):
+            env.kube.create(pod)
+        results = env.provision()
+        assert sum(len(n.pods) for n in results.new_nodes) == 64
+        assert env.kube.list_nodes()
+        # bind and add more pods; second round fills in-flight capacity
+        env.bind_nominated()
+        env.kube.create(make_pod(requests={"cpu": "0.1"}))
+        env.provision()
+
+
+class TestClusterState:
+    def test_state_tracks_bindings(self):
+        env = env_with(instance_types_list=instance_types(20))
+        pod = make_pod(requests={"cpu": "2"})
+        env.kube.create(pod)
+        env.provision()
+        env.bind_nominated()
+        node = env.kube.list_nodes()[0]
+        state = env.cluster.get_state_node(node.name)
+        assert state is not None
+        assert state.pod_count() == 1
+        assert state.available["cpu"] < state.allocatable["cpu"]
+
+    def test_state_releases_on_pod_delete(self):
+        env = env_with(instance_types_list=instance_types(20))
+        pod = make_pod(requests={"cpu": "2"})
+        env.kube.create(pod)
+        env.provision()
+        env.bind_nominated()
+        node = env.kube.list_nodes()[0]
+        before = env.cluster.get_state_node(node.name).available["cpu"]
+        env.kube.delete(pod, grace=False)
+        after = env.cluster.get_state_node(node.name).available["cpu"]
+        assert after > before
+
+    def test_synchronized(self):
+        env = env_with()
+        assert env.cluster.synchronized()
+
+    def test_nomination_ttl_expires(self):
+        env = env_with()
+        env.cluster.nominate_node_for_pod("node-x")
+        assert env.cluster.is_node_nominated("node-x")
+        env.clock.step(60)
+        assert not env.cluster.is_node_nominated("node-x")
+
+    def test_consolidation_epoch_bumps(self):
+        env = env_with()
+        before = env.cluster.consolidation_epoch()
+        env.kube.create(make_pod(node_name="n1", unschedulable=False))
+        assert env.cluster.consolidation_epoch() > before
