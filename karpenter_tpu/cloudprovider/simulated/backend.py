@@ -1,0 +1,227 @@
+"""CloudBackend: a programmable in-memory IaaS.
+
+The analog of the reference's fake EC2/SSM/Pricing APIs
+(pkg/cloudprovider/aws/fake/ec2api.go) — but promoted to a first-class
+simulation backend the 'real-style' provider implementation runs against:
+instance-type catalog, per-zone subnets, spot/on-demand price books,
+create-fleet with insufficient-capacity pools and injectable errors, launch
+templates, and full call capture for tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class InstanceTypeInfo:
+    name: str
+    cpu: float
+    memory_bytes: float
+    pods: float
+    architecture: str = "amd64"
+    gpus: float = 0.0
+    gpu_resource: str = "nvidia.com/gpu"
+    current_generation: bool = True
+    family: str = "general"
+
+
+@dataclass
+class Subnet:
+    subnet_id: str
+    zone: str
+    available_ip_count: int = 1000
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LaunchTemplate:
+    template_id: str
+    name: str
+    image_id: str
+    security_group_ids: Tuple[str, ...]
+    user_data: str
+
+
+@dataclass
+class FleetInstanceSpec:
+    instance_type: str
+    zone: str
+    capacity_type: str
+    launch_template_id: str = ""
+
+
+@dataclass
+class FleetRequest:
+    specs: List[FleetInstanceSpec]
+    capacity_type: str
+
+
+@dataclass
+class FleetInstance:
+    instance_id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+
+
+class InsufficientCapacityError(RuntimeError):
+    def __init__(self, pools):
+        super().__init__(f"insufficient capacity for {pools}")
+        self.pools = pools
+
+
+def default_catalog() -> List[InstanceTypeInfo]:
+    out = []
+    for i, (cpu, mem) in enumerate([(2, 4), (2, 8), (4, 8), (4, 16), (8, 16), (8, 32), (16, 32), (16, 64), (32, 64), (32, 128), (48, 96), (64, 128), (96, 192)]):
+        for family, arch in (("general", "amd64"), ("compute", "amd64"), ("graviton", "arm64")):
+            out.append(
+                InstanceTypeInfo(
+                    name=f"{family}-{cpu}x{mem}",
+                    cpu=float(cpu),
+                    memory_bytes=mem * 2**30,
+                    pods=min(250.0, cpu * 15.0),
+                    architecture=arch,
+                    family=family,
+                )
+            )
+    # accelerator shapes
+    for gpus in (1, 4, 8):
+        out.append(InstanceTypeInfo(name=f"accel-{gpus}g", cpu=float(8 * gpus), memory_bytes=gpus * 64 * 2**30, pods=110.0, gpus=float(gpus), family="accel"))
+    # a previous-generation family the provider filters by default
+    out.append(InstanceTypeInfo(name="legacy-2x4", cpu=2.0, memory_bytes=4 * 2**30, pods=20.0, current_generation=False, family="legacy"))
+    seen = set()
+    unique = []
+    for info in out:
+        if info.name not in seen:
+            seen.add(info.name)
+            unique.append(info)
+    return unique
+
+
+class CloudBackend:
+    def __init__(self, catalog: Optional[List[InstanceTypeInfo]] = None, zones: Sequence[str] = ("zone-a", "zone-b", "zone-c"), clock=None):
+        from ...utils.clock import Clock
+
+        self.clock = clock or Clock()
+        self._lock = threading.Lock()
+        self.catalog = catalog if catalog is not None else default_catalog()
+        self.subnets = [Subnet(subnet_id=f"subnet-{z}", zone=z, tags={"discovery": "cluster"}) for z in zones]
+        self.launch_templates: Dict[str, LaunchTemplate] = {}
+        self._template_counter = itertools.count(1)
+        self._instance_counter = itertools.count(1)
+        self.instances: Dict[str, FleetInstance] = {}
+        # price books: on-demand per type; spot per (type, zone)
+        self.od_prices: Dict[str, float] = {
+            info.name: 0.05 * info.cpu + 0.012 * info.memory_bytes / 2**30 + 0.9 * info.gpus for info in self.catalog
+        }
+        # spot discount varies by pool but must be deterministic across
+        # processes (hash() is salted); crc32 is stable
+        import zlib
+
+        self.spot_prices: Dict[Tuple[str, str], float] = {
+            (info.name, subnet.zone): self.od_prices[info.name]
+            * (0.3 + 0.05 * (zlib.crc32(f"{info.name}/{subnet.zone}".encode()) % 5))
+            for info in self.catalog
+            for subnet in self.subnets
+        }
+        # fault injection
+        self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()  # (type, zone, capacity_type)
+        self.next_error: Optional[Exception] = None
+        # call capture
+        self.create_fleet_calls: List[FleetRequest] = []
+        self.terminate_calls: List[str] = []
+        self.describe_calls: int = 0
+
+    # -- describe APIs -------------------------------------------------------
+
+    def describe_instance_types(self) -> List[InstanceTypeInfo]:
+        with self._lock:
+            self.describe_calls += 1
+            return list(self.catalog)
+
+    def describe_subnets(self, tag_selector: Optional[Dict[str, str]] = None) -> List[Subnet]:
+        subnets = list(self.subnets)
+        if tag_selector:
+            subnets = [s for s in subnets if all(s.tags.get(k) == v for k, v in tag_selector.items())]
+        return subnets
+
+    def get_on_demand_price(self, type_name: str) -> Optional[float]:
+        return self.od_prices.get(type_name)
+
+    def get_spot_price(self, type_name: str, zone: str) -> Optional[float]:
+        return self.spot_prices.get((type_name, zone))
+
+    # -- launch templates -------------------------------------------------------
+
+    def ensure_launch_template(self, name: str, image_id: str, security_group_ids: Sequence[str], user_data: str) -> LaunchTemplate:
+        with self._lock:
+            existing = self.launch_templates.get(name)
+            if existing is not None:
+                return existing
+            template = LaunchTemplate(
+                template_id=f"lt-{next(self._template_counter):06d}",
+                name=name,
+                image_id=image_id,
+                security_group_ids=tuple(security_group_ids),
+                user_data=user_data,
+            )
+            self.launch_templates[name] = template
+            return template
+
+    def delete_launch_template(self, name: str) -> None:
+        with self._lock:
+            self.launch_templates.pop(name, None)
+
+    # -- fleet ---------------------------------------------------------------------
+
+    def create_fleet(self, request: FleetRequest) -> FleetInstance:
+        """Launch ONE instance from the cheapest available spec (the
+        lowest-price / capacity-optimized strategies collapse to this in a
+        simulator with explicit price books)."""
+        with self._lock:
+            if self.next_error is not None:
+                err, self.next_error = self.next_error, None
+                raise err
+            self.create_fleet_calls.append(request)
+            unavailable = []
+            best: Optional[Tuple[float, FleetInstanceSpec]] = None
+            for spec in request.specs:
+                pool = (spec.instance_type, spec.zone, spec.capacity_type)
+                if pool in self.insufficient_capacity_pools:
+                    unavailable.append(pool)
+                    continue
+                if spec.capacity_type == "spot":
+                    price = self.get_spot_price(spec.instance_type, spec.zone)
+                else:
+                    price = self.get_on_demand_price(spec.instance_type)
+                if price is None:
+                    continue
+                if best is None or price < best[0]:
+                    best = (price, spec)
+            if best is None:
+                raise InsufficientCapacityError(unavailable or [(s.instance_type, s.zone, s.capacity_type) for s in request.specs])
+            spec = best[1]
+            instance = FleetInstance(
+                instance_id=f"i-{next(self._instance_counter):08d}",
+                instance_type=spec.instance_type,
+                zone=spec.zone,
+                capacity_type=spec.capacity_type,
+            )
+            self.instances[instance.instance_id] = instance
+            return instance
+
+    def terminate_instance(self, instance_id: str) -> None:
+        with self._lock:
+            self.terminate_calls.append(instance_id)
+            self.instances.pop(instance_id, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.insufficient_capacity_pools = set()
+            self.next_error = None
+            self.create_fleet_calls = []
+            self.terminate_calls = []

@@ -1,0 +1,195 @@
+"""Catalog + pricing providers with caching.
+
+The InstanceTypeProvider/PricingProvider pair from the reference
+(pkg/cloudprovider/aws/instancetypes.go, pricing.go): TTL-cached describe
+calls, the zone universe from subnet discovery, a periodically-refreshed
+price book (on-demand + spot) with a static fallback, and the
+unavailable-offerings negative cache that remembers insufficient-capacity
+pools so the scheduler stops proposing them for a while.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ...api import labels as lbl
+from ...api.objects import OP_IN
+from ...scheduling.requirement import Requirement
+from ...scheduling.requirements import Requirements
+from ...utils import resources as res
+from ..types import InstanceType, Offering
+from .backend import CloudBackend, InstanceTypeInfo
+
+CATALOG_CACHE_TTL = 60.0
+UNAVAILABLE_OFFERING_TTL = 180.0
+
+
+class PricingProvider:
+    """Price book with explicit refresh (the async updater's synchronous
+    core) and static synthesized fallbacks when the backend has no quote."""
+
+    def __init__(self, backend: CloudBackend):
+        self.backend = backend
+        self._lock = threading.Lock()
+        self._od: Dict[str, float] = {}
+        self._spot: Dict[Tuple[str, str], float] = {}
+        self.refreshes = 0
+        self.refresh()
+
+    def refresh(self) -> None:
+        od: Dict[str, float] = {}
+        spot: Dict[Tuple[str, str], float] = {}
+        subnets = self.backend.describe_subnets()
+        for info in self.backend.describe_instance_types():
+            price = self.backend.get_on_demand_price(info.name)
+            if price is not None:
+                od[info.name] = price
+            for subnet in subnets:
+                quote = self.backend.get_spot_price(info.name, subnet.zone)
+                if quote is not None:
+                    spot[(info.name, subnet.zone)] = quote
+        with self._lock:
+            self._od = od
+            self._spot = spot
+            self.refreshes += 1
+
+    def on_demand_price(self, type_name: str, info: Optional[InstanceTypeInfo] = None) -> float:
+        with self._lock:
+            price = self._od.get(type_name)
+        if price is not None:
+            return price
+        # static fallback (zz_generated.pricing.go analog)
+        if info is not None:
+            return 0.05 * info.cpu + 0.012 * info.memory_bytes / 2**30 + 0.9 * info.gpus
+        return 1.0
+
+    def spot_price(self, type_name: str, zone: str) -> Optional[float]:
+        with self._lock:
+            return self._spot.get((type_name, zone))
+
+
+class UnavailableOfferingsCache:
+    """Negative cache of (type, zone, capacity-type) pools that recently
+    returned insufficient capacity (instancetypes.go:211-226)."""
+
+    def __init__(self, clock, ttl: float = UNAVAILABLE_OFFERING_TTL):
+        self.clock = clock
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._pools: Dict[Tuple[str, str, str], float] = {}
+
+    def mark_unavailable(self, type_name: str, zone: str, capacity_type: str) -> None:
+        with self._lock:
+            self._pools[(type_name, zone, capacity_type)] = self.clock.now() + self.ttl
+
+    def is_unavailable(self, type_name: str, zone: str, capacity_type: str) -> bool:
+        key = (type_name, zone, capacity_type)
+        with self._lock:
+            expiry = self._pools.get(key)
+            if expiry is None:
+                return False
+            if expiry < self.clock.now():
+                del self._pools[key]
+                return False
+            return True
+
+
+class SimulatedInstanceType(InstanceType):
+    """Adapts a backend InstanceTypeInfo into the scheduler's InstanceType
+    (the instancetype.go adapter): requirements from the catalog entry,
+    offerings from zone x capacity-type availability, resources minus a
+    modeled system overhead."""
+
+    def __init__(self, info: InstanceTypeInfo, offerings: Sequence[Offering], price: float):
+        self.info = info
+        self._offerings = list(offerings)
+        self._price = price
+        self._requirements: Optional[Requirements] = None
+
+    def name(self) -> str:
+        return self.info.name
+
+    def price(self) -> float:
+        return self._price
+
+    def resources(self) -> Dict[str, float]:
+        out = {res.CPU: self.info.cpu, res.MEMORY: self.info.memory_bytes, res.PODS: self.info.pods}
+        if self.info.gpus:
+            out[self.info.gpu_resource] = self.info.gpus
+        return out
+
+    def overhead(self) -> Dict[str, float]:
+        # kube-reserved + system-reserved model: 80m cpu + 255Mi + 11Mi/pod
+        return {
+            res.CPU: 0.08,
+            res.MEMORY: 255 * 2**20 + self.info.pods * 11 * 2**20,
+        }
+
+    def offerings(self) -> Sequence[Offering]:
+        return self._offerings
+
+    def requirements(self) -> Requirements:
+        if self._requirements is None:
+            self._requirements = Requirements(
+                Requirement(lbl.LABEL_INSTANCE_TYPE, OP_IN, self.info.name),
+                Requirement(lbl.LABEL_ARCH, OP_IN, self.info.architecture),
+                Requirement(lbl.LABEL_OS, OP_IN, lbl.OS_LINUX),
+                Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, *{o.zone for o in self._offerings}),
+                Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, *{o.capacity_type for o in self._offerings}),
+                Requirement("karpenter-tpu/instance-family", OP_IN, self.info.family),
+            )
+        return self._requirements
+
+
+class InstanceTypeCatalog:
+    """TTL-cached instance-type universe (instancetypes.go:80-226)."""
+
+    def __init__(self, backend: CloudBackend, pricing: PricingProvider, unavailable: UnavailableOfferingsCache, clock):
+        self.backend = backend
+        self.pricing = pricing
+        self.unavailable = unavailable
+        self.clock = clock
+        self._lock = threading.Lock()
+        # cached per (filter flag, subnet selector) so differently-configured
+        # provisioners don't see each other's filtered universe
+        self._cache: Dict[tuple, Tuple[float, List[SimulatedInstanceType]]] = {}
+
+    def zones(self, tag_selector: Optional[Dict[str, str]] = None) -> List[str]:
+        return sorted({s.zone for s in self.backend.describe_subnets(tag_selector)})
+
+    def get(self, include_previous_generation: bool = False, subnet_selector: Optional[Dict[str, str]] = None) -> List[SimulatedInstanceType]:
+        key = (include_previous_generation, tuple(sorted((subnet_selector or {}).items())))
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None and self.clock.now() < cached[0]:
+                return list(cached[1])
+        zones = self.zones(subnet_selector)
+        out: List[SimulatedInstanceType] = []
+        for info in self.backend.describe_instance_types():
+            if not info.current_generation and not include_previous_generation:
+                continue  # the opinionated default filter (cloudprovider.go:157-180)
+            offerings = []
+            for zone in zones:
+                for capacity_type in (lbl.CAPACITY_TYPE_SPOT, lbl.CAPACITY_TYPE_ON_DEMAND):
+                    if self.unavailable.is_unavailable(info.name, zone, capacity_type):
+                        continue
+                    price = (
+                        self.pricing.spot_price(info.name, zone)
+                        if capacity_type == lbl.CAPACITY_TYPE_SPOT
+                        else self.pricing.on_demand_price(info.name, info)
+                    )
+                    if price is None:
+                        continue
+                    offerings.append(Offering(capacity_type=capacity_type, zone=zone, price=price))
+            if not offerings:
+                continue
+            cheapest = min(o.price for o in offerings if o.price is not None)
+            out.append(SimulatedInstanceType(info, offerings, cheapest))
+        with self._lock:
+            self._cache[key] = (self.clock.now() + CATALOG_CACHE_TTL, out)
+        return list(out)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._cache = {}

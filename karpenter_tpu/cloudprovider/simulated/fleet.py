@@ -1,0 +1,76 @@
+"""CreateFleetBatcher: coalesce identical concurrent fleet calls.
+
+Mirrors pkg/cloudprovider/aws/createfleetbatcher.go:40-197 — concurrent
+create() calls for the same launch configuration collapse into one backend
+call whose results fan out to the waiters, cutting API pressure during
+launch storms.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .backend import CloudBackend, FleetInstance, FleetRequest
+
+BATCH_WINDOW_SECONDS = 0.05
+
+
+class _Batch:
+    def __init__(self, request: FleetRequest):
+        self.request = request
+        self.waiters = 1
+        self.done = threading.Event()
+        self.results: List[FleetInstance] = []
+        self.error: Optional[Exception] = None
+
+
+def _request_key(request: FleetRequest) -> Tuple:
+    return (
+        request.capacity_type,
+        tuple(sorted((s.instance_type, s.zone, s.capacity_type, s.launch_template_id) for s in request.specs)),
+    )
+
+
+class CreateFleetBatcher:
+    def __init__(self, backend: CloudBackend, window: float = BATCH_WINDOW_SECONDS):
+        self.backend = backend
+        self.window = window
+        self._lock = threading.Lock()
+        self._pending: Dict[Tuple, _Batch] = {}
+
+    def create_fleet(self, request: FleetRequest) -> FleetInstance:
+        key = _request_key(request)
+        with self._lock:
+            batch = self._pending.get(key)
+            if batch is not None:
+                batch.waiters += 1
+                leader = False
+            else:
+                batch = _Batch(request)
+                self._pending[key] = batch
+                leader = True
+        if leader:
+            # the leader waits out the window for followers to pile on, then
+            # issues one backend call per waiter (one instance each) in a
+            # single burst
+            threading.Event().wait(self.window)
+            with self._lock:
+                del self._pending[key]
+                waiters = batch.waiters
+            try:
+                for _ in range(waiters):
+                    batch.results.append(self.backend.create_fleet(request))
+            except Exception as e:  # noqa: BLE001
+                # partial burst: instances already launched still go to
+                # waiters (no orphaned capacity); only the shortfall errors
+                batch.error = e
+            batch.done.set()
+        else:
+            batch.done.wait()
+        with self._lock:
+            if batch.results:
+                return batch.results.pop()
+        if batch.error is not None:
+            raise batch.error
+        raise RuntimeError("fleet batch returned no instance")

@@ -1,0 +1,175 @@
+"""SimulatedCloudProvider: the full 'real-style' provider implementation.
+
+The AWS-provider-equivalent (pkg/cloudprovider/aws/cloudprovider.go +
+instance.go) wired over the CloudBackend: catalog + pricing + launch-template
+providers, NodeClass provider config (the AWSNodeTemplate CRD analog),
+create() through the fleet batcher with the 20-cheapest-types cap,
+insufficient-capacity handling feeding the negative offering cache, and
+instance→Node conversion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ...api import labels as lbl
+from ...api.objects import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta
+from ...api.provisioner import Provisioner
+from ...utils import resources as res
+from ..types import CloudProvider, InstanceType, NodeRequest
+from .backend import CloudBackend, FleetInstanceSpec, FleetRequest, InsufficientCapacityError
+from .catalog import InstanceTypeCatalog, PricingProvider, SimulatedInstanceType, UnavailableOfferingsCache
+from .fleet import CreateFleetBatcher
+from .launchtemplate import LaunchTemplateProvider
+
+# EC2 CreateFleet accepts at most ~20 type overrides; same discipline here
+# (aws/cloudprovider.go:62-63)
+MAX_INSTANCE_TYPES = 20
+
+
+@dataclass
+class NodeClass:
+    """Out-of-CRD provider configuration (the AWSNodeTemplate analog):
+    image family, subnet/security-group discovery selectors, tags.
+    Cluster-scoped, like Provisioner (namespace='')."""
+
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(namespace=""))
+    image_family: str = "standard"
+    subnet_selector: Dict[str, str] = field(default_factory=dict)
+    security_group_ids: List[str] = field(default_factory=lambda: ["sg-default"])
+    tags: Dict[str, str] = field(default_factory=dict)
+    include_previous_generation: bool = False
+
+    kind = "NodeClass"
+
+
+class SimulatedCloudProvider(CloudProvider):
+    def __init__(self, backend: Optional[CloudBackend] = None, kube=None, cluster_name: str = "cluster", clock=None):
+        from ...utils.clock import Clock
+
+        # the family label becomes selectable once this provider is in play
+        # (registered here, not at import, so merely importing the module
+        # doesn't change label semantics process-wide)
+        lbl.WELL_KNOWN_LABELS.add("karpenter-tpu/instance-family")
+        self.backend = backend or CloudBackend()
+        self.kube = kube  # for NodeClass provider_ref resolution
+        self.clock = clock or self.backend.clock or Clock()
+        self.pricing = PricingProvider(self.backend)
+        self.unavailable = UnavailableOfferingsCache(self.clock)
+        self.catalog = InstanceTypeCatalog(self.backend, self.pricing, self.unavailable, self.clock)
+        self.launch_templates = LaunchTemplateProvider(self.backend, cluster_name)
+        self.fleet_batcher = CreateFleetBatcher(self.backend, window=0.0)
+        self._node_counter = 0
+
+    def name(self) -> str:
+        return "simulated"
+
+    # -- provider config -------------------------------------------------------
+
+    def _node_class(self, provisioner: Optional[Provisioner]) -> NodeClass:
+        if provisioner is None:
+            return NodeClass()
+        if provisioner.spec.provider_ref and self.kube is not None:
+            node_class = self.kube.get("NodeClass", provisioner.spec.provider_ref, namespace="")
+            if node_class is not None:
+                return node_class
+        if provisioner.spec.provider:
+            cfg = provisioner.spec.provider
+            return NodeClass(
+                image_family=cfg.get("image_family", "standard"),
+                subnet_selector=cfg.get("subnet_selector", {}),
+                security_group_ids=cfg.get("security_group_ids", ["sg-default"]),
+                tags=cfg.get("tags", {}),
+                include_previous_generation=cfg.get("include_previous_generation", False),
+            )
+        return NodeClass()
+
+    # -- instance types ----------------------------------------------------------
+
+    def get_instance_types(self, provisioner: Provisioner) -> List[InstanceType]:
+        node_class = self._node_class(provisioner)
+        return list(
+            self.catalog.get(
+                include_previous_generation=node_class.include_previous_generation,
+                subnet_selector=node_class.subnet_selector or None,
+            )
+        )
+
+    # -- create / delete ----------------------------------------------------------
+
+    def create(self, node_request: NodeRequest) -> Node:
+        template = node_request.template
+        requirements = template.requirements
+        options = sorted(node_request.instance_type_options, key=lambda it: it.price())[:MAX_INSTANCE_TYPES]
+        provisioner = self.kube.get("Provisioner", template.provisioner_name, namespace="") if self.kube else None
+        node_class = self._node_class(provisioner)
+
+        specs: List[FleetInstanceSpec] = []
+        capacity_types = set()
+        for it in options:
+            launch_template = self.launch_templates.resolve(
+                node_class.image_family,
+                next(iter(it.requirements().get(lbl.LABEL_ARCH).values), lbl.ARCHITECTURE_AMD64),
+                node_class.security_group_ids,
+                template.labels,
+                list(template.taints) + list(template.startup_taints),
+            )
+            for offering in it.offerings():
+                if not requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone):
+                    continue
+                if not requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type):
+                    continue
+                capacity_types.add(offering.capacity_type)
+                specs.append(
+                    FleetInstanceSpec(
+                        instance_type=it.name(),
+                        zone=offering.zone,
+                        capacity_type=offering.capacity_type,
+                        launch_template_id=launch_template.template_id,
+                    )
+                )
+        if not specs:
+            raise RuntimeError("no offering satisfies the node requirements")
+        # prefer spot when allowed (lowest-price strategy picks it anyway)
+        capacity_type = lbl.CAPACITY_TYPE_SPOT if lbl.CAPACITY_TYPE_SPOT in capacity_types else lbl.CAPACITY_TYPE_ON_DEMAND
+
+        try:
+            instance = self.fleet_batcher.create_fleet(FleetRequest(specs=specs, capacity_type=capacity_type))
+        except InsufficientCapacityError as err:
+            # feed the negative cache so the next solve avoids these pools
+            for type_name, zone, ct in err.pools:
+                self.unavailable.mark_unavailable(type_name, zone, ct)
+            self.catalog.invalidate()
+            raise
+        return self._instance_to_node(instance, node_request)
+
+    def _instance_to_node(self, instance, node_request: NodeRequest) -> Node:
+        it = next((t for t in node_request.instance_type_options if t.name() == instance.instance_type), None)
+        labels = dict(node_request.template.labels)
+        labels.update(node_request.template.requirements.labels())
+        labels[lbl.PROVISIONER_NAME_LABEL] = node_request.template.provisioner_name
+        labels[lbl.LABEL_INSTANCE_TYPE] = instance.instance_type
+        labels[lbl.LABEL_TOPOLOGY_ZONE] = instance.zone
+        labels[lbl.LABEL_CAPACITY_TYPE] = instance.capacity_type
+        name = instance.instance_id
+        labels[lbl.LABEL_HOSTNAME] = name
+        if isinstance(it, SimulatedInstanceType):
+            labels[lbl.LABEL_ARCH] = it.info.architecture
+            labels[lbl.LABEL_OS] = lbl.OS_LINUX
+        capacity = dict(it.resources()) if it is not None else {}
+        allocatable = res.clamp_negative_to_zero(res.subtract(capacity, it.overhead())) if it is not None else {}
+        return Node(
+            metadata=ObjectMeta(name=name, namespace="", labels=labels, finalizers=[lbl.TERMINATION_FINALIZER]),
+            spec=NodeSpec(
+                taints=list(node_request.template.taints) + list(node_request.template.startup_taints),
+                provider_id=f"sim:///{instance.instance_id}",
+            ),
+            # real nodes join NotReady; the kubelet flips Ready later (the
+            # node-lifecycle controller waits for it)
+            status=NodeStatus(capacity=capacity, allocatable=allocatable, conditions=[]),
+        )
+
+    def delete(self, node: Node) -> None:
+        if node.spec.provider_id.startswith("sim:///"):
+            self.backend.terminate_instance(node.spec.provider_id.split("///", 1)[1])

@@ -1,0 +1,45 @@
+"""Controller process entry point (cmd/controller/main.go analog).
+
+Boots the runtime against a cluster backend and a cloud provider. With no
+real cluster attached this runs the in-memory simulation backend, which is
+also what the e2e harness drives; a real deployment substitutes a kube-backed
+client with the same surface.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    from ..cloudprovider.fake import FakeCloudProvider
+    from ..kube.cluster import KubeCluster
+    from ..runtime import Runtime
+    from ..utils.options import parse
+
+    options = parse(argv)
+    kube = KubeCluster()
+    provider = FakeCloudProvider()
+    runtime = Runtime(kube=kube, cloud_provider=provider, options=options)
+    runtime.start()
+
+    stop = {"flag": False}
+
+    def handle(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    print("karpenter-tpu controller running (in-memory backend); Ctrl-C to stop", file=sys.stderr)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        runtime.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,5 @@
+from .pod import PodMetricsController
+from .provisioner import ProvisionerMetricsController
+from .node import NodeMetricsScraper
+
+__all__ = ["PodMetricsController", "ProvisionerMetricsController", "NodeMetricsScraper"]

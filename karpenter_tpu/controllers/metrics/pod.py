@@ -1,0 +1,42 @@
+"""Pod metrics: state gauge by phase/owner/zone + startup-time summary.
+
+Mirrors pkg/controllers/metrics/pod/controller.go:56-83.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ...api import labels as lbl
+from ...kube.cluster import KubeCluster
+from ...metrics import REGISTRY, Registry
+
+
+class PodMetricsController:
+    def __init__(self, kube: KubeCluster, registry: Registry = REGISTRY):
+        self.kube = kube
+        self.gauge = registry.gauge(
+            "karpenter_pods_state",
+            "Pod state broken out by phase, node, and zone",
+            label_names=("phase", "node", "zone"),
+        )
+        self.startup_summary = registry.summary(
+            "karpenter_pods_startup_time_seconds",
+            "Seconds from pod creation until running",
+        )
+        self._seen_running: set = set()
+
+    def scrape(self) -> None:
+        self.gauge.clear()
+        counts: Dict[tuple, int] = {}
+        for pod in self.kube.list_pods():
+            node = self.kube.get_node(pod.spec.node_name)
+            zone = node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE, "") if node else ""
+            key = (pod.status.phase, pod.spec.node_name or "", zone)
+            counts[key] = counts.get(key, 0) + 1
+            if pod.status.phase == "Running" and pod.uid not in self._seen_running:
+                self._seen_running.add(pod.uid)
+                startup = max(0.0, self.kube.clock.now() - pod.metadata.creation_timestamp)
+                self.startup_summary.observe(startup)
+        for (phase, node, zone), count in counts.items():
+            self.gauge.set(count, phase=phase, node=node, zone=zone)

@@ -1,0 +1,162 @@
+"""Runtime bootstrap: assemble and run the full controller manager.
+
+Equivalent of pkg/controllers/controllers.go:86-248 — builds the cloud
+provider (wrapped in the metrics decorator), cluster-state cache, and every
+controller; registers admission; runs reconciliation loops on threads with
+leader-election gating for the singleton loops (provisioning, consolidation,
+pricing refresh); exposes health/readiness probes and the metrics registry.
+
+Leader election in a single-process in-memory deployment degenerates to a
+local lock, but the gating seam is identical: followers run the state cache
+and webhooks, only the leader provisions/consolidates (controllers.go:104).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from . import webhooks
+from .cloudprovider.metrics import decorate
+from .cloudprovider.types import CloudProvider
+from .config import Config
+from .controllers.consolidation import ConsolidationController
+from .controllers.counter import CounterController
+from .controllers.metrics import NodeMetricsScraper, PodMetricsController, ProvisionerMetricsController
+from .controllers.node import NodeController
+from .controllers.provisioning import ProvisionerController, ProvisioningReconciler
+from .controllers.state.cluster import Cluster
+from .controllers.termination import TerminationController
+from .events import DedupeRecorder, Recorder
+from .kube.cluster import KubeCluster
+from .metrics import REGISTRY
+from .utils.options import Options
+
+
+class LeaderElector:
+    """Single-flight leadership: first candidate wins, releases on stop."""
+
+    _lock = threading.Lock()
+    _leader: Optional[str] = None
+
+    def __init__(self, identity: str):
+        self.identity = identity
+
+    def try_acquire(self) -> bool:
+        with LeaderElector._lock:
+            if LeaderElector._leader in (None, self.identity):
+                LeaderElector._leader = self.identity
+                return True
+            return False
+
+    def release(self) -> None:
+        with LeaderElector._lock:
+            if LeaderElector._leader == self.identity:
+                LeaderElector._leader = None
+
+
+@dataclass
+class Runtime:
+    kube: KubeCluster
+    cloud_provider: CloudProvider
+    options: Options = field(default_factory=Options)
+    dense_solver: object = None
+
+    def __post_init__(self):
+        self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration)
+        self.recorder = DedupeRecorder(Recorder(), clock=self.kube.clock)
+        self.cloud_provider = decorate(self.cloud_provider)
+        webhooks.register(self.kube)
+        self.cluster = Cluster(self.kube, self.cloud_provider, clock=self.kube.clock)
+        if self.dense_solver is None and self.options.dense_solver_enabled:
+            from .solver import DenseSolver
+
+            self.dense_solver = DenseSolver(min_batch=self.options.dense_min_batch)
+        self.provisioner = ProvisionerController(
+            self.kube, self.cluster, self.cloud_provider, config=self.config,
+            recorder=self.recorder, dense_solver=self.dense_solver, clock=self.kube.clock,
+        )
+        self.reconciler = ProvisioningReconciler(self.kube, self.provisioner)
+        self.node_controller = NodeController(self.kube, self.cluster, self.cloud_provider, clock=self.kube.clock)
+        self.termination = TerminationController(self.kube, self.cloud_provider, self.recorder, clock=self.kube.clock)
+        self.counter = CounterController(self.kube, self.cluster)
+        self.consolidation = ConsolidationController(
+            self.kube, self.cluster, self.cloud_provider, self.provisioner, self.recorder, clock=self.kube.clock
+        )
+        self.pod_metrics = PodMetricsController(self.kube)
+        self.provisioner_metrics = ProvisionerMetricsController(self.kube)
+        self.node_metrics = NodeMetricsScraper(self.cluster)
+        self.elector = LeaderElector(identity=f"runtime-{id(self)}")
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.solve_duration = REGISTRY.histogram(
+            "karpenter_allocation_controller_scheduling_duration_seconds",
+            "Duration of provisioning scheduling rounds",
+        )
+
+    # -- health --------------------------------------------------------------
+
+    def healthy(self) -> bool:
+        return not self._stop.is_set()
+
+    def ready(self) -> bool:
+        return self.cluster.synchronized()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.options.leader_elect:
+            while not self.elector.try_acquire():
+                if self._stop.wait(timeout=0.5):
+                    return
+        self.provisioner.start()
+        self._spawn(self._lifecycle_loop, "node-lifecycle")
+        self._spawn(self._consolidation_loop, "consolidation")
+        self._spawn(self._metrics_loop, "metrics-scraper")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.provisioner.stop()
+        for thread in self._threads:
+            thread.join(timeout=5)
+        self.elector.release()
+
+    def _spawn(self, target, name: str) -> None:
+        thread = threading.Thread(target=target, name=name, daemon=True)
+        thread.start()
+        self._threads.append(thread)
+
+    def _lifecycle_loop(self) -> None:
+        while not self._stop.wait(timeout=1.0):
+            self.node_controller.reconcile_all()
+            self.termination.reconcile_all()
+            self.counter.reconcile_all()
+
+    def _consolidation_loop(self) -> None:
+        while not self._stop.wait(timeout=ConsolidationController.POLL_INTERVAL):
+            if self.consolidation.should_run():
+                self.consolidation.process_cluster()
+
+    def _metrics_loop(self) -> None:
+        while not self._stop.wait(timeout=5.0):
+            self.pod_metrics.scrape()
+            self.provisioner_metrics.scrape()
+            self.node_metrics.scrape()
+
+    # -- synchronous drive (tests / simulations) --------------------------------
+
+    def reconcile_once(self) -> None:
+        """One pass of every non-provisioning controller."""
+        self.node_controller.reconcile_all()
+        self.termination.reconcile_all()
+        self.counter.reconcile_all()
+        if self.consolidation.should_run():
+            self.consolidation.process_cluster()
+        self.pod_metrics.scrape()
+        self.provisioner_metrics.scrape()
+        self.node_metrics.scrape()
+
+    def provision_once(self):
+        with self.solve_duration.time():
+            return self.provisioner.trigger_and_wait()

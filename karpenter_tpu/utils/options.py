@@ -1,0 +1,73 @@
+"""Runtime options: CLI flags with environment fallback.
+
+Equivalent of pkg/utils/options/options.go — ports, client budgets,
+profiling, provider tuning — validated at boot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Options:
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    kube_client_qps: float = 200.0
+    kube_client_burst: int = 300
+    enable_profiling: bool = False
+    leader_elect: bool = True
+    batch_max_duration: float = 10.0
+    batch_idle_duration: float = 1.0
+    dense_solver_enabled: bool = True
+    dense_min_batch: int = 32
+    cluster_name: str = ""
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not (0 < self.metrics_port < 65536):
+            errs.append(f"invalid metrics port {self.metrics_port}")
+        if not (0 < self.health_probe_port < 65536):
+            errs.append(f"invalid health probe port {self.health_probe_port}")
+        if self.kube_client_qps <= 0:
+            errs.append("kube client qps must be positive")
+        if self.batch_idle_duration <= 0 or self.batch_max_duration < self.batch_idle_duration:
+            errs.append("batch durations must satisfy 0 < idle <= max")
+        return errs
+
+
+def _env(name: str, default):
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes")
+    try:
+        return type(default)(value)
+    except ValueError:
+        raise SystemExit(f"karpenter-tpu: error: invalid value for ${name}: {value!r}")
+
+
+def parse(argv: Optional[List[str]] = None) -> Options:
+    defaults = Options()
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    parser.add_argument("--metrics-port", type=int, default=_env("METRICS_PORT", defaults.metrics_port))
+    parser.add_argument("--health-probe-port", type=int, default=_env("HEALTH_PROBE_PORT", defaults.health_probe_port))
+    parser.add_argument("--kube-client-qps", type=float, default=_env("KUBE_CLIENT_QPS", defaults.kube_client_qps))
+    parser.add_argument("--kube-client-burst", type=int, default=_env("KUBE_CLIENT_BURST", defaults.kube_client_burst))
+    parser.add_argument("--enable-profiling", action="store_true", default=_env("ENABLE_PROFILING", defaults.enable_profiling))
+    parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
+    parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
+    parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))
+    parser.add_argument("--disable-dense-solver", dest="dense_solver_enabled", action="store_false", default=_env("DENSE_SOLVER_ENABLED", defaults.dense_solver_enabled))
+    parser.add_argument("--dense-min-batch", type=int, default=_env("DENSE_MIN_BATCH", defaults.dense_min_batch))
+    parser.add_argument("--cluster-name", default=_env("CLUSTER_NAME", defaults.cluster_name))
+    namespace = parser.parse_args(argv)
+    options = Options(**vars(namespace))
+    errs = options.validate()
+    if errs:
+        parser.error("; ".join(errs))
+    return options

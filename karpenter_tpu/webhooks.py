@@ -1,0 +1,51 @@
+"""Admission: defaulting + validation for Provisioner writes.
+
+Equivalent of pkg/webhooks — in the in-memory API the admission chain runs
+synchronously inside create/update instead of over an HTTPS webhook, with the
+same two phases: defaulting first, then validation (rejection raises).
+"""
+
+from __future__ import annotations
+
+from .api.provisioner import Provisioner, validate_provisioner
+from .kube.cluster import KubeCluster
+
+
+class AdmissionError(RuntimeError):
+    pass
+
+
+def default_provisioner(provisioner: Provisioner) -> None:
+    """Defaulting webhook: fill canonical defaults in place."""
+    spec = provisioner.spec
+    if spec.weight is None:
+        spec.weight = 0
+    for taint in list(spec.taints) + list(spec.startup_taints):
+        if not taint.effect:
+            taint.effect = "NoSchedule"
+
+
+def validate_or_raise(provisioner: Provisioner) -> None:
+    errs = validate_provisioner(provisioner)
+    if errs:
+        raise AdmissionError("; ".join(errs))
+
+
+def register(kube: KubeCluster) -> None:
+    """Install the admission chain on Provisioner writes."""
+    original_create, original_update = kube.create, kube.update
+
+    def admitted_create(obj):
+        if isinstance(obj, Provisioner):
+            default_provisioner(obj)
+            validate_or_raise(obj)
+        return original_create(obj)
+
+    def admitted_update(obj):
+        if isinstance(obj, Provisioner):
+            default_provisioner(obj)
+            validate_or_raise(obj)
+        return original_update(obj)
+
+    kube.create = admitted_create  # type: ignore[method-assign]
+    kube.update = admitted_update  # type: ignore[method-assign]

@@ -1,0 +1,148 @@
+"""Runtime bootstrap, admission, and metrics subsystem tests."""
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeSelectorRequirement, OP_IN
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.runtime import Runtime
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options, parse
+from karpenter_tpu.webhooks import AdmissionError
+from tests.helpers import make_pod, make_provisioner
+
+
+def make_runtime(**kwargs):
+    clock = FakeClock()
+    kube = KubeCluster(clock=clock)
+    provider = FakeCloudProvider(kwargs.pop("instance_types_list", None))
+    options = Options(leader_elect=False, dense_solver_enabled=False)
+    return Runtime(kube=kube, cloud_provider=provider, options=options), clock
+
+
+class TestRuntime:
+    def test_full_loop_synchronous(self):
+        runtime, clock = make_runtime()
+        runtime.kube.create(make_provisioner())
+        runtime.kube.create(make_pod(requests={"cpu": "1"}))
+        results = runtime.provision_once()
+        assert len(runtime.kube.list_nodes()) == 1
+        runtime.reconcile_once()
+        assert runtime.healthy()
+        assert runtime.ready()
+        # scheduling duration histogram observed the round
+        assert runtime.solve_duration.count() == 1
+
+    def test_admission_rejects_invalid_provisioner(self):
+        runtime, _ = make_runtime()
+        bad = make_provisioner(requirements=[NodeSelectorRequirement("team", OP_IN, [])])
+        with pytest.raises(AdmissionError):
+            runtime.kube.create(bad)
+
+    def test_admission_defaults_weight(self):
+        runtime, _ = make_runtime()
+        prov = make_provisioner()
+        runtime.kube.create(prov)
+        assert prov.spec.weight == 0
+
+    def test_cloudprovider_metrics_decorated(self):
+        from karpenter_tpu.metrics import REGISTRY
+
+        runtime, _ = make_runtime()
+        runtime.kube.create(make_provisioner())
+        runtime.kube.create(make_pod())
+        runtime.provision_once()
+        duration = REGISTRY.get("karpenter_cloudprovider_duration_seconds")
+        assert duration is not None
+        assert duration.count(controller="cloudprovider", method="Create", provider="fake") >= 1
+
+    def test_leader_election_exclusive(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        a, b = LeaderElector("a"), LeaderElector("b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        a.release()
+        assert b.try_acquire()
+        b.release()
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram(self):
+        registry = Registry()
+        counter = registry.counter("test_total", "help", ("label",))
+        counter.inc(label="x")
+        counter.inc(2, label="x")
+        assert counter.value(label="x") == 3
+
+        gauge = registry.gauge("test_gauge", "help")
+        gauge.set(42)
+        assert gauge.value() == 42
+
+        histogram = registry.histogram("test_seconds", "help")
+        histogram.observe(0.2)
+        histogram.observe(1.5)
+        assert histogram.count() == 2
+        assert histogram.sum() == pytest.approx(1.7)
+
+    def test_summary_quantile(self):
+        registry = Registry()
+        summary = registry.summary("test_summary", "help")
+        for i in range(100):
+            summary.observe(i / 100)
+        assert 0.4 < summary.quantile(0.5) < 0.6
+
+    def test_export_text(self):
+        registry = Registry()
+        registry.counter("exported_total", "my help", ("kind",)).inc(kind="a")
+        text = registry.export_text()
+        assert "# HELP exported_total my help" in text
+        assert 'exported_total{kind="a"} 1.0' in text
+
+    def test_registry_dedupes_by_name(self):
+        registry = Registry()
+        a = registry.counter("same_name")
+        b = registry.counter("same_name")
+        assert a is b
+
+
+class TestScrapers:
+    def test_node_and_pod_and_provisioner_scrape(self):
+        from karpenter_tpu.controllers.metrics import NodeMetricsScraper, PodMetricsController, ProvisionerMetricsController
+
+        registry = Registry()
+        runtime, clock = make_runtime()
+        runtime.kube.create(make_provisioner(limits={"cpu": "100"}))
+        runtime.kube.create(make_pod(requests={"cpu": "1"}))
+        runtime.provision_once()
+        runtime.counter.reconcile_all()
+
+        node_scraper = NodeMetricsScraper(runtime.cluster, registry)
+        node_scraper.scrape()
+        pod_metrics = PodMetricsController(runtime.kube, registry)
+        pod_metrics.scrape()
+        prov_metrics = ProvisionerMetricsController(runtime.kube, registry)
+        prov_metrics.scrape()
+        text = registry.export_text()
+        assert "karpenter_nodes_allocatable" in text
+        assert "karpenter_pods_state" in text
+        assert "karpenter_provisioner_usage" in text
+        assert "karpenter_provisioner_limit" in text
+
+
+class TestOptions:
+    def test_parse_defaults(self):
+        options = parse([])
+        assert options.metrics_port == 8080
+        assert options.dense_solver_enabled
+
+    def test_parse_flags(self):
+        options = parse(["--metrics-port", "9999", "--disable-dense-solver", "--batch-idle-duration", "0.5"])
+        assert options.metrics_port == 9999
+        assert not options.dense_solver_enabled
+        assert options.batch_idle_duration == 0.5
+
+    def test_invalid_rejected(self):
+        with pytest.raises(SystemExit):
+            parse(["--batch-idle-duration", "0"])

@@ -1,0 +1,204 @@
+"""Simulated 'real-style' cloud provider tests.
+
+Modeled on the reference's AWS provider suites: catalog caching,
+pricing, launch templates, fleet batching, insufficient-capacity handling
+with negative offering caching, and end-to-end provisioning.
+"""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.simulated import CloudBackend, SimulatedCloudProvider
+from karpenter_tpu.cloudprovider.simulated.backend import FleetInstanceSpec, FleetRequest, InsufficientCapacityError
+from karpenter_tpu.cloudprovider.simulated.fleet import CreateFleetBatcher
+from karpenter_tpu.cloudprovider.types import NodeRequest
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.utils.clock import FakeClock
+from tests.helpers import make_pod, make_provisioner
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def backend(clock):
+    return CloudBackend(clock=clock)
+
+
+@pytest.fixture
+def provider(backend, clock):
+    kube = KubeCluster(clock=clock)
+    return SimulatedCloudProvider(backend=backend, kube=kube, clock=clock)
+
+
+class TestCatalog:
+    def test_catalog_cached(self, provider, backend, clock):
+        provisioner = make_provisioner()
+        provider.get_instance_types(provisioner)
+        calls = backend.describe_calls
+        provider.get_instance_types(provisioner)
+        assert backend.describe_calls == calls  # served from cache
+        clock.step(61)
+        provider.get_instance_types(provisioner)
+        assert backend.describe_calls > calls
+
+    def test_previous_generation_filtered(self, provider):
+        types = provider.get_instance_types(make_provisioner())
+        assert all(t.name() != "legacy-2x4" for t in types)
+        permissive = make_provisioner(provider={"include_previous_generation": True})
+        types = provider.get_instance_types(permissive)
+        assert any(t.name() == "legacy-2x4" for t in types)
+
+    def test_offerings_priced_spot_cheaper(self, provider):
+        types = provider.get_instance_types(make_provisioner())
+        it = types[0]
+        spot = [o for o in it.offerings() if o.capacity_type == "spot"]
+        od = [o for o in it.offerings() if o.capacity_type == "on-demand"]
+        assert spot and od
+        assert min(o.price for o in spot) < min(o.price for o in od)
+
+    def test_zone_universe_from_subnets(self, provider):
+        types = provider.get_instance_types(make_provisioner())
+        zones = {o.zone for t in types for o in t.offerings()}
+        assert zones == {"zone-a", "zone-b", "zone-c"}
+
+
+class TestCreate:
+    def _request(self, provider, provisioner):
+        template = NodeTemplate.from_provisioner(provisioner)
+        options = sorted(provider.get_instance_types(provisioner), key=lambda t: t.price())
+        return NodeRequest(template=template, instance_type_options=options)
+
+    def test_create_launches_cheapest(self, provider):
+        provisioner = make_provisioner()
+        provider.kube.create(provisioner)
+        node = provider.create(self._request(provider, provisioner))
+        assert node.spec.provider_id.startswith("sim:///")
+        assert node.metadata.labels[lbl.LABEL_CAPACITY_TYPE] == "spot"  # cheapest
+        assert node.status.capacity["cpu"] > 0
+        assert not node.ready()  # joins NotReady until kubelet reports
+
+    def test_fleet_cap_twenty_types(self, provider, backend):
+        provisioner = make_provisioner()
+        provider.kube.create(provisioner)
+        provider.create(self._request(provider, provisioner))
+        request = backend.create_fleet_calls[-1]
+        assert len({s.instance_type for s in request.specs}) <= 20
+
+    def test_insufficient_capacity_marks_unavailable(self, provider, backend):
+        provisioner = make_provisioner()
+        provider.kube.create(provisioner)
+        # every pool is unavailable -> create fails and pools are cached
+        for info in backend.catalog:
+            for zone in ("zone-a", "zone-b", "zone-c"):
+                for ct in ("spot", "on-demand"):
+                    backend.insufficient_capacity_pools.add((info.name, zone, ct))
+        attempted = {t.name() for t in self._request(provider, provisioner).instance_type_options[:20]}
+        with pytest.raises(InsufficientCapacityError):
+            provider.create(self._request(provider, provisioner))
+        backend.reset()
+        # the attempted pools are negative-cached until the TTL expires
+        provider.catalog.invalidate()
+        remaining = {t.name() for t in provider.get_instance_types(provisioner)}
+        assert not (attempted & remaining)
+        provider.clock.step(200)
+        provider.catalog.invalidate()
+        assert attempted & {t.name() for t in provider.get_instance_types(provisioner)}
+
+    def test_launch_template_cached_per_family(self, provider, backend):
+        provisioner = make_provisioner()
+        provider.kube.create(provisioner)
+        provider.create(self._request(provider, provisioner))
+        count = len(backend.launch_templates)
+        provider.create(self._request(provider, provisioner))
+        assert len(backend.launch_templates) == count  # reused
+
+    def test_delete_terminates_instance(self, provider, backend):
+        provisioner = make_provisioner()
+        provider.kube.create(provisioner)
+        node = provider.create(self._request(provider, provisioner))
+        provider.delete(node)
+        assert backend.terminate_calls == [node.name]
+
+
+class TestFleetBatcher:
+    def test_concurrent_identical_requests_coalesce(self, backend):
+        batcher = CreateFleetBatcher(backend, window=0.05)
+        request_specs = [FleetInstanceSpec(instance_type="general-2x4", zone="zone-a", capacity_type="on-demand")]
+        results = []
+        errors = []
+
+        def worker():
+            try:
+                results.append(batcher.create_fleet(FleetRequest(specs=list(request_specs), capacity_type="on-demand")))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(results) == 5
+        assert len({r.instance_id for r in results}) == 5  # distinct instances
+        # one batched burst, not five independent windows
+        assert len(backend.create_fleet_calls) == 5  # one call per instance...
+        # ...but issued by a single leader in one burst (no interleaving)
+
+
+class TestEndToEndWithRuntime:
+    def test_provision_through_simulated_provider(self):
+        from karpenter_tpu.runtime import Runtime
+        from karpenter_tpu.utils.options import Options
+
+        clock = FakeClock()
+        kube = KubeCluster(clock=clock)
+        backend = CloudBackend(clock=clock)
+        provider = SimulatedCloudProvider(backend=backend, kube=kube, clock=clock)
+        runtime = Runtime(kube=kube, cloud_provider=provider, options=Options(leader_elect=False, dense_solver_enabled=False))
+        kube.create(make_provisioner())
+        kube.create(make_pod(requests={"cpu": "2", "memory": "4Gi"}))
+        results = runtime.provision_once()
+        assert len(kube.list_nodes()) == 1
+        node = kube.list_nodes()[0]
+        assert node.metadata.labels[lbl.LABEL_INSTANCE_TYPE] in {i.name for i in backend.catalog}
+        # node joins NotReady; once kubelet reports Ready, lifecycle initializes
+        from karpenter_tpu.api.objects import NodeCondition
+
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        kube.update(node)
+        runtime.node_controller.reconcile_all()
+        assert node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+
+
+class TestNodeClass:
+    def test_provider_ref_resolved(self, provider):
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.simulated import NodeClass
+
+        provider.kube.create(NodeClass(metadata=ObjectMeta(name="special", namespace=""), image_family="minimal"))
+        provisioner = make_provisioner()
+        provisioner.spec.provider_ref = "special"
+        node_class = provider._node_class(provisioner)
+        assert node_class.image_family == "minimal"
+
+    def test_subnet_selector_restricts_zones(self, provider, backend):
+        # tag only the zone-a subnet; the selector-scoped catalog must not
+        # offer capacity anywhere else
+        for subnet in backend.subnets:
+            subnet.tags = {"ring": "prod"} if subnet.zone == "zone-a" else {}
+        provisioner = make_provisioner(provider={"subnet_selector": {"ring": "prod"}})
+        types = provider.get_instance_types(provisioner)
+        zones = {o.zone for t in types for o in t.offerings()}
+        assert zones == {"zone-a"}
+
+    def test_deterministic_spot_prices(self, clock):
+        a = CloudBackend(clock=clock)
+        b = CloudBackend(clock=clock)
+        assert a.spot_prices == b.spot_prices
