@@ -27,7 +27,7 @@ import numpy as np
 PODS = 10_000
 TYPES = 500
 BASELINE_PODS_PER_SEC = 100.0
-TRIALS = 3
+TRIALS = 5  # median over 5: the tunnel's dispatch latency is jittery
 
 
 def build_workload(count: int, seed: int = 42):

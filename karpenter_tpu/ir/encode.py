@@ -111,6 +111,18 @@ def _selector_signature(selector) -> tuple:
 def constraint_signature(pod: Pod) -> tuple:
     """Everything that affects where a pod may go (and how it groups)."""
     spec = pod.spec
+    # fast path: unconstrained pods (the common deployment shape) — avoid
+    # walking container ports when no constraint machinery is present
+    if (
+        spec.affinity is None
+        and not spec.topology_spread_constraints
+        and not spec.node_selector
+        and not spec.tolerations
+        and not spec.volumes
+        and not spec.init_containers
+        and all(not p.host_port for c in spec.containers for p in c.ports)
+    ):
+        return (pod.namespace, tuple(sorted(pod.metadata.labels.items())), (), (), (), (), (), False)
     affinity_sig: tuple = ()
     if spec.affinity is not None:
         a = spec.affinity
@@ -324,11 +336,24 @@ def encode_problem(
     request_rows: List[np.ndarray] = []
 
     for pod in pods:
-        req_vec = resource_vector(res.pod_requests(pod))
+        # per-pod encode cache: pods are immutable during scheduling
+        # (relaxation returns fresh copies — preferences.py), so the signature
+        # and request vector can live on the object across solves. This is
+        # the incremental device-state idea from SURVEY.md §7: pending pods
+        # that survive a batch re-encode for free on the next solve.
+        cached = getattr(pod, "_encode_cache", None)
+        if cached is not None:
+            sig, req_vec = cached
+        else:
+            req_vec = resource_vector(res.pod_requests(pod))
+            sig = constraint_signature(pod) if req_vec is not None else None
+            try:
+                pod._encode_cache = (sig, req_vec)
+            except AttributeError:
+                pass  # slotted/frozen pod objects simply skip the cache
         if req_vec is None:
             host_pods.append(pod)
             continue
-        sig = constraint_signature(pod)
         group = group_by_sig.get(sig)
         if group is None:
             kind, key, max_skew, sel_sig = classify_group(pod)

@@ -32,6 +32,21 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1) -> Mes
     """
     devices = jax.devices()
     n = n_devices or len(devices)
+    if len(devices) < n:
+        # The default backend (e.g. a single tunneled TPU chip) may have fewer
+        # devices than requested while the host CPU backend carries the forced
+        # virtual-device count (--xla_force_host_platform_device_count).
+        try:
+            cpu_devices = jax.devices("cpu")
+        except RuntimeError:
+            cpu_devices = []
+        if len(cpu_devices) >= n:
+            devices = cpu_devices
+        else:
+            raise ValueError(
+                f"need {n} devices; have {len(devices)} on the default backend "
+                f"and {len(cpu_devices)} on cpu"
+            )
     devices = np.asarray(devices[:n])
     if n % types_parallel != 0:
         raise ValueError(f"{n} devices not divisible by types_parallel={types_parallel}")

@@ -47,6 +47,43 @@ class VirtualNode:
         self.requests: Dict[str, float] = dict(daemon_resources or {})
         self.host_port_usage = HostPortUsage()
 
+    @classmethod
+    def open_prepared(
+        cls,
+        template: NodeTemplate,
+        requirements: Requirements,
+        topology: Topology,
+        daemon_resources: Dict[str, float],
+        instance_types: Sequence[InstanceType],
+    ) -> "VirtualNode":
+        """Fast constructor for the dense commit path (solver/dense.py):
+        the caller supplies an already-validated Requirements set, so the
+        template is rebuilt around it instead of deep-copied. Immutable
+        template fields (labels, taints, kubelet config) are shared by
+        reference — nothing mutates them after construction; `add` replaces
+        `template.requirements` wholesale rather than editing in place."""
+        node = cls.__new__(cls)
+        hostname = f"hostname-placeholder-{next(_hostname_counter):04d}"
+        topology.register(lbl.LABEL_HOSTNAME, hostname)
+        node._hostname = hostname
+        node.template = NodeTemplate(
+            provisioner_name=template.provisioner_name,
+            provider=template.provider,
+            provider_ref=template.provider_ref,
+            labels=template.labels,
+            taints=template.taints,
+            startup_taints=template.startup_taints,
+            requirements=requirements,
+            kubelet_configuration=template.kubelet_configuration,
+        )
+        requirements.add(Requirement(lbl.LABEL_HOSTNAME, OP_IN, hostname))
+        node.topology = topology
+        node.instance_type_options = list(instance_types)
+        node.pods = []
+        node.requests = dict(daemon_resources or {})
+        node.host_port_usage = HostPortUsage()
+        return node
+
     @property
     def requirements(self) -> Requirements:
         return self.template.requirements

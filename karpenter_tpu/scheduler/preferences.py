@@ -34,6 +34,10 @@ class Preferences:
         import copy
 
         candidate = copy.deepcopy(pod)
+        # the dense encoder caches (signature, requests) on the pod object;
+        # deepcopy would carry the pre-relaxation signature onto the relaxed
+        # copy, so drop it (ir/encode.py re-encodes on the next solve)
+        candidate.__dict__.pop("_encode_cache", None)
         relaxations = [
             self._remove_required_node_affinity_term,
             self._remove_preferred_pod_affinity_term,

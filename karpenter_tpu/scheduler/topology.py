@@ -29,6 +29,10 @@ class Topology:
         self.domains: Dict[str, Set[str]] = {k: set(v) for k, v in (domains or {}).items()}
         self.topologies: Dict[tuple, TopologyGroup] = {}
         self.inverse_topologies: Dict[tuple, TopologyGroup] = {}
+        # topology-key → groups index so register/unregister (called per new
+        # virtual node for the placeholder hostname) touch only the groups
+        # keyed on that label instead of scanning every group
+        self._groups_by_key: Dict[str, List[TopologyGroup]] = {}
         pods = list(pods)  # may be a generator; we iterate twice
         # the batch being scheduled must not count toward its own topologies
         self.excluded_pods: Set[str] = {p.uid for p in pods}
@@ -54,6 +58,7 @@ class Topology:
             if existing is None:
                 self._count_domains(group)
                 self.topologies[key] = group
+                self._groups_by_key.setdefault(group.key, []).append(group)
                 existing = group
             existing.add_owner(pod.uid)
 
@@ -141,6 +146,7 @@ class Topology:
             existing = self.inverse_topologies.get(key)
             if existing is None:
                 self.inverse_topologies[key] = group
+                self._groups_by_key.setdefault(group.key, []).append(group)
                 existing = group
             if node_labels and group.key in node_labels:
                 existing.record(node_labels[group.key])
@@ -191,44 +197,70 @@ class Topology:
         """Commit domain counts after a successful placement."""
         self.record_cohort([pod], requirements)
 
-    def record_cohort(self, pods: Sequence[Pod], requirements: Requirements) -> None:
+    def matching_cohort_groups(self, representative: Pod, requirements: Requirements) -> List[TopologyGroup]:
+        """Groups that count a cohort represented by this pod under these
+        requirements. Cacheable by the caller: cohorts from one dense bucket
+        share namespace, labels, and requirements up to the per-bin
+        placeholder hostname (solver/dense.py)."""
+        return [g for g in self.topologies.values() if g.counts(representative, requirements)]
+
+    def inverse_owner_index(self) -> Dict[str, List[TopologyGroup]]:
+        """pod uid → inverse anti-affinity groups owning it; build once per
+        commit sweep instead of scanning all inverse groups per pod."""
+        index: Dict[str, List[TopologyGroup]] = {}
+        for group in self.inverse_topologies.values():
+            for uid in group.owners:
+                index.setdefault(uid, []).append(group)
+        return index
+
+    def record_cohort(
+        self,
+        pods: Sequence[Pod],
+        requirements: Requirements,
+        matching: Optional[List[TopologyGroup]] = None,
+        inverse_index: Optional[Dict[str, List[TopologyGroup]]] = None,
+    ) -> None:
         """Commit domain counts for a cohort of pods placed together with
         identical requirements (one dense bin). Group membership checks run
         once per cohort instead of per pod — cohort pods share namespace and
-        labels by construction (ir/encode.py groups by signature)."""
+        labels by construction (ir/encode.py groups by signature). Callers
+        may pass precomputed `matching` (matching_cohort_groups) and
+        `inverse_index` (inverse_owner_index) to amortize the scans across
+        many cohorts; the recording rules live only here."""
         if not pods:
             return
-        representative = pods[0]
         n = len(pods)
-        for group in self.topologies.values():
-            if group.counts(representative, requirements):
-                domains = requirements.get(group.key)
-                if group.type == TopologyType.POD_ANTI_AFFINITY:
-                    # block out every domain the pods *could* land in
-                    group.record(*domains.values, count=n)
-                elif len(domains) == 1 and not domains.complement:
-                    group.record(next(iter(domains.values)), count=n)
-        for group in self.inverse_topologies.values():
+        if matching is None:
+            matching = self.matching_cohort_groups(pods[0], requirements)
+        for group in matching:
+            domains = requirements.get(group.key)
+            if group.type == TopologyType.POD_ANTI_AFFINITY:
+                # block out every domain the pods *could* land in
+                group.record(*domains.values, count=n)
+            elif len(domains) == 1 and not domains.complement:
+                group.record(next(iter(domains.values)), count=n)
+        if inverse_index is None:
+            for group in self.inverse_topologies.values():
+                for pod in pods:
+                    if group.is_owned_by(pod.uid):
+                        group.record(*requirements.get(group.key).values)
+        else:
             for pod in pods:
-                if group.is_owned_by(pod.uid):
+                for group in inverse_index.get(pod.uid, ()):
                     group.record(*requirements.get(group.key).values)
 
     def register(self, topology_key: str, domain: str) -> None:
         """Make a new domain (e.g. a fresh hostname) visible to all groups."""
         self.domains.setdefault(topology_key, set()).add(domain)
-        for group in self.topologies.values():
-            if group.key == topology_key:
-                group.register(domain)
-        for group in self.inverse_topologies.values():
-            if group.key == topology_key:
-                group.register(domain)
+        for group in self._groups_by_key.get(topology_key, ()):
+            group.register(domain)
 
     def unregister(self, topology_key: str, domain: str) -> None:
         """Retract a domain that was registered but never used (zero counts
         everywhere) — the cleanup path for discarded probe nodes."""
         self.domains.get(topology_key, set()).discard(domain)
-        for group in list(self.topologies.values()) + list(self.inverse_topologies.values()):
-            if group.key == topology_key and group.domains.get(domain) == 0:
+        for group in self._groups_by_key.get(topology_key, ()):
+            if group.domains.get(domain) == 0:
                 del group.domains[domain]
 
     def _matching_topologies(self, pod: Pod, requirements: Requirements) -> List[TopologyGroup]:

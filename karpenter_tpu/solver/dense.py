@@ -37,9 +37,30 @@ from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
 from ..ir.encode import DenseProblem, GroupKind, encode_problem
 from ..scheduling.requirement import Requirement
+from ..scheduling.requirements import Requirements
 from ..utils import resources as res
 
 _PAD = 128  # pad the pod axis to multiples of this for compile caching
+
+
+def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):
+    """Host preview of ops/feasibility.py:bucket_type_cost — same formula,
+    numpy float32 — used to speculate while the device round trip is in
+    flight. Disagreements (f32 rounding ties) are reconciled by repacking
+    against the device's authoritative answer."""
+    eps = np.float32(1e-9)
+    sum_req, max_req = bucket_stats[0], bucket_stats[1]
+    safe_caps = np.maximum(caps, eps)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = sum_req[:, None, :] / safe_caps[None, :, :]
+    impossible = (caps[None, :, :] <= eps) & (sum_req[:, None, :] > eps)
+    frac = np.max(np.where(impossible, np.inf, ratio), axis=-1)
+    bins = np.ceil(np.maximum(frac, eps))
+    pod_fits = np.all(max_req[:, None, :] <= caps[None, :, :] + np.float32(1e-6), axis=-1)
+    ok = allowed & pod_fits & np.isfinite(frac)
+    key = frac * prices[None, :] + bins * np.float32(1e-4) + prices[None, :] * np.float32(1e-7)
+    key = np.where(ok, key, np.inf)
+    return np.argmin(key, axis=1).astype(np.int32), ok.any(axis=1)
 
 
 @dataclass
@@ -281,34 +302,42 @@ class DenseSolver:
         """Bucket→type choice on device; packing via counts (see
         pack_counts.py for why the per-pod scan is the wrong shape for TPU).
 
+        The device dispatch is asynchronous and its round trip over the TPU
+        tunnel is pure latency (~70 ms), so the host *speculates*: it previews
+        the same argmin formula in numpy float32 and packs every bucket while
+        the device result is in flight. When the result lands it is
+        authoritative — any bucket where the device disagrees with the
+        preview is repacked against the device's choice. On directly-attached
+        TPU (us-scale dispatch) the speculation is simply always-confirmed
+        work that overlapped nothing.
+
         Returns per-pod row→bin assignment plus per-bin metadata.
         """
         import jax.numpy as jnp
 
         from ..ops.feasibility import bucket_type_cost_packed
-        from .pack_counts import assign_bins, dedupe_sizes, pack_counts
 
         B = len(buckets)
         zone_index = {z: i for i, z in enumerate(problem.zones)}
         ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
 
-        # bucket aggregates (numpy, bucket-scale)
+        # bucket aggregates (numpy, bucket-scale); bucket_extra is the
+        # zone/capacity-type offering mask shared by the device's `allowed`
+        # input and the commit-time audit (one definition, can't diverge)
         sum_req = np.zeros((B, problem.requests.shape[1]), np.float64)
         max_req = np.zeros_like(sum_req)
+        bucket_extra = np.ones((B, problem.T), dtype=bool)
         allowed = np.zeros((B, problem.T), dtype=bool)
         for b, bucket in enumerate(buckets):
             rows = bucket.pod_rows
             sum_req[b] = problem.requests[rows].sum(axis=0)
             max_req[b] = problem.requests[rows].max(axis=0)
-            mask = problem.compat[bucket.group_index].copy()
-            if bucket.zone == "__infeasible__":
-                mask[:] = False
-            else:
-                if bucket.zone is not None:
-                    mask &= problem.type_zone[:, zone_index[bucket.zone]]
-                if bucket.capacity_type is not None:
-                    mask &= problem.type_ct[:, ct_index[bucket.capacity_type]]
-            allowed[b] = mask
+            if bucket.zone is not None and bucket.zone != "__infeasible__":
+                bucket_extra[b] &= problem.type_zone[:, zone_index[bucket.zone]]
+            if bucket.capacity_type is not None:
+                bucket_extra[b] &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+            if bucket.zone != "__infeasible__":
+                allowed[b] = problem.compat[bucket.group_index] & bucket_extra[b]
 
         # host math stays float64 (exact vs resources.fits); the device sees
         # f32 — its choice is advisory, commit-time checks are authoritative
@@ -326,57 +355,110 @@ class DenseSolver:
         caps_dev, prices_dev = device_catalog
 
         bucket_stats = np.stack([sum_req, max_req]).astype(np.float32)  # [2, B, R]
-        packed = np.asarray(bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed)))
-        tstar, feasible = packed[0], packed[2].astype(bool)
+        packed_fut = bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed))
 
-        bin_of_row = np.full((problem.P,), -1, np.int64)
-        bin_bucket: List[int] = []
-        next_bin = 0
+        # speculate under the in-flight round trip
+        prev_tstar, prev_feasible = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
+        local: List[tuple] = []
         for b, bucket in enumerate(buckets):
             rows = np.asarray(bucket.pod_rows, dtype=np.int64)
-            if not feasible[b]:
-                continue  # all pods of this bucket fall back to the host loop
-            cap = caps_eff[tstar[b]]
             reqs = problem.requests[rows]
-            if bucket.dedicated:
-                fits = np.all(reqs <= cap[None, :] + res.tolerance(cap)[None, :], axis=1)
-                ids = np.where(fits, next_bin + np.cumsum(fits) - 1, -1)
-                bin_of_row[rows] = ids
-                opened = int(fits.sum())
-                bin_bucket.extend([b] * opened)
-                next_bin += opened
-            elif bucket.single_bin:
-                # fill one bin greedily, largest first, exact resource check
-                order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
-                free = cap.astype(np.float64).copy()
-                taken = []
-                for i in order:
-                    if np.all(reqs[i] <= free + res.tolerance(free)):
-                        free -= reqs[i]
-                        taken.append(i)
-                if taken:
-                    bin_of_row[rows[np.asarray(taken)]] = next_bin
-                    bin_bucket.append(b)
-                    next_bin += 1
-            else:
-                quantum = None
-                # bound the distinct-size count for continuous distributions
-                if len(rows) > 4096:
-                    quantum = np.maximum(cap, 1e-9) / 4096.0
-                unique, counts, inverse = dedupe_sizes(reqs, quantum)
-                patterns, unplaced = pack_counts(unique, counts, cap)
-                ids, next_bin2 = assign_bins(inverse, patterns, unplaced, next_bin)
-                bin_of_row[rows] = ids
-                bin_bucket.extend([b] * (next_bin2 - next_bin))
-                next_bin = next_bin2
+            pack = self._pack_bucket(bucket, reqs, caps_eff[prev_tstar[b]]) if prev_feasible[b] else None
+            local.append((rows, reqs, pack))
 
-        return {
-            "buckets": buckets,
-            "tstar": tstar,
-            "bin_of_row": bin_of_row,
-            "bin_bucket": np.asarray(bin_bucket, dtype=np.int64),
-            "num_bins": next_bin,
-        }
+        # speculative assembly + audit, still under the in-flight round trip
+        sol = self._assemble(problem, buckets, local, bucket_extra)
+
+        packed = np.asarray(packed_fut)  # blocks until the device result lands
+        tstar, feasible = packed[0], packed[2].astype(bool)
+        changed = False
+        for b, bucket in enumerate(buckets):
+            if bool(feasible[b]) != bool(prev_feasible[b]) or (feasible[b] and tstar[b] != prev_tstar[b]):
+                rows, reqs, _ = local[b]
+                pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]]) if feasible[b] else None
+                local[b] = (rows, reqs, pack)
+                changed = True
+        if changed:  # rare: an f32 rounding tie broke differently on device
+            sol = self._assemble(problem, buckets, local, bucket_extra)
+        sol["tstar"] = tstar
+        return sol
+
+    def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray) -> dict:
+        """Pure assembly + audit of the per-bucket packings: global bin ids,
+        per-bin usage/rows, and surviving instance-type masks (same tolerance
+        rule as resources.fits so audits can't disagree). Touches no scheduler
+        state, so it runs speculatively under the device round trip and is
+        recomputed wholesale on (rare) reconciliation."""
+        bin_of_row = np.full((problem.P,), -1, np.int64)
+        bin_bucket_list: List[int] = []
+        next_bin = 0
+        for b, (rows, _reqs, pack) in enumerate(local):
+            if pack is None:
+                continue  # all pods of this bucket fall back to the host loop
+            ids_local, n_local = pack
+            bin_of_row[rows] = np.where(ids_local >= 0, ids_local + next_bin, -1)
+            bin_bucket_list.extend([b] * n_local)
+            next_bin += n_local
+        num_bins = next_bin
+        bin_bucket = np.asarray(bin_bucket_list, dtype=np.int64)
+        sol = {"buckets": buckets, "bin_of_row": bin_of_row, "bin_bucket": bin_bucket, "num_bins": num_bins}
+        if num_bins == 0:
+            return sol
+
+        # per-bin aggregates (vectorized over the pod axis)
+        usage = np.zeros((num_bins, problem.requests.shape[1]), np.float64)
+        placed = bin_of_row >= 0
+        np.add.at(usage, bin_of_row[placed], problem.requests[placed])
+        placed_rows = np.nonzero(placed)[0]
+        order = np.argsort(bin_of_row[placed_rows], kind="stable")
+        sorted_rows = placed_rows[order]
+        boundaries = np.searchsorted(bin_of_row[sorted_rows], np.arange(num_bins + 1))
+        bin_rows: List[np.ndarray] = [sorted_rows[boundaries[i] : boundaries[i + 1]] for i in range(num_bins)]
+
+        # bulk audit: surviving instance-type options for every bin at once.
+        # Bins repeat heavily (identical dedicated bins, repeated pack
+        # patterns), so the [bins, T, R] compare runs over unique rows only.
+        need_all = usage + problem.daemon_overhead[None, :]  # [num_bins, R]
+        cap_tol = problem.caps + res.tolerance(problem.caps)  # [T, R]
+        uniq_need, inv_need = np.unique(need_all, axis=0, return_inverse=True)
+        fit_all = np.all(uniq_need[:, None, :] <= cap_tol[None, :, :], axis=2)[inv_need]  # [num_bins, T]
+        group_of_bin = np.asarray([buckets[int(b)].group_index for b in bin_bucket], dtype=np.int64)
+        mask_all = fit_all & problem.compat[group_of_bin] & bucket_extra[bin_bucket]
+        sol.update(usage=usage, bin_rows=bin_rows, mask_all=mask_all)
+        return sol
+
+    def _pack_bucket(self, bucket: _Bucket, reqs: np.ndarray, cap: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pack one bucket's pods into bins of capacity `cap`.
+
+        Returns (local bin id per pod row, -1 unplaced; number of bins)."""
+        from .pack_counts import assign_bins, dedupe_sizes, pack_counts
+
+        n = len(reqs)
+        if bucket.dedicated:
+            fits = np.all(reqs <= cap[None, :] + res.tolerance(cap)[None, :], axis=1)
+            ids = np.where(fits, np.cumsum(fits) - 1, -1)
+            return ids, int(fits.sum())
+        if bucket.single_bin:
+            # fill one bin greedily, largest first, exact resource check
+            order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
+            free = cap.astype(np.float64).copy()
+            taken = []
+            for i in order:
+                if np.all(reqs[i] <= free + res.tolerance(free)):
+                    free -= reqs[i]
+                    taken.append(i)
+            ids = np.full((n,), -1, np.int64)
+            if taken:
+                ids[np.asarray(taken)] = 0
+                return ids, 1
+            return ids, 0
+        quantum = None
+        # bound the distinct-size count for continuous distributions
+        if n > 4096:
+            quantum = np.maximum(cap, 1e-9) / 4096.0
+        unique, counts, inverse = dedupe_sizes(reqs, quantum)
+        patterns, unplaced = pack_counts(unique, counts, cap)
+        return assign_bins(inverse, patterns, unplaced, 0)
 
     # -- steps 4+5: verify & commit ------------------------------------------
 
@@ -392,39 +474,57 @@ class DenseSolver:
         if num_bins == 0:
             return 0, fallback_rows
 
-        # per-bin aggregates (vectorized over the pod axis)
-        usage = np.zeros((num_bins, problem.requests.shape[1]), np.float64)
-        placed = bin_of_row >= 0
-        np.add.at(usage, bin_of_row[placed], problem.requests[placed])
-        bin_rows: List[List[int]] = [[] for _ in range(num_bins)]
-        for row in np.nonzero(placed)[0]:
-            bin_rows[int(bin_of_row[row])].append(int(row))
-
-        caps_full = problem.caps  # [T, R]
-        overhead = problem.daemon_overhead
-        zone_index = {z: i for i, z in enumerate(problem.zones)}
-        ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
-
-        # bulk audit: surviving instance-type options for every bin at once
-        # (same tolerance rule as resources.fits so audits can't disagree)
-        need_all = usage + overhead[None, :]  # [num_bins, R]
-        cap_tol = caps_full + res.tolerance(caps_full)  # [T, R]
-        fit_all = np.all(need_all[:, None, :] <= cap_tol[None, :, :], axis=2)  # [num_bins, T]
-        group_of_bin = np.asarray([buckets[int(b)].group_index for b in bin_bucket], dtype=np.int64)
-        mask_all = fit_all & problem.compat[group_of_bin]
-        for bid in range(num_bins):
-            bucket = buckets[int(bin_bucket[bid])]
-            if bucket.zone is not None and bucket.zone != "__infeasible__":
-                mask_all[bid] &= problem.type_zone[:, zone_index[bucket.zone]]
-            if bucket.capacity_type is not None:
-                mask_all[bid] &= problem.type_ct[:, ct_index[bucket.capacity_type]]
+        usage = sol["usage"]
+        bin_rows = sol["bin_rows"]
+        mask_all = sol["mask_all"]
 
         # identical dedicated bins share options lists; cache by content
         options_cache: Dict[bytes, list] = {}
+        # topology recording caches: bins of one bucket share namespace,
+        # labels, and node requirements (up to the per-bin placeholder
+        # hostname — hostname-keyed pod requirements are routed to the host
+        # loop by bucket_proto below), so which groups count a cohort is a
+        # per-bucket fact. The group's *domain* is still read from each bin's
+        # own requirements.
+        match_cache: Dict[int, list] = {}
+        inverse_by_uid = scheduler.topology.inverse_owner_index()
+
+        # per-bucket prototype requirements: template ∩ group ∩ zone/ct is a
+        # bucket-level fact; each bin copies the prototype and adds only its
+        # placeholder hostname (inside open_prepared)
+        proto_cache: Dict[int, Optional[Requirements]] = {}
+
+        def bucket_proto(bkey: int) -> Optional[Requirements]:
+            if bkey in proto_cache:
+                return proto_cache[bkey]
+            bucket = buckets[bkey]
+            group = problem.groups[bucket.group_index]
+            reqs = Requirements(*problem.template.requirements.values())
+            proto: Optional[Requirements] = reqs
+            if group.requirements is not None:
+                # any hostname-keyed pod requirement (IN a specific host, but
+                # also DoesNotExist/Gt/Lt, which compatible() can't veto) is
+                # incompatible with the per-bin placeholder-hostname protocol
+                # — the exact host loop owns those pods
+                if group.requirements.has(lbl.LABEL_HOSTNAME):
+                    proto = None
+                elif reqs.compatible(group.requirements) is not None:
+                    proto = None
+                else:
+                    reqs.add(*group.requirements.values())
+            if proto is not None:
+                if bucket.zone is not None and bucket.zone != "__infeasible__":
+                    reqs.add(Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, bucket.zone))
+                if bucket.capacity_type is not None:
+                    reqs.add(Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, bucket.capacity_type))
+            proto_cache[bkey] = proto
+            return proto
+
+        daemon = scheduler.daemon_overhead.get(problem.template.provisioner_name, {})
         committed = 0
         for bid in range(num_bins):
-            bucket = buckets[int(bin_bucket[bid])]
-            group = problem.groups[bucket.group_index]
+            bucket_key = int(bin_bucket[bid])
+            bucket = buckets[bucket_key]
             mask = mask_all[bid]
             if not mask.any():
                 fallback_rows.extend(bin_rows[bid])
@@ -435,25 +535,24 @@ class DenseSolver:
             if options is None:
                 options = [problem.instance_types[t] for t in np.nonzero(mask)[0]]
                 options_cache[mask_key] = options
-            node = VirtualNode(problem.template, scheduler.topology, dict(scheduler.daemon_overhead.get(problem.template.provisioner_name, {})), options)
+            proto = bucket_proto(bucket_key)
+            if proto is None:
+                fallback_rows.extend(bin_rows[bid])
+                continue
+            node = VirtualNode.open_prepared(problem.template, proto.copy(), scheduler.topology, daemon, options)
             reqs = node.template.requirements
-            if group.requirements is not None:
-                err = reqs.compatible(group.requirements)
-                if err is not None:
-                    node.release()
-                    fallback_rows.extend(bin_rows[bid])
-                    continue
-                reqs.add(*group.requirements.values())
-            if bucket.zone is not None and bucket.zone != "__infeasible__":
-                reqs.add(Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, bucket.zone))
-            if bucket.capacity_type is not None:
-                reqs.add(Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, bucket.capacity_type))
 
             node.pods = [problem.pods[row] for row in bin_rows[bid]]
             node.requests = res.merge(
                 node.requests, {name: float(v) for name, v in zip(problem.resource_names, usage[bid]) if v > 0}
             )
             scheduler.nodes.append(node)
-            committed += len(node.pods)
-            scheduler.topology.record_cohort(node.pods, reqs)
+            n_pods = len(node.pods)
+            committed += n_pods
+
+            matching = match_cache.get(bucket_key)
+            if matching is None:
+                matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
+                match_cache[bucket_key] = matching
+            scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
         return committed, fallback_rows

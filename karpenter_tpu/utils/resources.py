@@ -57,6 +57,8 @@ def tolerance(total):
     fits). Elementwise-safe: accepts floats or numpy arrays. Shared by
     fits(), the dense packer (pack_counts.py), and the commit audit
     (solver/dense.py) so their verdicts can never disagree."""
+    if isinstance(total, (int, float)):
+        return 1e-6 + 1e-9 * abs(total) if total > 0 else 1e-12
     import numpy as np
 
     return np.where(np.asarray(total) > 0, 1e-6 + 1e-9 * np.abs(total), 1e-12)
@@ -70,7 +72,7 @@ def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
     """
     for name, value in (candidate or {}).items():
         limit = (total or {}).get(name, 0.0)
-        if value > limit + float(tolerance(limit)):
+        if value > limit + tolerance(limit):
             return False
     return True
 

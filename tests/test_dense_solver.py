@@ -114,6 +114,26 @@ class TestDenseVsOracle:
             zone_req = node.requirements.get(LABEL_TOPOLOGY_ZONE)
             assert len(zone_req.values) == 1
 
+    def test_hostname_negative_requirement_goes_to_host_loop(self):
+        """A hostname DoesNotExist node-affinity term can't be vetoed by
+        compatible() (hostname isn't a well-known label), so the dense path
+        must route it to the host loop rather than commit a node whose
+        placeholder hostname violates it (regression: bucket_proto gate)."""
+        from karpenter_tpu.api.objects import OP_DOES_NOT_EXIST
+
+        pods = [
+            make_pod(
+                requests={"cpu": "0.5"},
+                node_requirements=[NodeSelectorRequirement(key=LABEL_HOSTNAME, operator=OP_DOES_NOT_EXIST)],
+            )
+            for _ in range(40)
+        ]
+        host, dense = solve_both(pods)
+        # neither path may schedule these pods onto a hostname-carrying node
+        # in a way that violates the term; behavior must agree with the oracle
+        assert scheduled_names(dense) == scheduled_names(host)
+        audit_feasible(dense)
+
     def test_zonal_spread(self):
         constraint = TopologySpreadConstraint(
             max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"})
