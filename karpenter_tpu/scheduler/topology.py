@@ -36,6 +36,9 @@ class Topology:
         pods = list(pods)  # may be a generator; we iterate twice
         # the batch being scheduled must not count toward its own topologies
         self.excluded_pods: Set[str] = {p.uid for p in pods}
+        # pods that have registered ownership at least once: update() only
+        # needs its remove-ownership sweep (O(groups)) for these
+        self._registered: Set[str] = set()
         self._update_inverse_affinities()
         for p in pods:
             self.update(p)
@@ -45,8 +48,11 @@ class Topology:
     def update(self, pod: Pod) -> None:
         """(Re)register the pod as owner of its topology groups; called after
         relaxation to drop ownership of removed constraints."""
-        for group in self.topologies.values():
-            group.remove_owner(pod.uid)
+        if pod.uid in self._registered:
+            for group in self.topologies.values():
+                group.remove_owner(pod.uid)
+        else:
+            self._registered.add(pod.uid)
 
         if podutils.has_required_pod_anti_affinity(pod):
             self._update_inverse_anti_affinity(pod, node_labels=None)

@@ -92,6 +92,12 @@ class DenseSolver:
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # warm the native packing core at construction (solver construction
+        # is bootstrap) so a lazy g++ build never lands inside a live solve;
+        # process-wide cached, no-op after the first solver
+        from .. import native
+
+        native.load()
         # per-catalog device arrays (caps/prices), uploaded once and reused
         # across solves — host->device transfers over the tunnel are the
         # dominant per-dispatch cost, so only per-batch data moves per solve
@@ -431,13 +437,11 @@ class DenseSolver:
         """Pack one bucket's pods into bins of capacity `cap`.
 
         Returns (local bin id per pod row, -1 unplaced; number of bins)."""
-        from .pack_counts import assign_bins, dedupe_sizes, pack_counts
+        from .pack_counts import dedupe_sizes, pack_and_assign, pack_dedicated
 
         n = len(reqs)
         if bucket.dedicated:
-            fits = np.all(reqs <= cap[None, :] + res.tolerance(cap)[None, :], axis=1)
-            ids = np.where(fits, np.cumsum(fits) - 1, -1)
-            return ids, int(fits.sum())
+            return pack_dedicated(reqs, cap)
         if bucket.single_bin:
             # fill one bin greedily, largest first, exact resource check
             order = np.lexsort((-reqs[:, 1], -reqs[:, 0]))
@@ -457,8 +461,7 @@ class DenseSolver:
         if n > 4096:
             quantum = np.maximum(cap, 1e-9) / 4096.0
         unique, counts, inverse = dedupe_sizes(reqs, quantum)
-        patterns, unplaced = pack_counts(unique, counts, cap)
-        return assign_bins(inverse, patterns, unplaced, 0)
+        return pack_and_assign(unique, counts, inverse, cap)
 
     # -- steps 4+5: verify & commit ------------------------------------------
 

@@ -22,12 +22,44 @@ from typing import List, Tuple
 
 import numpy as np
 
+from .. import native
+
+
+def pack_and_assign(unique: np.ndarray, counts: np.ndarray, inverse: np.ndarray, cap: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Pack deduplicated sizes and expand to per-item bin ids in one call.
+
+    Uses the native C++ core (karpenter_tpu/native) when available; the pure
+    numpy path below is the always-available fallback with identical
+    semantics (held together by tests/test_native.py).
+
+    Returns (bin_of_item [P] int64 with -1 unplaced, number of bins).
+    """
+    result = native.pack_assign(unique, counts, inverse, cap, 0)
+    if result is not None:
+        bin_of_item, next_bin, _unplaced = result
+        return bin_of_item, next_bin
+    patterns, unplaced = pack_counts(unique, counts, cap)
+    return assign_bins(inverse, patterns, unplaced, 0)
+
+
+def pack_dedicated(requests: np.ndarray, cap: np.ndarray) -> Tuple[np.ndarray, int]:
+    """One item per bin when it fits an empty bin; -1 otherwise."""
+    result = native.pack_dedicated(requests, cap, 0)
+    if result is not None:
+        return result
+    from ..utils.resources import tolerance
+
+    fits = np.all(requests <= cap[None, :] + tolerance(cap)[None, :], axis=1)
+    ids = np.where(fits, np.cumsum(fits) - 1, -1)
+    return ids, int(fits.sum())
+
 
 def dedupe_sizes(requests: np.ndarray, quantum: np.ndarray = None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Group identical request vectors.
 
-    Returns (unique [U, R] float32, counts [U] int64, inverse [P] int64),
-    with unique sorted descending by (cpu, memory) — FFD order. An optional
+    Returns (unique [U, R] same dtype as the input, counts [U] int64,
+    inverse [P] int64), with unique sorted descending by the first resource
+    column, later columns as tiebreaks — FFD order. An optional
     per-resource quantum rounds requests *up* to bound U for continuous size
     distributions (feasible by construction: we only over-estimate).
     """
@@ -36,7 +68,8 @@ def dedupe_sizes(requests: np.ndarray, quantum: np.ndarray = None) -> Tuple[np.n
         q = np.maximum(quantum, 1e-12)
         reqs = np.ceil(requests / q) * q
     unique, inverse, counts = np.unique(reqs, axis=0, return_inverse=True, return_counts=True)
-    order = np.lexsort((-unique[:, 1], -unique[:, 0]))
+    # descending by first column, later columns as tiebreaks (FFD order)
+    order = np.lexsort(tuple(-unique[:, c] for c in range(unique.shape[1] - 1, -1, -1)))
     rank = np.empty_like(order)
     rank[order] = np.arange(len(order))
     return unique[order], counts[order], rank[inverse]

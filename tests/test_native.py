@@ -1,0 +1,110 @@
+"""Differential tests: native C++ packing core vs the pure-Python reference.
+
+The native core (karpenter_tpu/native) must match the Python path exactly —
+pack_and_assign routes through whichever is available, so any divergence
+would silently change scheduling outcomes.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import native
+from karpenter_tpu.solver.pack_counts import assign_bins, dedupe_sizes, pack_counts
+from karpenter_tpu.utils.resources import tolerance
+
+
+def python_pack_assign(unique, counts, inverse, cap):
+    patterns, unplaced = pack_counts(unique, counts, cap)
+    return assign_bins(inverse, patterns, unplaced, 0)
+
+
+needs_native = pytest.mark.skipif(not native.available(), reason="native core unavailable")
+
+
+@needs_native
+def test_native_loads_and_reports_abi():
+    assert native.load() is not None
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(20))
+def test_pack_assign_matches_python(seed):
+    rng = np.random.default_rng(seed)
+    P = int(rng.integers(1, 400))
+    R = int(rng.integers(1, 5))
+    # discrete size menu so classes repeat, as real requests do
+    menu = rng.random((int(rng.integers(1, 12)), R)) * 4.0
+    reqs = menu[rng.integers(0, len(menu), size=P)]
+    cap = rng.random((R,)) * 16 + 1.0
+    unique, counts, inverse = dedupe_sizes(reqs)
+
+    got = native.pack_assign(unique, counts, inverse, cap, 0)
+    assert got is not None
+    got_ids, got_bins, got_unplaced = got
+    want_ids, want_bins = python_pack_assign(unique, counts, inverse, cap)
+
+    np.testing.assert_array_equal(got_ids, want_ids)
+    assert got_bins == want_bins
+    _, py_unplaced = pack_counts(unique, counts, cap)
+    np.testing.assert_array_equal(got_unplaced, py_unplaced)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(10))
+def test_pack_dedicated_matches_python(seed):
+    rng = np.random.default_rng(seed)
+    P = int(rng.integers(1, 100))
+    R = int(rng.integers(1, 5))
+    reqs = rng.random((P, R)) * 4.0
+    cap = rng.random((R,)) * 3.0
+
+    got = native.pack_dedicated(reqs, cap, 0)
+    assert got is not None
+    got_ids, got_bins = got
+
+    fits = np.all(reqs <= cap[None, :] + tolerance(cap)[None, :], axis=1)
+    want_ids = np.where(fits, np.cumsum(fits) - 1, -1)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    assert got_bins == int(fits.sum())
+
+
+@needs_native
+def test_oversized_items_unplaced():
+    unique = np.array([[10.0, 10.0], [1.0, 1.0]])
+    counts = np.array([3, 4], dtype=np.int64)
+    inverse = np.array([0, 0, 0, 1, 1, 1, 1], dtype=np.int64)
+    cap = np.array([4.0, 4.0])
+    got_ids, got_bins, got_unplaced = native.pack_assign(unique, counts, inverse, cap, 0)
+    assert list(got_unplaced) == [3, 0]
+    assert (got_ids[:3] == -1).all()
+    assert (got_ids[3:] >= 0).all()
+    want_ids, want_bins = python_pack_assign(unique, counts, inverse, cap)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    assert got_bins == want_bins
+
+
+@needs_native
+def test_zero_items():
+    unique = np.zeros((0, 2))
+    counts = np.zeros((0,), dtype=np.int64)
+    inverse = np.zeros((0,), dtype=np.int64)
+    cap = np.array([4.0, 4.0])
+    got_ids, got_bins, got_unplaced = native.pack_assign(unique, counts, inverse, cap, 0)
+    assert got_bins == 0
+    assert got_ids.shape == (0,)
+
+
+def test_fallback_when_disabled(monkeypatch):
+    # the pure path must produce valid packings even without the native core
+    from karpenter_tpu.solver import pack_counts as pc
+
+    monkeypatch.setattr(native, "pack_assign", lambda *a, **k: None)
+    monkeypatch.setattr(native, "pack_dedicated", lambda *a, **k: None)
+    rng = np.random.default_rng(7)
+    reqs = rng.random((50, 2)) * 2.0
+    cap = np.array([8.0, 8.0])
+    unique, counts, inverse = dedupe_sizes(reqs)
+    ids, bins = pc.pack_and_assign(unique, counts, inverse, cap)
+    assert bins > 0 and (ids >= 0).all()
+    ids2, bins2 = pc.pack_dedicated(reqs, cap)
+    assert bins2 == 50
