@@ -41,6 +41,10 @@ def _compile() -> bool:
         _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     except OSError:
         return False  # read-only install: stay on the pure-Python path
+    # compile to a unique temp path, then atomically rename into place:
+    # concurrent cold-starting processes may race this build, and a rebuild
+    # must never truncate a .so another live process has mapped
+    tmp = _BUILD_DIR / f".libpackcore.{os.getpid()}.tmp.so"
     cmd = [
         "g++",
         "-O3",
@@ -49,15 +53,18 @@ def _compile() -> bool:
         "-fPIC",
         str(_SRC),
         "-o",
-        str(_LIB),
+        str(tmp),
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            print(f"packcore build failed:\n{proc.stderr}", file=sys.stderr)
+            return False
+        os.replace(tmp, _LIB)
     except (OSError, subprocess.TimeoutExpired):
         return False
-    if proc.returncode != 0:
-        print(f"packcore build failed:\n{proc.stderr}", file=sys.stderr)
-        return False
+    finally:
+        tmp.unlink(missing_ok=True)
     return True
 
 

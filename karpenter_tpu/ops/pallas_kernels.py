@@ -1,0 +1,159 @@
+"""Pallas TPU kernel for the bucket→instance-type cost choice.
+
+Fuses the whole bucket_type_cost computation (ops/feasibility.py:53 — the
+tensor reformulation of the reference's per-node instance-type filter,
+scheduling/node.go:139-161) into ONE kernel: the [B, T, R] ratio surface is
+never materialized in HBM. The resource axis is unrolled in-register (R is
+static and small), so the working set is a handful of [B, T] f32 tiles in
+VMEM and the kernel is one VPU pass: ratio-max, ceil, feasibility mask,
+composite cost key, masked argmin, and the packed int32 [3, B] result that
+the solver downloads in a single transfer.
+
+On non-TPU backends the kernel runs in interpreter mode (tests); the jnp
+path in feasibility.py remains the fallback and the differential test
+(tests/test_pallas.py) pins the two to identical outputs on identical f32
+inputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _kernel(sum_ref, max_ref, caps_ref, prices_ref, allowed_ref, out_ref):
+    """sum/max: [B, R]; caps: [R, T] (transposed for lane-contiguous rows);
+    prices: [1, T]; allowed: [B, T] int8; out: [3, B] int32."""
+    # Mosaic note: boolean (i1) vectors with broadcast/replicated layouts
+    # fail to relayout on TPU, so every mask here is a materialized [B, T]
+    # f32 0/1 tensor combined with multiplies, and comparisons only run on
+    # already-broadcast f32 operands.
+    B = sum_ref.shape[0]
+    R = sum_ref.shape[1]
+    T = caps_ref.shape[1]
+    eps = jnp.float32(1e-9)
+    inf = jnp.float32(jnp.inf)
+    one = jnp.float32(1.0)
+    zero = jnp.float32(0.0)
+    ones_bt = jnp.ones((B, T), jnp.float32)
+
+    frac = jnp.zeros((B, T), jnp.float32)
+    fits = ones_bt
+    for r in range(R):  # static unroll: R is the (small) resource arity
+        cap_r = caps_ref[r, :][None, :] * ones_bt  # materialized [B, T]
+        s_r = sum_ref[:, r][:, None] * ones_bt
+        m_r = max_ref[:, r][:, None] * ones_bt
+        ratio = s_r / jnp.maximum(cap_r, eps)
+        # type lacks the resource entirely (cap==0) but the bucket needs it
+        impossible = jnp.where(cap_r <= eps, one, zero) * jnp.where(s_r > eps, one, zero)
+        frac = jnp.maximum(frac, jnp.where(impossible > zero, inf, ratio))
+        fits = fits * jnp.where(m_r <= cap_r + jnp.float32(1e-6), one, zero)
+
+    bins = jnp.ceil(jnp.maximum(frac, eps))
+    allowed = allowed_ref[:].astype(jnp.float32)
+    finite = jnp.where(frac < inf, one, zero)
+    ok = allowed * fits * finite  # [B, T] 0/1
+    prices = prices_ref[0, :][None, :] * ones_bt
+    key = frac * prices + bins * jnp.float32(1e-4) + prices * jnp.float32(1e-7)
+    key = jnp.where(ok > zero, key, inf)
+
+    min_key = jnp.min(key, axis=1, keepdims=True) * ones_bt  # materialized
+    col = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1).astype(jnp.float32)
+    # first index achieving the minimum — exact jnp.argmin semantics
+    # (all-inf rows: inf == inf everywhere, so the min below is column 0)
+    idx = jnp.where(key == min_key, col, jnp.float32(T))
+    tstar_f = jnp.min(idx, axis=1)  # [B]
+    tstar_b = tstar_f[:, None] * ones_bt
+    at_star = jnp.where(col == tstar_b, one, zero)
+    safe_bins = jnp.where(ok > zero, bins, zero)  # bins may be inf when infeasible
+    chosen = jnp.sum(at_star * safe_bins, axis=1)  # 0 when infeasible
+    feasible = jnp.max(ok, axis=1)
+
+    out_ref[0, :] = tstar_f.astype(jnp.int32)
+    out_ref[1, :] = chosen.astype(jnp.int32)
+    out_ref[2, :] = feasible.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _bucket_type_cost_padded(sum_requests, max_requests, caps_t, prices, allowed, interpret):
+    B = sum_requests.shape[0]
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((3, B), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(sum_requests, max_requests, caps_t, prices, allowed)
+
+
+def pad_catalog(caps, prices):
+    """Host-side (numpy) catalog padding: [T, R] caps + [T] prices →
+    ([R, Tp] transposed caps, [1, Tp] prices), Tp a lane multiple. The caller
+    uploads these once per catalog and reuses them across solves — over a
+    tunnel-attached TPU, per-dispatch transfers are the latency budget."""
+    import numpy as np
+
+    T, R = caps.shape
+    Tp = _ceil_to(max(T, 1), _LANE)
+    caps_t = np.zeros((R, Tp), np.float32)
+    caps_t[:, :T] = caps.T
+    prices_p = np.zeros((1, Tp), np.float32)
+    prices_p[0, :T] = prices
+    return caps_t, prices_p
+
+
+def pad_batch(bucket_stats, allowed):
+    """Host-side (numpy) per-batch padding: [2, B, R] stats + [B, T] allowed
+    → ([Bp, R] sum, [Bp, R] max, [Bp, Tp] int8 allowed). Padded rows keep
+    allowed=0 → infeasible → stripped by the caller; padded type columns
+    keep allowed=0 → key=inf → never chosen."""
+    import numpy as np
+
+    B, R = bucket_stats.shape[1], bucket_stats.shape[2]
+    T = allowed.shape[1]
+    Bp, Tp = _ceil_to(max(B, 1), _SUBLANE), _ceil_to(max(T, 1), _LANE)
+    sum_p = np.zeros((Bp, R), np.float32)
+    sum_p[:B] = bucket_stats[0]
+    max_p = np.zeros((Bp, R), np.float32)
+    max_p[:B] = bucket_stats[1]
+    allowed_p = np.zeros((Bp, Tp), np.int8)
+    allowed_p[:B, :T] = allowed
+    return sum_p, max_p, allowed_p
+
+
+def bucket_type_cost_padded(sum_p, max_p, caps_t, prices_p, allowed_p):
+    """One fused kernel dispatch on pre-padded inputs → [3, Bp] int32."""
+    return _bucket_type_cost_padded(sum_p, max_p, caps_t, prices_p, allowed_p, jax.default_backend() != "tpu")
+
+
+def bucket_type_cost_pallas(bucket_stats, caps, prices, allowed):
+    """Convenience drop-in for ops/feasibility.py:bucket_type_cost_packed
+    (pads, dispatches, strips). bucket_stats: [2, B, R] f32; caps: [T, R]
+    f32; prices: [T] f32; allowed: [B, T] bool. Returns [3, B] int32
+    (tstar, bins, feasible) — identical contract and tie-breaking as the
+    jnp path. The solver uses the split pad_catalog/pad_batch entry points
+    to amortize catalog upload."""
+    B = bucket_stats.shape[1]
+    caps_t, prices_p = pad_catalog(caps, prices)
+    sum_p, max_p, allowed_p = pad_batch(bucket_stats, allowed)
+    out = bucket_type_cost_padded(
+        jnp.asarray(sum_p), jnp.asarray(max_p), jnp.asarray(caps_t), jnp.asarray(prices_p), jnp.asarray(allowed_p)
+    )
+    return out[:, :B]

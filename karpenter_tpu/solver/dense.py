@@ -88,6 +88,10 @@ class _Bucket:
 class DenseSolver:
     """Attachable TPU presolver for Scheduler (scheduler.py)."""
 
+    # process-wide: whether the fused Pallas kernel works on this backend
+    # (None = not probed yet; flips False permanently on any failure)
+    _pallas_ok: Optional[bool] = None
+
     def __init__(self, min_batch: int = 32, num_slots: int = 8):
         self.min_batch = min_batch
         self.num_slots = num_slots
@@ -302,6 +306,32 @@ class DenseSolver:
         populated = [z for z, c in zip(allowed, counts) if c > 0]
         return populated[0] if populated else allowed[0]
 
+    def _pallas_enabled(self) -> bool:
+        import os
+
+        if os.environ.get("KARPENTER_TPU_NO_PALLAS"):
+            return False
+        cls = type(self)
+        if cls._pallas_ok is None:
+            import jax
+
+            if jax.default_backend() != "tpu":
+                # interpreter mode is for tests only; the jnp path IS the
+                # production path off-TPU
+                cls._pallas_ok = False
+                return False
+            try:
+                from ..ops.pallas_kernels import bucket_type_cost_pallas
+
+                stats = np.ones((2, 1, 2), np.float32)
+                probe = np.asarray(
+                    bucket_type_cost_pallas(stats, np.full((1, 2), 4, np.float32), np.ones((1,), np.float32), np.ones((1, 1), bool))
+                )
+                cls._pallas_ok = probe.shape == (3, 1) and bool(probe[2, 0])
+            except Exception:
+                cls._pallas_ok = False
+        return cls._pallas_ok
+
     # -- step 3: device solve -------------------------------------------------
 
     def _device_solve(self, problem: DenseProblem, buckets: List[_Bucket]):
@@ -324,6 +354,7 @@ class DenseSolver:
         from ..ops.feasibility import bucket_type_cost_packed
 
         B = len(buckets)
+        use_pallas = self._pallas_enabled()
         zone_index = {z: i for i, z in enumerate(problem.zones)}
         ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
 
@@ -349,19 +380,45 @@ class DenseSolver:
         # f32 — its choice is advisory, commit-time checks are authoritative
         caps_eff = np.maximum(problem.caps - problem.daemon_overhead[None, :], 0.0)
 
-        catalog_key = (caps_eff.tobytes(), problem.prices.tobytes())
-        device_catalog = self._device_catalog.get(catalog_key)
-        if device_catalog is None:
-            device_catalog = (
-                jnp.asarray(caps_eff, dtype=jnp.float32),
-                jnp.asarray(problem.prices, dtype=jnp.float32),
-            )
-            self._device_catalog.clear()  # one catalog at a time is enough
-            self._device_catalog[catalog_key] = device_catalog
-        caps_dev, prices_dev = device_catalog
-
         bucket_stats = np.stack([sum_req, max_req]).astype(np.float32)  # [2, B, R]
-        packed_fut = bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed))
+
+        # per-catalog device arrays are uploaded once and cached keyed by
+        # (content, path); one catalog is resident at a time per path flavor
+        def _catalog(flavor: bool):
+            key = (caps_eff.tobytes(), problem.prices.tobytes(), flavor)
+            catalog = self._device_catalog.get(key)
+            if catalog is None:
+                if flavor:
+                    from ..ops.pallas_kernels import pad_catalog
+
+                    caps_t, prices_p = pad_catalog(caps_eff.astype(np.float32), problem.prices.astype(np.float32))
+                    catalog = (jnp.asarray(caps_t), jnp.asarray(prices_p))
+                else:
+                    catalog = (jnp.asarray(caps_eff, dtype=jnp.float32), jnp.asarray(problem.prices, dtype=jnp.float32))
+                if len(self._device_catalog) > 2:  # keep at most both flavors of one catalog
+                    self._device_catalog.clear()
+                self._device_catalog[key] = catalog
+            return catalog
+
+        def _jnp_dispatch():
+            caps_dev, prices_dev = _catalog(False)
+            return bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed))
+
+        if use_pallas:
+            try:
+                from ..ops.pallas_kernels import bucket_type_cost_padded, pad_batch
+
+                caps_dev, prices_dev = _catalog(True)
+                sum_p, max_p, allowed_p = pad_batch(bucket_stats, allowed)
+                packed_fut = bucket_type_cost_padded(
+                    jnp.asarray(sum_p), jnp.asarray(max_p), caps_dev, prices_dev, jnp.asarray(allowed_p)
+                )
+            except Exception:  # unexpected shape class the kernel can't compile
+                type(self)._pallas_ok = False
+                use_pallas = False
+                packed_fut = _jnp_dispatch()
+        else:
+            packed_fut = _jnp_dispatch()
 
         # speculate under the in-flight round trip
         prev_tstar, prev_feasible = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
@@ -375,7 +432,13 @@ class DenseSolver:
         # speculative assembly + audit, still under the in-flight round trip
         sol = self._assemble(problem, buckets, local, bucket_extra)
 
-        packed = np.asarray(packed_fut)  # blocks until the device result lands
+        try:
+            packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
+        except Exception:
+            if not use_pallas:
+                raise
+            type(self)._pallas_ok = False  # runtime failure: retire the kernel
+            packed = np.asarray(_jnp_dispatch())[:, :B]
         tstar, feasible = packed[0], packed[2].astype(bool)
         changed = False
         for b, bucket in enumerate(buckets):

@@ -1,0 +1,69 @@
+"""Differential tests: the fused Pallas bucket→type kernel vs the jnp path.
+
+Both receive identical f32 inputs; tstar/feasible must match exactly
+(including argmin tie-breaking), and bins must match wherever feasible.
+On CPU the kernel runs in interpreter mode; on TPU it compiles for real.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from karpenter_tpu.ops.feasibility import bucket_type_cost_packed  # noqa: E402
+from karpenter_tpu.ops.pallas_kernels import bucket_type_cost_pallas  # noqa: E402
+
+
+def _random_problem(rng, B, T, R):
+    sum_req = (rng.random((B, R)) * 20).astype(np.float32)
+    max_req = (sum_req * rng.random((B, R))).astype(np.float32)
+    caps = (rng.random((T, R)) * 16).astype(np.float32)
+    caps[rng.random((T, R)) < 0.1] = 0.0  # types lacking a resource entirely
+    prices = (rng.random((T,)) * 4 + 0.1).astype(np.float32)
+    allowed = rng.random((B, T)) > 0.3
+    return sum_req, max_req, caps, prices, allowed
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("shape", [(1, 1, 1), (3, 7, 2), (16, 100, 4), (53, 500, 8), (64, 512, 8)])
+def test_pallas_matches_jnp(seed, shape):
+    B, T, R = shape
+    rng = np.random.default_rng(seed * 1000 + B)
+    sum_req, max_req, caps, prices, allowed = _random_problem(rng, B, T, R)
+    stats = np.stack([sum_req, max_req])
+
+    want = np.asarray(bucket_type_cost_packed(jnp.asarray(stats), jnp.asarray(caps), jnp.asarray(prices), jnp.asarray(allowed)))
+    got = np.asarray(bucket_type_cost_pallas(stats, caps, prices, allowed))
+
+    assert got.shape == want.shape == (3, B)
+    np.testing.assert_array_equal(got[2], want[2], err_msg="feasible mismatch")
+    feasible = want[2].astype(bool)
+    np.testing.assert_array_equal(got[0][feasible], want[0][feasible], err_msg="tstar mismatch")
+    np.testing.assert_array_equal(got[1][feasible], want[1][feasible], err_msg="bins mismatch")
+
+
+def test_price_ties_break_to_first_index():
+    # two identical cheapest types: both paths must pick the lower index
+    B, T, R = 4, 8, 2
+    sum_req = np.full((B, R), 2.0, np.float32)
+    max_req = np.full((B, R), 1.0, np.float32)
+    caps = np.full((T, R), 4.0, np.float32)
+    prices = np.full((T,), 1.0, np.float32)
+    allowed = np.ones((B, T), bool)
+    stats = np.stack([sum_req, max_req])
+    got = np.asarray(bucket_type_cost_pallas(stats, caps, prices, allowed))
+    want = np.asarray(bucket_type_cost_packed(jnp.asarray(stats), jnp.asarray(caps), jnp.asarray(prices), jnp.asarray(allowed)))
+    np.testing.assert_array_equal(got[0], want[0])
+    assert (got[0] == 0).all()
+
+
+def test_infeasible_bucket_reported():
+    B, T, R = 2, 4, 2
+    sum_req = np.array([[100.0, 100.0], [1.0, 1.0]], np.float32)
+    max_req = np.array([[100.0, 100.0], [1.0, 1.0]], np.float32)  # pod too big for any type
+    caps = np.full((T, R), 4.0, np.float32)
+    prices = np.ones((T,), np.float32)
+    allowed = np.ones((B, T), bool)
+    got = np.asarray(bucket_type_cost_pallas(np.stack([sum_req, max_req]), caps, prices, allowed))
+    assert got[2, 0] == 0 and got[2, 1] == 1
