@@ -53,8 +53,15 @@ class TopologyGroup:
         self.namespaces = set(namespaces)
         self.selector = selector
         self.max_skew = max_skew
-        self.domains: Dict[str, int] = {domain: 0 for domain in (domains or ())}
+        # sorted for determinism: the domain universe arrives as a set, and
+        # selection order must not depend on hash seeds
+        self.domains: Dict[str, int] = {domain: 0 for domain in sorted(domains or ())}
         self.owners: Set[str] = set()  # pod UIDs governed by this group
+        # rotates among equal-min-count domains so a pod whose chosen domain
+        # proves infeasible (e.g. no offering for that zone x capacity-type
+        # pair) explores the other ties on retry — the deterministic
+        # counterpart of the reference's randomized Go map iteration
+        self._tie_rotation = 0
         if topology_type == TopologyType.SPREAD and pod is not None:
             self.node_filter = TopologyNodeFilter.for_spread(pod)
         else:
@@ -111,7 +118,7 @@ class TopologyGroup:
     def _next_domain_spread(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
         global_min = self._domain_min_count(pod_domains)
         self_selecting = self.selects(pod)
-        min_domain = None
+        candidates: list = []
         min_count = MAX_INT32
         for domain in self.domains:
             if node_domains.has(domain):
@@ -119,12 +126,17 @@ class TopologyGroup:
                 if self_selecting:
                     count += 1
                 # kube-scheduler skew rule: count - global_min <= maxSkew
-                if count - global_min <= self.max_skew and count < min_count:
-                    min_domain = domain
-                    min_count = count
-        if min_domain is None:
+                if count - global_min <= self.max_skew:
+                    if count < min_count:
+                        min_count = count
+                        candidates = [domain]
+                    elif count == min_count:
+                        candidates.append(domain)
+        if not candidates:
             return Requirement(self.key, OP_DOES_NOT_EXIST)
-        return Requirement(self.key, OP_IN, min_domain)
+        choice = candidates[self._tie_rotation % len(candidates)]
+        self._tie_rotation += 1
+        return Requirement(self.key, OP_IN, choice)
 
     def _domain_min_count(self, domains: Requirement) -> int:
         # hostname topologies can always mint a fresh (zero-count) domain

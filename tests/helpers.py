@@ -150,6 +150,38 @@ def make_provisioner(
     return Provisioner(metadata=ObjectMeta(name=name, namespace=""), spec=spec)
 
 
+def make_state_node(
+    node: Optional[Node] = None,
+    provisioner: str = "default",
+    available: Optional[Dict[str, object]] = None,
+    daemonset_requested: Optional[Dict[str, object]] = None,
+    **node_kwargs,
+):
+    """A cluster-state node view for scheduler in-flight tests — the minimal
+    StateNode surface ExistingNodeView consumes (controllers/state/cluster.py)."""
+    from karpenter_tpu.api.labels import PROVISIONER_NAME_LABEL
+    from karpenter_tpu.scheduling.hostports import HostPortUsage
+    from karpenter_tpu.scheduling.volumelimits import VolumeCount, VolumeLimits
+
+    if node is None:
+        labels = dict(node_kwargs.pop("labels", {}) or {})
+        if provisioner is not None:
+            labels.setdefault(PROVISIONER_NAME_LABEL, provisioner)
+        node = make_node(labels=labels, **node_kwargs)
+
+    class _StateNode:
+        pass
+
+    state = _StateNode()
+    state.node = node
+    state.available = _parse_resources(available) if available is not None else dict(node.status.allocatable)
+    state.daemonset_requested = _parse_resources(daemonset_requested)
+    state.host_port_usage = HostPortUsage()
+    state.volume_usage = VolumeLimits()
+    state.volume_limits = VolumeCount()
+    return state
+
+
 def make_node(
     name: str = "",
     labels: Optional[Dict[str, str]] = None,

@@ -1,0 +1,303 @@
+"""Extended pod affinity / anti-affinity scenarios.
+
+Catalog drawn from the reference's Pod Affinity/Anti-Affinity context
+(suite_test.go:1798-2793): empty terms, arch-keyed topologies, self-affinity
+bootstrap, preferred-term violations, inverse anti-affinity, namespace
+filtering, dependent chains, and zone-topology interactions.
+"""
+
+from collections import Counter
+
+from karpenter_tpu.api.labels import LABEL_ARCH, LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    NodeSelectorRequirement,
+    OP_IN,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from tests.helpers import make_pod, make_pods, make_provisioner
+from tests.test_scheduler import expect_not_scheduled, expect_scheduled, node_of, schedule
+
+
+def zone_of(node):
+    if hasattr(node, "template"):
+        return next(iter(node.template.requirements.get(LABEL_TOPOLOGY_ZONE).values))
+    return node.node.metadata.labels[LABEL_TOPOLOGY_ZONE]
+
+
+def affinity_term(key, labels, namespaces=None, namespace_selector=None):
+    kwargs = {}
+    if namespaces:
+        kwargs["namespaces"] = namespaces
+    if namespace_selector is not None:
+        kwargs["namespace_selector"] = namespace_selector
+    return PodAffinityTerm(topology_key=key, label_selector=LabelSelector(match_labels=labels), **kwargs)
+
+
+class TestAffinityBasics:
+    def test_empty_affinity_objects_schedule(self):
+        # reference: "should schedule a pod with empty pod affinity and anti-affinity"
+        pod = make_pod(requests={"cpu": "1"})
+        pod.spec.affinity = Affinity(pod_affinity=PodAffinity(), pod_anti_affinity=PodAntiAffinity())
+        results = schedule([pod])
+        expect_scheduled(results, pod)
+
+    def test_affinity_on_arch_topology(self):
+        # reference: "should respect pod affinity (arch)" — affinity pod lands
+        # on the same arch domain as its target
+        target = make_pod(
+            labels={"security": "s2"},
+            requests={"cpu": "1"},
+            node_requirements=[NodeSelectorRequirement(LABEL_ARCH, OP_IN, ["arm64"])],
+        )
+        follower = make_pod(requests={"cpu": "1"}, pod_requirements=[affinity_term(LABEL_ARCH, {"security": "s2"})])
+        results = schedule([target, follower])
+        t_node = expect_scheduled(results, target)
+        f_node = expect_scheduled(results, follower)
+        t_arch = next(iter(t_node.template.requirements.get(LABEL_ARCH).values))
+        f_arch = next(iter(f_node.template.requirements.get(LABEL_ARCH).values))
+        assert t_arch == f_arch == "arm64"
+
+    def test_affinity_to_nonexistent_pod_fails(self):
+        # reference: "should not schedule pods with affinity to a non-existent pod"
+        pod = make_pod(requests={"cpu": "1"}, pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"no": "such-pod"})])
+        results = schedule([pod])
+        expect_not_scheduled(results, pod)
+
+    def test_affinity_zone_constrained_target(self):
+        # reference: "should support pod affinity with zone topology (constrained target)"
+        target = make_pod(
+            labels={"security": "s2"},
+            requests={"cpu": "1"},
+            node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-3"])],
+        )
+        followers = make_pods(4, requests={"cpu": "1"}, pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"})])
+        results = schedule([target] + followers)
+        for p in [target] + followers:
+            assert zone_of(expect_scheduled(results, p)) == "test-zone-3"
+
+
+class TestSelfAffinity:
+    def test_self_affinity_hostname_single_node(self):
+        # reference: "should respect self pod affinity (hostname)" — the whole
+        # cohort shares one node
+        pods = [
+            make_pod(labels={"app": "db"}, requests={"cpu": "0.5"}, pod_requirements=[affinity_term(LABEL_HOSTNAME, {"app": "db"})])
+            for _ in range(3)
+        ]
+        results = schedule(pods)
+        nodes = {id(expect_scheduled(results, p)) for p in pods}
+        assert len(nodes) == 1
+
+    def test_self_affinity_zone_single_zone(self):
+        # reference: "should respect self pod affinity (zone)"
+        pods = [
+            make_pod(labels={"app": "db"}, requests={"cpu": "0.5"}, pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "db"})])
+            for _ in range(3)
+        ]
+        results = schedule(pods)
+        zones = {zone_of(expect_scheduled(results, p)) for p in pods}
+        assert len(zones) == 1
+
+    def test_self_affinity_zone_with_constraint(self):
+        # reference: "should respect self pod affinity (zone w/ constraint)" —
+        # the cohort zone must be the constrained one
+        pods = [
+            make_pod(
+                labels={"app": "db"},
+                requests={"cpu": "0.5"},
+                node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-3"])],
+                pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "db"})],
+            )
+            for _ in range(3)
+        ]
+        results = schedule(pods)
+        zones = {zone_of(expect_scheduled(results, p)) for p in pods}
+        assert zones == {"test-zone-3"}
+
+
+class TestPreferredViolations:
+    def test_preferred_affinity_violated_when_impossible(self):
+        # reference: "should allow violation of preferred pod affinity" — a
+        # preference pointing at nothing must not block scheduling
+        pref = WeightedPodAffinityTerm(weight=50, pod_affinity_term=affinity_term(LABEL_TOPOLOGY_ZONE, {"no": "match"}))
+        pod = make_pod(requests={"cpu": "1"}, pod_preferences=[pref])
+        results = schedule([pod])
+        expect_scheduled(results, pod)
+
+    def test_preferred_anti_affinity_violated_when_necessary(self):
+        # reference: "should allow violation of preferred pod anti-affinity" —
+        # preferred anti-affinity against an existing spread still schedules
+        spread_pods = [
+            make_pod(
+                labels={"app": "web"},
+                requests={"cpu": "1"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"})
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        anti = make_pod(
+            requests={"cpu": "1"},
+            pod_anti_preferences=[WeightedPodAffinityTerm(weight=50, pod_affinity_term=affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "web"}))],
+        )
+        results = schedule(spread_pods + [anti])
+        for p in spread_pods + [anti]:
+            expect_scheduled(results, p)
+
+    def test_conflicting_required_wins_over_preference(self):
+        # reference: "should allow violation of a pod affinity preference with
+        # a conflicting required constraint"
+        target = make_pod(labels={"security": "s2"}, requests={"cpu": "1"},
+                          node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])])
+        pref = WeightedPodAffinityTerm(weight=50, pod_affinity_term=affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"}))
+        follower = make_pod(
+            requests={"cpu": "1"},
+            node_requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-2"])],
+            pod_preferences=[pref],
+        )
+        results = schedule([target, follower])
+        assert zone_of(expect_scheduled(results, target)) == "test-zone-1"
+        assert zone_of(expect_scheduled(results, follower)) == "test-zone-2"
+
+
+class TestAntiAffinity:
+    def test_anti_affinity_zone_blocks_later_pods(self):
+        # reference: "should not violate pod anti-affinity on zone" — three
+        # zone-pinned anti-affinity pods take the three zones; an unpinned
+        # fourth sharing the label has no free zone (its own placement would
+        # count everywhere it *could* land)
+        pinned = [
+            make_pod(
+                labels={"app": "db"},
+                requests={"cpu": "2"},
+                node_selector={LABEL_TOPOLOGY_ZONE: f"test-zone-{i + 1}"},
+                pod_anti_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "db"})],
+            )
+            for i in range(3)
+        ]
+        extra = make_pod(labels={"app": "db"}, requests={"cpu": "0.5"},
+                         pod_anti_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "db"})])
+        results = schedule(pinned + [extra])
+        zones = Counter(zone_of(expect_scheduled(results, p)) for p in pinned)
+        assert all(v == 1 for v in zones.values()) and len(zones) == 3
+        expect_not_scheduled(results, extra)
+
+    def test_anti_affinity_arch(self):
+        # reference: "should not violate pod anti-affinity (arch)"
+        # (suite_test.go:2197) — the target pins arm64; the anti pod must land
+        # on the other arch
+        target = make_pod(
+            labels={"security": "s2"},
+            requests={"cpu": "2"},
+            node_selector={LABEL_ARCH: "arm64"},
+        )
+        anti = make_pod(requests={"cpu": "1"}, pod_anti_requirements=[affinity_term(LABEL_ARCH, {"security": "s2"})])
+        results = schedule([target, anti])
+        t_node = expect_scheduled(results, target)
+        a_node = expect_scheduled(results, anti)
+        t_arch = next(iter(t_node.template.requirements.get(LABEL_ARCH).values))
+        a_arch = next(iter(a_node.template.requirements.get(LABEL_ARCH).values))
+        assert t_arch == "arm64" and a_arch != t_arch
+
+    def test_inverse_anti_affinity_blocks_new_pod(self):
+        # reference: "should not violate pod anti-affinity on zone (inverse)"
+        # (suite_test.go:2280) — zone-pinned pods with anti-affinity to a
+        # label occupy every zone; a pod wearing that label cannot schedule
+        anti_pods = [
+            make_pod(
+                requests={"cpu": "2"},
+                node_selector={LABEL_TOPOLOGY_ZONE: f"test-zone-{i + 1}"},
+                pod_anti_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"})],
+            )
+            for i in range(3)
+        ]
+        labeled = make_pod(labels={"security": "s2"}, requests={"cpu": "0.5"})
+        results = schedule(anti_pods + [labeled])
+        for p in anti_pods:
+            expect_scheduled(results, p)
+        expect_not_scheduled(results, labeled)
+
+    def test_anti_affinity_zone_with_spread_topology(self):
+        # reference: "should support pod anti-affinity with a zone topology" —
+        # anti-affinity on zone with a zonal spread on the same label set
+        pods = [
+            make_pod(
+                labels={"app": "solo"},
+                requests={"cpu": "0.5"},
+                pod_anti_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"app": "solo"})],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "solo"})
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        results = schedule(pods)
+        zones = Counter(zone_of(node_of(results, p)) for p in pods if p not in results.unschedulable)
+        assert all(v == 1 for v in zones.values())
+
+
+class TestNamespaceFiltering:
+    def test_affinity_ignores_other_namespaces_by_default(self):
+        # reference: "should filter pod affinity topologies by namespace, no
+        # matching pods" — a same-labeled pod in another namespace doesn't count
+        target = make_pod(namespace="other", labels={"security": "s2"}, requests={"cpu": "1"})
+        follower = make_pod(
+            namespace="default", requests={"cpu": "1"}, pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"})]
+        )
+        results = schedule([target, follower])
+        expect_scheduled(results, target)
+        expect_not_scheduled(results, follower)
+
+    def test_affinity_matches_listed_namespace(self):
+        # reference: "...matching pods namespace list" — the target must be
+        # zone-pinned to count (an open zone is never a committed domain)
+        target = make_pod(
+            namespace="other", labels={"security": "s2"}, requests={"cpu": "1"},
+            node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"},
+        )
+        follower = make_pod(
+            namespace="default",
+            requests={"cpu": "1"},
+            pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"}, namespaces=["other"])],
+        )
+        results = schedule([target, follower])
+        t_zone = zone_of(expect_scheduled(results, target))
+        f_zone = zone_of(expect_scheduled(results, follower))
+        assert t_zone == f_zone == "test-zone-2"
+
+
+class TestDependentChains:
+    def test_multiple_dependent_affinities(self):
+        # reference: "should handle multiple dependent affinities"
+        a = make_pod(labels={"d": "a"}, requests={"cpu": "0.2"})
+        b = make_pod(labels={"d": "b"}, requests={"cpu": "0.2"}, pod_requirements=[affinity_term(LABEL_HOSTNAME, {"d": "a"})])
+        c = make_pod(labels={"d": "c"}, requests={"cpu": "0.2"}, pod_requirements=[affinity_term(LABEL_HOSTNAME, {"d": "b"})])
+        d = make_pod(labels={"d": "d"}, requests={"cpu": "0.2"}, pod_requirements=[affinity_term(LABEL_HOSTNAME, {"d": "c"})])
+        results = schedule([d, c, b, a])
+        nodes = {id(expect_scheduled(results, p)) for p in (a, b, c, d)}
+        assert len(nodes) == 1
+
+    def test_affinity_zone_unconstrained_target_defers(self):
+        # reference: "should support pod affinity with zone topology
+        # (unconstrained target)" (suite_test.go:2549) — in the SAME batch the
+        # target's zone is undetermined (its node keeps all zones open), so
+        # followers cannot schedule; they succeed on the next solve once the
+        # target's zone is committed
+        target = make_pod(labels={"security": "s2"}, requests={"cpu": "1"})
+        followers = make_pods(5, requests={"cpu": "1"}, pod_requirements=[affinity_term(LABEL_TOPOLOGY_ZONE, {"security": "s2"})])
+        results = schedule([target] + followers)
+        expect_scheduled(results, target)
+        for p in followers:
+            expect_not_scheduled(results, p)

@@ -1,0 +1,233 @@
+"""In-flight / existing node scheduling scenarios.
+
+Catalog drawn from the reference's In-Flight Nodes suite
+(pkg/controllers/provisioning/scheduling/suite_test.go:3494-4034): reuse
+before launch, fit and compatibility limits, terminating/tainted node
+handling, startup-taint assumptions, topology interaction, and daemonset
+headroom on in-flight nodes.
+"""
+
+from karpenter_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE,
+    LABEL_NODE_INITIALIZED,
+    LABEL_TOPOLOGY_ZONE,
+    PROVISIONER_NAME_LABEL,
+    TAINT_NODE_NOT_READY,
+    TAINT_NODE_UNREACHABLE,
+)
+from karpenter_tpu.api.objects import (
+    NO_SCHEDULE,
+    LabelSelector,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_type
+from karpenter_tpu.scheduler import build_scheduler
+from tests.helpers import make_pod, make_pods, make_provisioner, make_state_node
+
+from tests.test_scheduler import expect_not_scheduled, expect_scheduled, node_of
+
+
+def schedule(pods, state_nodes=(), provisioners=None, provider=None, **kwargs):
+    provisioners = provisioners or [make_provisioner()]
+    provider = provider or FakeCloudProvider()
+    scheduler = build_scheduler(provisioners, provider, pods, state_nodes=state_nodes, **kwargs)
+    return scheduler.solve(pods)
+
+
+def base_labels(**extra):
+    labels = {
+        PROVISIONER_NAME_LABEL: "default",
+        LABEL_INSTANCE_TYPE: "default-instance-type",
+        LABEL_TOPOLOGY_ZONE: "test-zone-1",
+        LABEL_CAPACITY_TYPE: "on-demand",
+    }
+    labels.update(extra)
+    return labels
+
+
+class TestInFlightReuse:
+    def test_no_second_node_when_inflight_fits(self):
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert not results.new_nodes, "should reuse the in-flight node"
+        assert results.existing_nodes[0].pods == [pod]
+
+    def test_inflight_reused_with_matching_node_selector(self):
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"}, node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert not results.new_nodes
+
+    def test_second_node_when_pod_does_not_fit(self):
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "2", "memory": "4Gi", "pods": "10"})
+        pod = make_pod(requests={"cpu": "8"})
+        results = schedule([pod], state_nodes=[state])
+        node = expect_scheduled(results, pod)
+        assert results.new_nodes == [node]
+
+    def test_second_node_when_node_selector_incompatible(self):
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"}, node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+        results = schedule([pod], state_nodes=[state])
+        node = expect_scheduled(results, pod)
+        assert results.new_nodes == [node]
+
+    def test_unowned_node_not_considered(self):
+        # a node without the provisioner label was not launched by us
+        labels = base_labels()
+        del labels[PROVISIONER_NAME_LABEL]
+        state = make_state_node(labels=labels, provisioner=None, allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert len(results.new_nodes) == 1
+
+    def test_inflight_packed_before_launch(self):
+        # reference: "should pack in-flight nodes before launching new nodes"
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "4", "memory": "16Gi", "pods": "110"})
+        pods = make_pods(6, requests={"cpu": "1"})
+        results = schedule(pods, state_nodes=[state])
+        for p in pods:
+            expect_scheduled(results, p)
+        assert len(results.existing_nodes[0].pods) == 4
+        assert sum(len(n.pods) for n in results.new_nodes) == 2
+
+    def test_excluded_node_not_used(self):
+        from karpenter_tpu.scheduler import SchedulerOptions
+
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state], opts=SchedulerOptions(exclude_nodes=[state.node.name]))
+        expect_scheduled(results, pod)
+        assert len(results.new_nodes) == 1
+
+
+class TestInFlightTaints:
+    def test_tainted_inflight_not_assumed(self):
+        state = make_state_node(
+            labels=base_labels(), taints=[Taint(key="team", value="a", effect=NO_SCHEDULE)],
+            allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert len(results.new_nodes) == 1, "intolerant pod must not assume the tainted node"
+
+    def test_tainted_inflight_used_when_tolerated(self):
+        state = make_state_node(
+            labels=base_labels(), taints=[Taint(key="team", value="a", effect=NO_SCHEDULE)],
+            allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        pod = make_pod(requests={"cpu": "1"}, tolerations=[Toleration(key="team", operator="Equal", value="a", effect=NO_SCHEDULE)])
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert not results.new_nodes
+
+    def test_startup_taint_assumed_before_initialization(self):
+        # reference: "should assume pod will schedule to a tainted node with a
+        # custom startup taint" — the kubelet will remove it
+        startup = Taint(key="initializing", effect=NO_SCHEDULE)
+        prov = make_provisioner(startup_taints=[startup])
+        state = make_state_node(labels=base_labels(), taints=[startup], allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state], provisioners=[prov])
+        expect_scheduled(results, pod)
+        assert not results.new_nodes
+
+    def test_startup_taint_respected_after_initialization(self):
+        # after initialization the taint is no longer ephemeral: someone else
+        # re-applied it deliberately (existingnode.go:76-84)
+        startup = Taint(key="initializing", effect=NO_SCHEDULE)
+        prov = make_provisioner(startup_taints=[startup])
+        state = make_state_node(
+            labels=base_labels(**{LABEL_NODE_INITIALIZED: "true"}),
+            taints=[startup],
+            allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state], provisioners=[prov])
+        expect_scheduled(results, pod)
+        assert len(results.new_nodes) == 1
+
+    def test_not_ready_taint_is_ephemeral(self):
+        # reference: "should consider a tainted NotReady node as in-flight"
+        state = make_state_node(
+            labels=base_labels(),
+            taints=[
+                Taint(key=TAINT_NODE_NOT_READY, effect=NO_SCHEDULE),
+                Taint(key=TAINT_NODE_UNREACHABLE, effect=NO_SCHEDULE),
+            ],
+            allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        pod = make_pod(requests={"cpu": "1"})
+        results = schedule([pod], state_nodes=[state])
+        expect_scheduled(results, pod)
+        assert not results.new_nodes
+
+
+class TestInFlightTopology:
+    def test_zonal_spread_counts_inflight(self):
+        # an in-flight node in zone-1 biases new spread pods to other zones;
+        # domain counts come from recorded topology state
+        spread = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"app": "web"})
+        )
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pods = [make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread_constraints=[spread]) for _ in range(6)]
+        results = schedule(pods, state_nodes=[state])
+        zones = {}
+        for p in pods:
+            node = expect_scheduled(results, p)
+            if hasattr(node, "template"):
+                zone = next(iter(node.template.requirements.get(LABEL_TOPOLOGY_ZONE).values))
+            else:
+                zone = node.node.metadata.labels[LABEL_TOPOLOGY_ZONE]
+            zones[zone] = zones.get(zone, 0) + 1
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert set(zones) == {"test-zone-1", "test-zone-2", "test-zone-3"}
+
+    def test_hostname_spread_counts_inflight(self):
+        spread = TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"app": "web"})
+        )
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"})
+        pods = [make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread_constraints=[spread]) for _ in range(4)]
+        results = schedule(pods, state_nodes=[state])
+        used_existing = sum(1 for p in pods if node_of(results, p) in results.existing_nodes)
+        # the in-flight hostname is one domain; per-hostname max skew 1 means
+        # each host holds at most one more than the emptiest
+        assert used_existing >= 1
+        for n in results.new_nodes:
+            assert len(n.pods) <= 1 + min(len(m.pods) for m in results.new_nodes)
+
+
+class TestInFlightDaemonOverhead:
+    def test_daemon_headroom_reserved(self):
+        # expected daemon resources not yet bound reduce what pods may take
+        ds = make_pod(requests={"cpu": "2"})
+        state = make_state_node(labels=base_labels(), allocatable={"cpu": "4", "memory": "16Gi", "pods": "110"})
+        pods = make_pods(4, requests={"cpu": "1"})
+        results = schedule(pods, state_nodes=[state], daemonset_pods=[ds])
+        # only 2 cpu of headroom remain on the in-flight node
+        assert len(results.existing_nodes[0].pods) == 2
+
+    def test_daemon_already_bound_not_double_counted(self):
+        ds = make_pod(requests={"cpu": "2"})
+        state = make_state_node(
+            labels=base_labels(),
+            allocatable={"cpu": "4", "memory": "16Gi", "pods": "110"},
+            daemonset_requested={"cpu": "2"},
+        )
+        # the daemon pod already bound: its usage is in daemonset_requested and
+        # (in real state) deducted from available; remaining headroom is zero
+        state.available = {"cpu": 2.0, "memory": 16 * 2**30, "pods": 109.0}
+        pods = make_pods(4, requests={"cpu": "1"})
+        results = schedule(pods, state_nodes=[state], daemonset_pods=[ds])
+        assert len(results.existing_nodes[0].pods) == 2
