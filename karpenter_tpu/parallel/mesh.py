@@ -25,12 +25,26 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1) -> Mesh:
+def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer_cpu: bool = False) -> Mesh:
     """Build a (pods x types) mesh over the first n devices.
 
     types_parallel devices shard the type axis; the rest shard pods.
+
+    prefer_cpu checks the host CPU backend FIRST — the virtual-multi-device
+    dryrun path, where the default backend may be a single tunneled TPU chip
+    that is slow (or broken) to initialize and must not be touched when the
+    forced CPU device count already satisfies the request.
     """
-    devices = jax.devices()
+    devices = None
+    if prefer_cpu and n_devices:
+        try:
+            cpu_devices = jax.devices("cpu")
+            if len(cpu_devices) >= n_devices:
+                devices = cpu_devices
+        except RuntimeError:
+            devices = None
+    if devices is None:
+        devices = jax.devices()
     n = n_devices or len(devices)
     if len(devices) < n:
         # The default backend (e.g. a single tunneled TPU chip) may have fewer
@@ -52,6 +66,15 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1) -> Mes
         raise ValueError(f"{n} devices not divisible by types_parallel={types_parallel}")
     grid = devices.reshape(n // types_parallel, types_parallel)
     return Mesh(grid, axis_names=("pods", "types"))
+
+
+def default_mesh(n_devices: int, prefer_cpu: bool = False) -> Mesh:
+    """The production mesh shape for n devices: 2-way types-parallel when the
+    count allows (argmin-combine traffic over the types axis is tiny), the
+    rest pods-parallel. Both the solver auto-detect and the driver dryrun use
+    this, so the dryrun always validates the shape production runs."""
+    types_parallel = 2 if n_devices % 2 == 0 and n_devices >= 4 else 1
+    return solver_mesh(n_devices, types_parallel=types_parallel, prefer_cpu=prefer_cpu)
 
 
 def pod_sharding(mesh: Mesh) -> NamedSharding:

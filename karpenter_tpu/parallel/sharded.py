@@ -90,3 +90,46 @@ def make_sharded_solve_step(mesh: Mesh, num_bins: int):
 def sharded_solve_step(mesh: Mesh, requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids, num_bins: int):
     fn = make_sharded_solve_step(mesh, num_bins)
     return fn(requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids)
+
+
+def place(mesh: Mesh, array, spec: P):
+    """device_put onto the mesh's own devices.
+
+    Never use default-device jnp.asarray for mesh inputs: when the mesh is a
+    CPU fallback (virtual multi-device dryrun) the default backend may be a
+    single — or broken — TPU client, and a default placement either lands on
+    the wrong device set or fails outright before the sharded program runs.
+    """
+    return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+@lru_cache(maxsize=8)
+def make_sharded_bucket_cost(mesh: Mesh):
+    """The PRODUCTION multi-chip dispatch: bucket->type cost choice sharded
+    over the (pods x types) mesh.
+
+    Same math and packed [3, B] result as ops/feasibility.py:
+    bucket_type_cost_packed — the bucket axis rides the "pods" mesh axis
+    (data parallel), the instance-type axis rides "types" (model parallel),
+    and the per-bucket argmin over types becomes an XLA cross-shard argmin
+    combine over ICI. DenseSolver routes its device dispatch here whenever
+    more than one device is visible; shapes are padded by the caller to mesh
+    divisibility (padded types carry allowed=False and zero caps, so they can
+    never win the argmin; padded buckets report infeasible and are trimmed).
+    """
+    from ..ops.feasibility import bucket_type_cost_packed
+
+    in_shardings = (
+        NamedSharding(mesh, P(None, "pods", None)),  # bucket_stats [2, B, R]
+        type_sharding(mesh),  # caps [T, R]
+        type_sharding(mesh),  # prices [T]
+        NamedSharding(mesh, P("pods", "types")),  # allowed [B, T]
+    )
+
+    # the body IS the single-device program (one definition of the cost
+    # formula — ops/feasibility.py); only the shardings are new here
+    @partial(jax.jit, in_shardings=in_shardings, out_shardings=replicated(mesh))
+    def bucket_cost(bucket_stats, caps, prices, allowed):
+        return bucket_type_cost_packed(bucket_stats, caps, prices, allowed)
+
+    return bucket_cost

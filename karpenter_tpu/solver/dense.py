@@ -27,11 +27,14 @@ protocol enforces.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
@@ -70,6 +73,7 @@ class DenseSolveStats:
     pods_committed: int = 0
     pods_to_host: int = 0
     nodes_created: int = 0
+    sharded_batches: int = 0  # batches dispatched over a multi-device mesh
     encode_seconds: float = 0.0
     device_seconds: float = 0.0
     commit_seconds: float = 0.0
@@ -92,7 +96,7 @@ class DenseSolver:
     # (None = not probed yet; flips False permanently on any failure)
     _pallas_ok: Optional[bool] = None
 
-    def __init__(self, min_batch: int = 32, num_slots: int = 8):
+    def __init__(self, min_batch: int = 32, num_slots: int = 8, mesh=None):
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
@@ -104,8 +108,46 @@ class DenseSolver:
         native.load()
         # per-catalog device arrays (caps/prices), uploaded once and reused
         # across solves — host->device transfers over the tunnel are the
-        # dominant per-dispatch cost, so only per-batch data moves per solve
-        self._device_catalog: Dict[tuple, tuple] = {}
+        # dominant per-dispatch cost, so only per-batch data moves per solve.
+        # Keyed per path flavor ("plain" | "pallas" | "sharded"), a few
+        # catalogs resident per flavor (multi-provisioner alternation), and
+        # eviction is per-flavor so a path flip (pallas retirement, env
+        # toggle) never evicts the other flavor of the same catalog.
+        self._device_catalog: Dict[str, Dict[tuple, tuple]] = {}
+        self._catalogs_per_flavor = 4
+        # explicit mesh wins; otherwise auto-detect on first device solve
+        self._mesh = mesh
+        self._mesh_checked = mesh is not None
+
+    def _active_mesh(self):
+        """The (pods x types) device mesh when >1 device is visible.
+
+        Multi-chip is the production path on pods/slices: the bucket->type
+        cost surface shards over (buckets, types) and XLA carries the argmin
+        combines over ICI (parallel/sharded.py). KARPENTER_TPU_MESH=0
+        disables; an integer value forces that device count (used by the
+        virtual-device dryrun).
+        """
+        if self._mesh_checked:
+            return self._mesh
+        self._mesh_checked = True
+        import os
+
+        setting = os.environ.get("KARPENTER_TPU_MESH", "")
+        if setting == "0":
+            return None
+        try:
+            import jax
+
+            from ..parallel.mesh import default_mesh
+
+            n = int(setting) if setting else len(jax.devices())
+            if n > 1:
+                self._mesh = default_mesh(n)
+        except Exception as exc:  # mesh is an optimization; never break solving
+            log.warning("solver mesh unavailable, staying single-device: %s", exc)
+            self._mesh = None
+        return self._mesh
 
     # -- Scheduler hook ------------------------------------------------------
 
@@ -354,7 +396,8 @@ class DenseSolver:
         from ..ops.feasibility import bucket_type_cost_packed
 
         B = len(buckets)
-        use_pallas = self._pallas_enabled()
+        mesh = self._active_mesh()
+        use_pallas = mesh is None and self._pallas_enabled()
         zone_index = {z: i for i, z in enumerate(problem.zones)}
         ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
 
@@ -382,43 +425,76 @@ class DenseSolver:
 
         bucket_stats = np.stack([sum_req, max_req]).astype(np.float32)  # [2, B, R]
 
-        # per-catalog device arrays are uploaded once and cached keyed by
-        # (content, path); one catalog is resident at a time per path flavor
-        def _catalog(flavor: bool):
-            key = (caps_eff.tobytes(), problem.prices.tobytes(), flavor)
-            catalog = self._device_catalog.get(key)
-            if catalog is None:
-                if flavor:
-                    from ..ops.pallas_kernels import pad_catalog
+        # per-catalog device arrays are uploaded once and cached (a few per
+        # flavor; eviction is per-flavor — see __init__)
+        def _catalog(flavor: str):
+            key = (caps_eff.tobytes(), problem.prices.tobytes())
+            flavor_cache = self._device_catalog.setdefault(flavor, {})
+            cached = flavor_cache.get(key)
+            if cached is not None:
+                return cached
+            if flavor == "pallas":
+                from ..ops.pallas_kernels import pad_catalog
 
-                    caps_t, prices_p = pad_catalog(caps_eff.astype(np.float32), problem.prices.astype(np.float32))
-                    catalog = (jnp.asarray(caps_t), jnp.asarray(prices_p))
-                else:
-                    catalog = (jnp.asarray(caps_eff, dtype=jnp.float32), jnp.asarray(problem.prices, dtype=jnp.float32))
-                if len(self._device_catalog) > 2:  # keep at most both flavors of one catalog
-                    self._device_catalog.clear()
-                self._device_catalog[key] = catalog
+                caps_t, prices_p = pad_catalog(caps_eff.astype(np.float32), problem.prices.astype(np.float32))
+                catalog = (jnp.asarray(caps_t), jnp.asarray(prices_p))
+            elif flavor == "sharded":
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.sharded import place
+
+                types_dim = mesh.shape["types"]
+                Tp = -(-problem.T // types_dim) * types_dim
+                caps_p = np.zeros((Tp, caps_eff.shape[1]), np.float32)
+                caps_p[: problem.T] = caps_eff
+                prices_p = np.zeros((Tp,), np.float32)
+                prices_p[: problem.T] = problem.prices
+                catalog = (place(mesh, caps_p, P("types", None)), place(mesh, prices_p, P("types")))
+            else:
+                catalog = (jnp.asarray(caps_eff, dtype=jnp.float32), jnp.asarray(problem.prices, dtype=jnp.float32))
+            while len(flavor_cache) >= self._catalogs_per_flavor:
+                flavor_cache.pop(next(iter(flavor_cache)))  # FIFO within flavor
+            flavor_cache[key] = catalog
             return catalog
 
-        def _jnp_dispatch():
-            caps_dev, prices_dev = _catalog(False)
+        def _plain_dispatch():
+            caps_dev, prices_dev = _catalog("plain")
             return bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed))
+
+        def _jnp_dispatch():
+            if mesh is not None:
+                return self._sharded_dispatch(mesh, _catalog("sharded"), bucket_stats, allowed)
+            return _plain_dispatch()
 
         if use_pallas:
             try:
                 from ..ops.pallas_kernels import bucket_type_cost_padded, pad_batch
 
-                caps_dev, prices_dev = _catalog(True)
+                caps_dev, prices_dev = _catalog("pallas")
                 sum_p, max_p, allowed_p = pad_batch(bucket_stats, allowed)
                 packed_fut = bucket_type_cost_padded(
                     jnp.asarray(sum_p), jnp.asarray(max_p), caps_dev, prices_dev, jnp.asarray(allowed_p)
                 )
-            except Exception:  # unexpected shape class the kernel can't compile
+            except Exception as exc:  # unexpected shape class the kernel can't compile
                 type(self)._pallas_ok = False
                 use_pallas = False
+                log.warning("retiring Pallas kernel (compile/dispatch failure), falling back to jnp path: %r", exc)
                 packed_fut = _jnp_dispatch()
         else:
-            packed_fut = _jnp_dispatch()
+            try:
+                packed_fut = _jnp_dispatch()
+            except Exception as exc:
+                if mesh is None:
+                    raise
+                # mesh is an optimization, never a failure mode: retire it for
+                # this solver (chip dropout, placement failure) and continue
+                # single-device
+                self._mesh = None
+                mesh = None
+                log.warning("retiring solver mesh (dispatch failure), falling back to single device: %r", exc)
+                packed_fut = _plain_dispatch()
+        if mesh is not None:
+            self.stats.sharded_batches += 1
 
         # speculate under the in-flight round trip
         prev_tstar, prev_feasible = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
@@ -434,11 +510,19 @@ class DenseSolver:
 
         try:
             packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
-        except Exception:
-            if not use_pallas:
+        except Exception as exc:
+            if use_pallas:
+                type(self)._pallas_ok = False  # runtime failure: retire the kernel
+                log.warning("retiring Pallas kernel (runtime failure), falling back to jnp path: %r", exc)
+                packed = np.asarray(_jnp_dispatch())[:, :B]
+            elif mesh is not None:
+                self._mesh = None
+                mesh = None
+                log.warning("retiring solver mesh (runtime failure), falling back to single device: %r", exc)
+                self.stats.sharded_batches -= 1
+                packed = np.asarray(_plain_dispatch())[:, :B]
+            else:
                 raise
-            type(self)._pallas_ok = False  # runtime failure: retire the kernel
-            packed = np.asarray(_jnp_dispatch())[:, :B]
         tstar, feasible = packed[0], packed[2].astype(bool)
         changed = False
         for b, bucket in enumerate(buckets):
@@ -451,6 +535,34 @@ class DenseSolver:
             sol = self._assemble(problem, buckets, local, bucket_extra)
         sol["tstar"] = tstar
         return sol
+
+    def _sharded_dispatch(self, mesh, catalog, bucket_stats: np.ndarray, allowed: np.ndarray):
+        """Dispatch the bucket->type choice over the multi-device mesh.
+
+        Pads the bucket axis to the mesh's pods dimension and the type axis
+        to the catalog's padded width, places inputs with the mesh's own
+        shardings (parallel/sharded.py:place — never default-device), and
+        runs the sharded jit. Result is packed [3, Bp]; the caller trims."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharded import make_sharded_bucket_cost, place
+
+        caps_dev, prices_dev = catalog
+        Tp = caps_dev.shape[0]
+        pods_dim = mesh.shape["pods"]
+        B = bucket_stats.shape[1]
+        Bp = max(-(-B // pods_dim) * pods_dim, pods_dim)
+        stats_p = np.zeros((2, Bp, bucket_stats.shape[2]), np.float32)
+        stats_p[:, :B] = bucket_stats
+        allowed_p = np.zeros((Bp, Tp), dtype=bool)
+        allowed_p[:B, : allowed.shape[1]] = allowed
+        fn = make_sharded_bucket_cost(mesh)
+        return fn(
+            place(mesh, stats_p, P(None, "pods", None)),
+            caps_dev,
+            prices_dev,
+            place(mesh, allowed_p, P("pods", "types")),
+        )
 
     def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray) -> dict:
         """Pure assembly + audit of the per-bucket packings: global bin ids,

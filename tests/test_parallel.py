@@ -68,3 +68,91 @@ def test_mesh_shapes():
     assert mesh.shape == {"pods": 2, "types": 4}
     with pytest.raises(ValueError):
         solver_mesh(6, types_parallel=4)
+
+
+def _mixed_workload(count=200, seed=7):
+    from karpenter_tpu.api.labels import LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm, TopologySpreadConstraint
+    from tests.helpers import make_pod
+
+    rng = np.random.default_rng(seed)
+    cpus = [0.1, 0.25, 0.5, 1.0]
+    pods = []
+    for i in range(count // 4):
+        label = {"spread": "ab"[int(rng.integers(2))]}
+        pods.append(
+            make_pod(
+                labels=label,
+                requests={"cpu": cpus[int(rng.integers(4))], "memory": "128Mi"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=label))
+                ],
+            )
+        )
+    for i in range(count // 8):
+        label = {"anti": "x"}
+        pods.append(
+            make_pod(
+                labels=label,
+                requests={"cpu": 0.25, "memory": "64Mi"},
+                pod_anti_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=label))],
+            )
+        )
+    while len(pods) < count:
+        pods.append(make_pod(requests={"cpu": cpus[int(rng.integers(4))], "memory": "256Mi"}))
+    return pods
+
+
+def _solve_layout(mesh_arg, monkeypatch):
+    """Run the production DenseSolver end-to-end; return a comparable layout."""
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_tpu.scheduler import build_scheduler
+    from karpenter_tpu.solver import DenseSolver
+    from tests.helpers import make_provisioner
+
+    if mesh_arg is None:
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "0")
+    else:
+        monkeypatch.delenv("KARPENTER_TPU_MESH", raising=False)
+    pods = _mixed_workload()
+    provider = FakeCloudProvider(instance_types(20))
+    solver = DenseSolver(min_batch=1, mesh=mesh_arg)
+    scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+    results = scheduler.solve(pods)
+    layout = sorted(
+        (n.instance_type_options[0].name(), tuple(sorted(p.name for p in n.pods))) for n in results.new_nodes
+    )
+    return layout, solver.stats
+
+
+def test_production_solver_sharded_matches_single_device(mesh, monkeypatch):
+    """The PRODUCTION DenseSolver (not the toy step) dispatched over the mesh
+    must produce the identical layout to the single-device path."""
+    layout_mesh, stats_mesh = _solve_layout(mesh, monkeypatch)
+    layout_single, stats_single = _solve_layout(None, monkeypatch)
+    assert stats_mesh.sharded_batches >= 1
+    assert stats_single.sharded_batches == 0
+    assert stats_mesh.pods_committed == stats_single.pods_committed > 0
+    # pod names differ between builds (fresh objects); compare shape of layout
+    assert [(t, len(ps)) for t, ps in layout_mesh] == [(t, len(ps)) for t, ps in layout_single]
+
+
+def test_dense_solver_autodetects_mesh(monkeypatch):
+    """With >1 visible device and no override, the solver runs sharded."""
+    from karpenter_tpu.solver import DenseSolver
+
+    monkeypatch.delenv("KARPENTER_TPU_MESH", raising=False)
+    solver = DenseSolver(min_batch=1)
+    m = solver._active_mesh()
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    assert m is not None and m.shape["pods"] * m.shape["types"] == len(jax.devices())
+
+
+def test_graft_dryrun_multichip():
+    """The driver-facing entry point runs end-to-end on the virtual mesh."""
+    import __graft_entry__ as g
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    g.dryrun_multichip(8)
