@@ -340,15 +340,20 @@ def encode_problem(
         # (relaxation returns fresh copies — preferences.py), so the signature
         # and request vector can live on the object across solves. This is
         # the incremental device-state idea from SURVEY.md §7: pending pods
-        # that survive a batch re-encode for free on the next solve.
+        # that survive a batch re-encode for free on the next solve. The
+        # cache is keyed on metadata.resource_version: live pods DO mutate
+        # between solves (kube update events, e.g. a resized pod feeding a
+        # consolidation simulation), and a stale request vector here would
+        # silently mis-place the pod.
+        version = pod.metadata.resource_version
         cached = getattr(pod, "_encode_cache", None)
-        if cached is not None:
-            sig, req_vec = cached
+        if cached is not None and cached[0] == version:
+            _, sig, req_vec = cached
         else:
             req_vec = resource_vector(res.pod_requests(pod))
             sig = constraint_signature(pod) if req_vec is not None else None
             try:
-                pod._encode_cache = (sig, req_vec)
+                pod._encode_cache = (version, sig, req_vec)
             except AttributeError:
                 pass  # slotted/frozen pod objects simply skip the cache
         if req_vec is None:

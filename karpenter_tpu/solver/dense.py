@@ -17,12 +17,19 @@ Pipeline (one call per batch, attached to Scheduler via `dense_solver=`):
                  record topology domains, so host-path pods that follow see
                  consistent counts.
 
+Existing/in-flight nodes are first-class: before opening new bins, each
+bucket fills compatible existing capacity (mirroring the host loop's
+existing-nodes-first rule, reference scheduler.go:191-195 and
+existingnode.go:97), committing through the exact ExistingNodeView.add
+protocol so any modeling drift degrades to a per-pod fallback, never an
+invalid placement. This is what makes consolidation simulations (which
+always carry existing nodes) a real consumer of the dense path.
+
 Pods whose constraints the dense IR can't express — and all pods whenever
-existing in-flight nodes, provisioner limits, or inverse anti-affinities are
-in play (round-1 scope) — return to the caller for the exact host loop.
-Correct-by-construction: the host loop re-checks nothing that was committed,
-but everything committed was verified against the same invariants the host
-protocol enforces.
+provisioner limits or populated inverse anti-affinities are in play — return
+to the caller for the exact host loop. Correct-by-construction: the host
+loop re-checks nothing that was committed, but everything committed was
+verified against the same invariants the host protocol enforces.
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
-from ..ir.encode import DenseProblem, GroupKind, encode_problem
+from ..ir.encode import DenseProblem, GroupKind, encode_problem, resource_vector
 from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
@@ -71,10 +78,12 @@ class DenseSolveStats:
     batches: int = 0
     pods_in: int = 0
     pods_committed: int = 0
+    pods_on_existing: int = 0  # subset of pods_committed placed on existing nodes
     pods_to_host: int = 0
     nodes_created: int = 0
     sharded_batches: int = 0  # batches dispatched over a multi-device mesh
     encode_seconds: float = 0.0
+    fill_seconds: float = 0.0  # existing-node fill (incl. its exact commits)
     device_seconds: float = 0.0
     commit_seconds: float = 0.0
 
@@ -157,8 +166,6 @@ class DenseSolver:
         pods = list(pods)
         if len(pods) < self.min_batch:
             return pods
-        if scheduler.existing_nodes:
-            return pods  # in-flight node fill is host-path in round 1
         if scheduler.remaining_resources:
             return pods  # provisioner limits need the sequential invariant
         # Inverse anti-affinity from *already-placed* cluster pods (non-zero
@@ -194,13 +201,27 @@ class DenseSolver:
             return leftover
 
         buckets = self._build_buckets(problem, scheduler.topology)
+        t_encoded = time.perf_counter()
+        existing_committed = 0
+        taken = None
+        if scheduler.existing_nodes:
+            existing_committed, taken = self._fill_existing(scheduler, problem, buckets)
+            buckets = [b for b in buckets if b.pod_rows]
         t1 = time.perf_counter()
-        assignment = self._device_solve(problem, buckets)
-        t2 = time.perf_counter()
-        committed, fallback_rows = self._verify_and_commit(scheduler, problem, buckets, assignment)
+        if buckets:
+            assignment = self._device_solve(problem, buckets)
+            t2 = time.perf_counter()
+            committed, fallback_rows = self._verify_and_commit(scheduler, problem, buckets, assignment, taken)
+        else:
+            t2 = time.perf_counter()
+            unassigned = np.arange(problem.P) if taken is None else np.nonzero(~taken)[0]
+            committed, fallback_rows = 0, [int(r) for r in unassigned]
+        committed += existing_committed
+        self.stats.pods_on_existing += existing_committed
         t3 = time.perf_counter()
 
-        self.stats.encode_seconds += t1 - t0
+        self.stats.encode_seconds += t_encoded - t0
+        self.stats.fill_seconds += t1 - t_encoded
         self.stats.device_seconds += t2 - t1
         self.stats.commit_seconds += t3 - t2
         leftover.extend(problem.pods[row] for row in fallback_rows)
@@ -347,6 +368,181 @@ class DenseSolver:
         counts = self._existing_counts(topology, group, lbl.LABEL_TOPOLOGY_ZONE, allowed)
         populated = [z for z, c in zip(allowed, counts) if c > 0]
         return populated[0] if populated else allowed[0]
+
+    # -- step 2.5: fill existing/in-flight node capacity ----------------------
+
+    def _view_accepts(self, group, view) -> bool:
+        """Exact host-algebra gate: can this group's constraint shape land on
+        this existing node at all (taints + requirement compatibility)?
+        Resource fit and topology tightening are re-checked per pod at commit
+        time by ExistingNodeView.add, so this gate only prunes."""
+        pod = group.pods[0]
+        if view.taints.tolerates(pod) is not None:
+            return False
+        if group.requirements is None:
+            return True
+        # hostname-keyed pod requirements (IN a host, but also DoesNotExist /
+        # Gt / Lt, which compatible() can't veto against a real hostname) are
+        # host-loop territory — same rule as bucket_proto for new bins
+        if group.requirements.has(lbl.LABEL_HOSTNAME):
+            return False
+        node_requirements = Requirements(*view.requirements.values())
+        return node_requirements.compatible(group.requirements) is None
+
+    def _fill_existing(self, scheduler, problem: DenseProblem, buckets: List[_Bucket]):
+        """Fill existing-node capacity before opening new bins.
+
+        Mirrors the host loop's existing-nodes-first rule
+        (scheduler.go:191-195, existingnode.go:97) at bucket granularity:
+
+        - plain / zone-pinned buckets fill greedily largest-first over
+          deduplicated size classes (same FFD order as the host queue);
+        - spread groups interleave one pod at a time across their zone
+          buckets, lowest-current-count first, because the exact topology
+          check inside view.add enforces the per-pod min-count domain rule
+          (topologygroup.go:157-184) — bulk-filling one zone would trip it;
+        - dedicated / single-bin buckets (hostname spread, anti-affinity,
+          hostname affinity) skip existing fill: their per-host zero-count
+          checks need the exact host protocol.
+
+        Every placement commits through ExistingNodeView.add, so capacity
+        modeling here only *proposes*; a rejected add leaves the pod in its
+        bucket for the new-bin solve. Returns (count committed, taken [P]).
+        """
+        from ..scheduler.errors import IncompatibleError
+        from .pack_counts import dedupe_sizes
+
+        views = scheduler.existing_nodes
+        taken = np.zeros((problem.P,), dtype=bool)
+        frees: List[Optional[np.ndarray]] = []
+        tols: List[Optional[np.ndarray]] = []  # fits() tolerance of each view's available
+        zone_of: List[Optional[str]] = []
+        ct_of: List[Optional[str]] = []
+        for view in views:
+            avail = resource_vector(view.available)
+            used = resource_vector(view.requests)
+            if avail is None or used is None:
+                frees.append(None)
+                tols.append(None)
+            else:
+                frees.append(np.maximum(avail - used, 0.0))
+                tols.append(res.tolerance(avail))
+            zone_of.append(view.node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE))
+            ct_of.append(view.node.metadata.labels.get(lbl.LABEL_CAPACITY_TYPE))
+
+        compat_cache: Dict[tuple, bool] = {}
+        committed = 0
+
+        def view_ok(bucket: _Bucket, group, vi: int) -> bool:
+            if frees[vi] is None:
+                return False
+            if bucket.zone is not None and zone_of[vi] != bucket.zone:
+                return False
+            if bucket.capacity_type is not None and ct_of[vi] != bucket.capacity_type:
+                return False
+            key = (bucket.group_index, vi)
+            ok = compat_cache.get(key)
+            if ok is None:
+                ok = self._view_accepts(group, views[vi])
+                compat_cache[key] = ok
+            return ok
+
+        def commit(vi: int, row: int) -> bool:
+            nonlocal committed
+            try:
+                views[vi].add(problem.pods[row])
+            except IncompatibleError:
+                return False
+            taken[row] = True
+            committed += 1
+            frees[vi] = frees[vi] - problem.requests[row]
+            return True
+
+        spread_units: Dict[int, List[_Bucket]] = {}
+        for bucket in buckets:
+            if not bucket.pod_rows or bucket.zone == "__infeasible__":
+                continue
+            if bucket.dedicated or bucket.single_bin:
+                # per-host zero-count checks (anti-affinity, hostname spread/
+                # affinity) need the exact host protocol, which also fills
+                # existing nodes first — route these pods there rather than
+                # densely opening fresh nodes while existing capacity idles
+                bucket.pod_rows = []
+                continue
+            group = problem.groups[bucket.group_index]
+            if group.kind == GroupKind.SPREAD:
+                spread_units.setdefault(bucket.group_index, []).append(bucket)
+                continue
+            # plain / zone-pinned affinity: class-vectorized greedy fill
+            rows = bucket.pod_rows
+            unique, counts, inverse = dedupe_sizes(problem.requests[rows])
+            U = len(unique)
+            class_rows: List[List[int]] = [[] for _ in range(U)]
+            for local, u in enumerate(inverse):
+                class_rows[int(u)].append(rows[local])
+            cursor = [0] * U
+            remaining = counts.astype(np.int64).copy()
+            for vi in range(len(views)):
+                if remaining.sum() == 0:
+                    break
+                if not view_ok(bucket, group, vi):
+                    continue
+                bail = False
+                for u in range(U):
+                    if bail or remaining[u] == 0:
+                        continue
+                    size = unique[u]
+                    positive = size > 1e-12
+                    if positive.any():
+                        headroom = frees[vi][positive] + tols[vi][positive]
+                        k = int(min(np.floor(headroom / size[positive]).min(), remaining[u]))
+                    else:
+                        k = int(remaining[u])  # zero-request pods fit anywhere
+                    placed = 0
+                    while placed < k:
+                        if not commit(vi, class_rows[u][cursor[u]]):
+                            bail = True  # exact check vetoed; stop this view
+                            break
+                        cursor[u] += 1
+                        placed += 1
+                    remaining[u] -= placed
+            bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
+
+        # spread groups: one pod at a time, lowest-count zone first
+        for g, unit in spread_units.items():
+            group = problem.groups[g]
+            states = []  # per bucket: (bucket, descending-size row queue, count, viable views)
+            for bucket in unit:
+                domain = bucket.zone if bucket.zone is not None else bucket.capacity_type
+                count = int(
+                    self._existing_counts(scheduler.topology, group, group.topology_key, [domain])[0]
+                )
+                order = np.lexsort(tuple(-problem.requests[bucket.pod_rows][:, c] for c in (1, 0)))
+                queue = [bucket.pod_rows[i] for i in order]
+                viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
+                states.append({"bucket": bucket, "queue": queue, "count": count, "views": viable})
+            while True:
+                live = [s for s in states if s["queue"] and s["views"]]
+                if not live:
+                    break
+                state = min(live, key=lambda s: s["count"])
+                row = state["queue"][0]
+                req = problem.requests[row]
+                placed = False
+                for vi in list(state["views"]):
+                    if not np.all(req <= frees[vi] + tols[vi]):
+                        continue
+                    if commit(vi, row):
+                        placed = True
+                        break
+                    state["views"].remove(vi)  # exact check vetoed this view
+                state["queue"].pop(0)  # placed, or left for the new-bin solve
+                if placed:
+                    state["count"] += 1
+            for state in states:
+                state["bucket"].pod_rows = [r for r in state["bucket"].pod_rows if not taken[r]]
+
+        return committed, taken
 
     def _pallas_enabled(self) -> bool:
         import os
@@ -640,14 +836,19 @@ class DenseSolver:
 
     # -- steps 4+5: verify & commit ------------------------------------------
 
-    def _verify_and_commit(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol) -> Tuple[int, List[int]]:
+    def _verify_and_commit(
+        self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol, taken: Optional[np.ndarray] = None
+    ) -> Tuple[int, List[int]]:
         from ..scheduler.node import VirtualNode
 
         bin_of_row = sol["bin_of_row"]
         bin_bucket = sol["bin_bucket"]
         num_bins = sol["num_bins"]
 
-        fallback_rows: List[int] = [int(r) for r in np.nonzero(bin_of_row < 0)[0]]
+        unplaced = np.nonzero(bin_of_row < 0)[0]
+        if taken is not None:  # rows already committed onto existing nodes
+            unplaced = unplaced[~taken[unplaced]]
+        fallback_rows: List[int] = [int(r) for r in unplaced]
 
         if num_bins == 0:
             return 0, fallback_rows
