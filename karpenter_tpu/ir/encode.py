@@ -257,17 +257,19 @@ class DenseProblem:
     requests: np.ndarray  # [P, R] float64 (host math is exact; device casts to f32)
     group_ids: np.ndarray  # [P] int32
     groups: List[GroupInfo]  # G entries
-    # instance types (single template for now; index into template list)
-    template: NodeTemplate
+    # instance types: the concatenation of each template's (weight-ordered)
+    # provisioner universe — a type column belongs to exactly one template
+    templates: List[NodeTemplate]
     instance_types: List[InstanceType]
-    caps: np.ndarray  # [T, R] float64 (resources - overhead, missing -> 0)
+    type_template: np.ndarray  # [T] int32: owning template index per column
+    caps: np.ndarray  # [T, R] float64 (resources - system overhead, missing -> 0)
     prices: np.ndarray  # [T] float64
     type_zone: np.ndarray  # [T, Z] bool
     type_ct: np.ndarray  # [T, C] bool
-    compat: np.ndarray  # [G, T] bool
+    compat: np.ndarray  # [G, T] bool (nonzero only inside the group's template segment)
     group_zone_allowed: np.ndarray  # [G, Z] bool
     group_ct_allowed: np.ndarray  # [G, C] bool
-    daemon_overhead: np.ndarray  # [R] float64
+    daemon_overhead: np.ndarray  # [T, R] float64: daemonset overhead of each column's template
     # pods that must take the exact host path
     host_pods: List[Pod] = field(default_factory=list)
 
@@ -283,20 +285,43 @@ class DenseProblem:
     def G(self) -> int:
         return len(self.groups)
 
+    def template_of_group(self, group: "GroupInfo") -> NodeTemplate:
+        return self.templates[group.template_index]
+
 
 def encode_problem(
     pods: Sequence[Pod],
-    template: NodeTemplate,
-    instance_types: Sequence[InstanceType],
-    daemon_overhead: Optional[Dict[str, float]] = None,
+    templates: Sequence[NodeTemplate],
+    instance_types: Dict[str, Sequence[InstanceType]],
+    daemon_overhead: Optional[Dict[str, Dict[str, float]]] = None,
     zones: Optional[Sequence[str]] = None,
     capacity_types: Optional[Sequence[str]] = None,
 ) -> DenseProblem:
-    """Encode a batch against one node template's instance-type universe."""
+    """Encode a batch against the weight-ordered node templates.
+
+    Each group binds to the FIRST template (weight order) it is compatible
+    with and that offers at least one compatible instance type — the same
+    first-workable-template rule the host loop applies when opening a fresh
+    node (reference scheduler.go:207-232). The type axis is the concatenation
+    of every template's instance-type universe; a group's compat row is zero
+    outside its chosen template's segment, so the device argmin can never
+    pick a cross-template type.
+    """
+    templates = list(templates)
+    type_list: List[InstanceType] = []
+    type_template_ids: List[int] = []
+    segment_bounds: List[Tuple[int, int]] = []  # [ti] -> (start, end) on the type axis
+    for ti, template in enumerate(templates):
+        segment_types = list(instance_types.get(template.provisioner_name, ()))
+        start = len(type_list)
+        type_list.extend(segment_types)
+        type_template_ids.extend([ti] * len(segment_types))
+        segment_bounds.append((start, len(type_list)))
+
     # -- axes ---------------------------------------------------------------
     zone_set: Set[str] = set(zones or ())
     ct_set: Set[str] = set(capacity_types or ())
-    for it in instance_types:
+    for it in type_list:
         for offering in it.offerings():
             zone_set.add(offering.zone)
             ct_set.add(offering.capacity_type)
@@ -306,12 +331,12 @@ def encode_problem(
     ct_index = {c: i for i, c in enumerate(ct_list)}
 
     # -- instance-type matrices --------------------------------------------
-    T = len(instance_types)
+    T = len(type_list)
     caps = np.zeros((T, R), dtype=np.float64)
     prices = np.zeros((T,), dtype=np.float64)
     type_zone = np.zeros((T, len(zone_list)), dtype=bool)
     type_ct = np.zeros((T, len(ct_list)), dtype=bool)
-    for t, it in enumerate(instance_types):
+    for t, it in enumerate(type_list):
         cap_vec = resource_vector(it.resources())
         over_vec = resource_vector(it.overhead())
         if cap_vec is None or over_vec is None:
@@ -323,9 +348,16 @@ def encode_problem(
             type_zone[t, zone_index[offering.zone]] = True
             type_ct[t, ct_index[offering.capacity_type]] = True
 
-    overhead_vec = resource_vector(daemon_overhead or {})
-    if overhead_vec is None:
-        overhead_vec = np.zeros((R,), np.float64)
+    # daemonset overhead per type column = its template's overhead
+    overhead_by_template: List[np.ndarray] = []
+    for template in templates:
+        vec = resource_vector((daemon_overhead or {}).get(template.provisioner_name, {}) or {})
+        overhead_by_template.append(vec if vec is not None else np.zeros((R,), np.float64))
+    overhead_t = (
+        np.stack(overhead_by_template)[np.asarray(type_template_ids, dtype=np.int64)]
+        if type_list
+        else np.zeros((0, R), np.float64)
+    )
 
     # -- group pods by constraint signature ---------------------------------
     groups: List[GroupInfo] = []
@@ -384,31 +416,42 @@ def encode_problem(
     # -- per-group compatibility via the exact host algebra ------------------
     from ..scheduler.node import type_is_compatible, type_has_offering
 
-    type_list = list(instance_types)
     # overhead-fits-resources holds independently of the group (requests are
     # checked per bin later); precompute once per catalog
     empty_fit = np.array([res.fits(it.overhead(), it.resources()) for it in type_list], dtype=bool)
     for group in groups:
         pod = group.pods[0]
-        # taints: template taints must be tolerated
-        if template.taints.tolerates(pod) is not None:
+        # first workable template in weight order (scheduler.go:207-232):
+        # taints tolerated, requirements compatible, >=1 compatible type
+        chosen = -1
+        for ti, template in enumerate(templates):
+            if template.taints.tolerates(pod) is not None:
+                continue
+            node_requirements = Requirements(*template.requirements.values())
+            if node_requirements.compatible(group.requirements) is not None:
+                continue
+            node_requirements.add(*group.requirements.values())
+            start, end = segment_bounds[ti]
+            any_type = False
+            for t in range(start, end):
+                it = type_list[t]
+                if empty_fit[t] and type_is_compatible(it, node_requirements) and type_has_offering(it, node_requirements):
+                    compat[group.index, t] = True
+                    any_type = True
+            if not any_type:
+                continue
+            chosen = ti
+            group.template_index = ti
+            zone_req = node_requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
+            group_zone_allowed[group.index] = [zone_req.has(z) for z in zone_list]
+            ct_req = node_requirements.get(lbl.LABEL_CAPACITY_TYPE)
+            group_ct_allowed[group.index] = [ct_req.has(c) for c in ct_list]
+            break
+        if chosen < 0:
+            # no template can open a node for this shape: exact host loop
+            # owns the (identical) failure message
             group.kind = GroupKind.HOST
-            continue
-        node_requirements = Requirements(*template.requirements.values())
-        err = node_requirements.compatible(group.requirements)
-        if err is not None:
-            # incompatible with this template: dense path has a single
-            # template, so these pods are host-path (other templates there)
-            group.kind = GroupKind.HOST
-            continue
-        node_requirements.add(*group.requirements.values())
-        for t, it in enumerate(type_list):
-            if empty_fit[t] and type_is_compatible(it, node_requirements) and type_has_offering(it, node_requirements):
-                compat[group.index, t] = True
-        zone_req = node_requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
-        group_zone_allowed[group.index] = [zone_req.has(z) for z in zone_list]
-        ct_req = node_requirements.get(lbl.LABEL_CAPACITY_TYPE)
-        group_ct_allowed[group.index] = [ct_req.has(c) for c in ct_list]
+            compat[group.index, :] = False
 
     # groups demoted to HOST during compat: move their pods to host_pods
     if any(g.kind == GroupKind.HOST for g in groups):
@@ -443,8 +486,9 @@ def encode_problem(
         requests=requests,
         group_ids=group_ids,
         groups=groups,
-        template=template,
+        templates=templates,
         instance_types=type_list,
+        type_template=np.asarray(type_template_ids, dtype=np.int32),
         caps=caps,
         prices=prices,
         type_zone=type_zone,
@@ -452,6 +496,6 @@ def encode_problem(
         compat=compat,
         group_zone_allowed=group_zone_allowed,
         group_ct_allowed=group_ct_allowed,
-        daemon_overhead=overhead_vec,
+        daemon_overhead=overhead_t,
         host_pods=host_pods,
     )

@@ -25,11 +25,17 @@ protocol so any modeling drift degrades to a per-pod fallback, never an
 invalid placement. This is what makes consolidation simulations (which
 always carry existing nodes) a real consumer of the dense path.
 
+Multi-provisioner batches encode every template: the type axis concatenates
+each template's (weight-ordered) universe and a group binds to its first
+workable template, the host loop's rule. Provisioner limits apply at commit
+with the same filter-then-subtractMax pessimism the host loop keeps per
+opened node (scheduler.go:263-284).
+
 Pods whose constraints the dense IR can't express — and all pods whenever
-provisioner limits or populated inverse anti-affinities are in play — return
-to the caller for the exact host loop. Correct-by-construction: the host
-loop re-checks nothing that was committed, but everything committed was
-verified against the same invariants the host protocol enforces.
+populated inverse anti-affinities are in play — return to the caller for
+the exact host loop. Correct-by-construction: the host loop re-checks
+nothing that was committed, but everything committed was verified against
+the same invariants the host protocol enforces.
 """
 
 from __future__ import annotations
@@ -166,8 +172,6 @@ class DenseSolver:
         pods = list(pods)
         if len(pods) < self.min_batch:
             return pods
-        if scheduler.remaining_resources:
-            return pods  # provisioner limits need the sequential invariant
         # Inverse anti-affinity from *already-placed* cluster pods (non-zero
         # recorded domains) can block arbitrary dense placements -> host path.
         # Inverse groups from pods of this batch start with zero counts and
@@ -178,20 +182,17 @@ class DenseSolver:
                 return pods
         if not scheduler.node_templates:
             return pods
+        if not any(scheduler.instance_types.get(t.provisioner_name) for t in scheduler.node_templates):
+            return pods
         self.stats.batches += 1
         self.stats.pods_in += len(pods)
-
-        template = scheduler.node_templates[0]
-        instance_types = scheduler.instance_types.get(template.provisioner_name, [])
-        if not instance_types:
-            return pods
 
         t0 = time.perf_counter()
         problem = encode_problem(
             pods,
-            template,
-            instance_types,
-            daemon_overhead=scheduler.daemon_overhead.get(template.provisioner_name, {}),
+            scheduler.node_templates,
+            scheduler.instance_types,
+            daemon_overhead=scheduler.daemon_overhead,
             zones=scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ()),
             capacity_types=scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ()),
         )
@@ -492,12 +493,11 @@ class DenseSolver:
                     if bail or remaining[u] == 0:
                         continue
                     size = unique[u]
+                    # every size class has pods >= 1 (pod_requests adds it),
+                    # so at least one positive component always exists
                     positive = size > 1e-12
-                    if positive.any():
-                        headroom = frees[vi][positive] + tols[vi][positive]
-                        k = int(min(np.floor(headroom / size[positive]).min(), remaining[u]))
-                    else:
-                        k = int(remaining[u])  # zero-request pods fit anywhere
+                    headroom = frees[vi][positive] + tols[vi][positive]
+                    k = int(min(np.floor(headroom / size[positive]).min(), remaining[u]))
                     placed = 0
                     while placed < k:
                         if not commit(vi, class_rows[u][cursor[u]]):
@@ -508,10 +508,16 @@ class DenseSolver:
                     remaining[u] -= placed
             bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
 
-        # spread groups: one pod at a time, lowest-count zone first
+        # spread groups: one pod at a time, lowest-count zone first. A commit
+        # veto here is the topology min-count rule firing because a domain
+        # with NO existing capacity holds the min — zone-global, not a
+        # property of the view — and the blocker's count cannot move until
+        # the new-bin solve records its cohorts, so the whole domain blocks
+        # for the rest of this fill (its remaining pods take new bins; the
+        # next batch sees equalized counts and can fill further).
         for g, unit in spread_units.items():
             group = problem.groups[g]
-            states = []  # per bucket: (bucket, descending-size row queue, count, viable views)
+            states = []  # per bucket/domain: descending-size row queue, count, viable views
             for bucket in unit:
                 domain = bucket.zone if bucket.zone is not None else bucket.capacity_type
                 count = int(
@@ -520,25 +526,28 @@ class DenseSolver:
                 order = np.lexsort(tuple(-problem.requests[bucket.pod_rows][:, c] for c in (1, 0)))
                 queue = [bucket.pod_rows[i] for i in order]
                 viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
-                states.append({"bucket": bucket, "queue": queue, "count": count, "views": viable})
+                states.append({"bucket": bucket, "queue": queue, "count": count, "views": viable, "blocked": False})
             while True:
-                live = [s for s in states if s["queue"] and s["views"]]
+                live = [s for s in states if s["queue"] and s["views"] and not s["blocked"]]
                 if not live:
                     break
                 state = min(live, key=lambda s: s["count"])
                 row = state["queue"][0]
                 req = problem.requests[row]
                 placed = False
-                for vi in list(state["views"]):
+                for vi in state["views"]:
                     if not np.all(req <= frees[vi] + tols[vi]):
                         continue
                     if commit(vi, row):
                         placed = True
-                        break
-                    state["views"].remove(vi)  # exact check vetoed this view
-                state["queue"].pop(0)  # placed, or left for the new-bin solve
+                    else:
+                        state["blocked"] = True  # skew veto: domain-wide, retry never helps
+                    break
                 if placed:
+                    state["queue"].pop(0)
                     state["count"] += 1
+                elif not state["blocked"]:
+                    state["queue"].pop(0)  # no capacity for this pod; new-bin it
             for state in states:
                 state["bucket"].pod_rows = [r for r in state["bucket"].pod_rows if not taken[r]]
 
@@ -616,8 +625,10 @@ class DenseSolver:
                 allowed[b] = problem.compat[bucket.group_index] & bucket_extra[b]
 
         # host math stays float64 (exact vs resources.fits); the device sees
-        # f32 — its choice is advisory, commit-time checks are authoritative
-        caps_eff = np.maximum(problem.caps - problem.daemon_overhead[None, :], 0.0)
+        # f32 — its choice is advisory, commit-time checks are authoritative.
+        # daemon_overhead is [T, R]: each column carries its own template's
+        # daemonset overhead (multi-template concatenated axis)
+        caps_eff = np.maximum(problem.caps - problem.daemon_overhead, 0.0)
 
         bucket_stats = np.stack([sum_req, max_req]).astype(np.float32)  # [2, B, R]
 
@@ -795,10 +806,11 @@ class DenseSolver:
         # bulk audit: surviving instance-type options for every bin at once.
         # Bins repeat heavily (identical dedicated bins, repeated pack
         # patterns), so the [bins, T, R] compare runs over unique rows only.
-        need_all = usage + problem.daemon_overhead[None, :]  # [num_bins, R]
-        cap_tol = problem.caps + res.tolerance(problem.caps)  # [T, R]
-        uniq_need, inv_need = np.unique(need_all, axis=0, return_inverse=True)
-        fit_all = np.all(uniq_need[:, None, :] <= cap_tol[None, :, :], axis=2)[inv_need]  # [num_bins, T]
+        # Per-type daemon overhead folds into the capacity side (same
+        # usage + overhead <= caps + tol inequality as before).
+        cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+        uniq_need, inv_need = np.unique(usage, axis=0, return_inverse=True)
+        fit_all = np.all(uniq_need[:, None, :] <= cap_tol_eff[None, :, :], axis=2)[inv_need]  # [num_bins, T]
         group_of_bin = np.asarray([buckets[int(b)].group_index for b in bin_bucket], dtype=np.int64)
         mask_all = fit_all & problem.compat[group_of_bin] & bucket_extra[bin_bucket]
         sol.update(usage=usage, bin_rows=bin_rows, mask_all=mask_all)
@@ -878,7 +890,7 @@ class DenseSolver:
                 return proto_cache[bkey]
             bucket = buckets[bkey]
             group = problem.groups[bucket.group_index]
-            reqs = Requirements(*problem.template.requirements.values())
+            reqs = Requirements(*problem.template_of_group(group).requirements.values())
             proto: Optional[Requirements] = reqs
             if group.requirements is not None:
                 # any hostname-keyed pod requirement (IN a specific host, but
@@ -899,11 +911,12 @@ class DenseSolver:
             proto_cache[bkey] = proto
             return proto
 
-        daemon = scheduler.daemon_overhead.get(problem.template.provisioner_name, {})
         committed = 0
         for bid in range(num_bins):
             bucket_key = int(bin_bucket[bid])
             bucket = buckets[bucket_key]
+            group = problem.groups[bucket.group_index]
+            template = problem.template_of_group(group)
             mask = mask_all[bid]
             if not mask.any():
                 fallback_rows.extend(bin_rows[bid])
@@ -914,11 +927,24 @@ class DenseSolver:
             if options is None:
                 options = [problem.instance_types[t] for t in np.nonzero(mask)[0]]
                 options_cache[mask_key] = options
+            # provisioner limits: drop types whose capacity alone would
+            # breach, then apply the subtractMax pessimism after commit —
+            # the exact sequential invariant the host loop keeps per opened
+            # node (scheduler.go:263-284), via the host loop's own helpers
+            remaining = scheduler.remaining_resources.get(template.provisioner_name)
+            if remaining is not None:
+                from ..scheduler.scheduler import filter_by_remaining_resources
+
+                options = filter_by_remaining_resources(options, remaining)
+                if not options:
+                    fallback_rows.extend(bin_rows[bid])
+                    continue
             proto = bucket_proto(bucket_key)
             if proto is None:
                 fallback_rows.extend(bin_rows[bid])
                 continue
-            node = VirtualNode.open_prepared(problem.template, proto.copy(), scheduler.topology, daemon, options)
+            daemon = scheduler.daemon_overhead.get(template.provisioner_name, {})
+            node = VirtualNode.open_prepared(template, proto.copy(), scheduler.topology, daemon, options)
             reqs = node.template.requirements
 
             node.pods = [problem.pods[row] for row in bin_rows[bid]]
@@ -934,4 +960,8 @@ class DenseSolver:
                 matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
                 match_cache[bucket_key] = matching
             scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
+            if remaining is not None:
+                from ..scheduler.scheduler import subtract_max
+
+                scheduler.remaining_resources[template.provisioner_name] = subtract_max(remaining, options)
         return committed, fallback_rows
