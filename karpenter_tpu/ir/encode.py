@@ -448,10 +448,9 @@ def encode_problem(
             group_ct_allowed[group.index] = [ct_req.has(c) for c in ct_list]
             break
         if chosen < 0:
-            # no template can open a node for this shape: exact host loop
-            # owns the (identical) failure message
+            # no template can open a node for this shape (compat row is
+            # all-False): exact host loop owns the (identical) failure message
             group.kind = GroupKind.HOST
-            compat[group.index, :] = False
 
     # groups demoted to HOST during compat: move their pods to host_pods
     if any(g.kind == GroupKind.HOST for g in groups):

@@ -713,7 +713,7 @@ class DenseSolver:
             local.append((rows, reqs, pack))
 
         # speculative assembly + audit, still under the in-flight round trip
-        sol = self._assemble(problem, buckets, local, bucket_extra)
+        sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
 
         try:
             packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
@@ -739,7 +739,7 @@ class DenseSolver:
                 local[b] = (rows, reqs, pack)
                 changed = True
         if changed:  # rare: an f32 rounding tie broke differently on device
-            sol = self._assemble(problem, buckets, local, bucket_extra)
+            sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
         sol["tstar"] = tstar
         return sol
 
@@ -771,7 +771,7 @@ class DenseSolver:
             place(mesh, allowed_p, P("pods", "types")),
         )
 
-    def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray) -> dict:
+    def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray, caps_eff: np.ndarray) -> dict:
         """Pure assembly + audit of the per-bucket packings: global bin ids,
         per-bin usage/rows, and surviving instance-type masks (same tolerance
         rule as resources.fits so audits can't disagree). Touches no scheduler
@@ -789,7 +789,7 @@ class DenseSolver:
             next_bin += n_local
         num_bins = next_bin
         bin_bucket = np.asarray(bin_bucket_list, dtype=np.int64)
-        sol = {"buckets": buckets, "bin_of_row": bin_of_row, "bin_bucket": bin_bucket, "num_bins": num_bins}
+        sol = {"buckets": buckets, "bin_of_row": bin_of_row, "bin_bucket": bin_bucket, "num_bins": num_bins, "caps_eff": caps_eff}
         if num_bins == 0:
             return sol
 
@@ -846,12 +846,107 @@ class DenseSolver:
         unique, counts, inverse = dedupe_sizes(reqs, quantum)
         return pack_and_assign(unique, counts, inverse, cap)
 
+    # -- step 3.5: cross-bucket spill selection --------------------------------
+
+    _SPILL_BIN_PODS = 64  # donor bins larger than this stay dense
+    _SPILL_TOTAL_PODS = 256  # pass budget: beyond this, host-loop time would bite
+
+    def _select_spill_donors(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> set:
+        """Pick bins to route to the exact host loop for cross-bucket packing.
+
+        The per-bucket dense pack cannot share one node between two
+        constraint groups, so each bucket's remainder bin may open a node
+        whose pods would have fit spare capacity on another bucket's bin —
+        the one structural cost gap vs the ILP optimum (measured by
+        tests/test_cost_regret.py). The host loop already expresses the
+        sharing exactly: it fills in-flight nodes (the committed dense bins)
+        before opening new ones (scheduler.go:191-205). So: any small
+        remainder bin of a PLAIN bucket whose pods could fit another bin's
+        cost-neutral spare is *not committed*; its pods fall back to the
+        host loop, which re-packs them — onto committed bins when the exact
+        protocol admits them, onto a fresh FFD node otherwise.
+
+        Cost-neutral spare: free capacity under the bin's cheapest surviving
+        type, so absorbing a spilled pod can never raise that bin's launch
+        price. Only PLAIN buckets participate (topology-pinned buckets need
+        domain bookkeeping the host relaxation ladder owns). Donors are
+        considered smallest-first; a receiver is claimed by at most one
+        donor, must itself be committable (non-empty audit mask), and once
+        claimed stays dense-committed (it can be neither a later donor nor
+        a later receiver) — no mutual-spill cycles, no double-claimed
+        spare. Bounded: donor bins over
+        _SPILL_BIN_PODS pods or passes over _SPILL_TOTAL_PODS total are
+        skipped — at 10k-pod scale bins hold hundreds of pods each and the
+        remainder is a <1% cost effect, while at MILP-verifiable scale the
+        pass is what closes the gap to <=3%.
+        """
+        num_bins = sol["num_bins"]
+        if num_bins < 2:
+            return set()
+        bin_bucket = sol["bin_bucket"]
+        bin_rows = sol["bin_rows"]
+        usage = sol["usage"]
+        mask_all = sol["mask_all"]
+
+        price_masked = np.where(mask_all, problem.prices[None, :], np.inf)
+        cheapest_t = np.argmin(price_masked, axis=1)
+        caps_eff = sol["caps_eff"]
+        spare = caps_eff[cheapest_t] + res.tolerance(caps_eff[cheapest_t]) - usage  # [num_bins, R]
+
+        plain = np.asarray(
+            [
+                problem.groups[buckets[int(b)].group_index].kind == GroupKind.PLAIN
+                and buckets[int(b)].zone is None
+                and buckets[int(b)].capacity_type is None
+                for b in bin_bucket
+            ]
+        )
+        # remainder = last bin of each bucket's pack (patterns emit in order,
+        # the partial pattern last)
+        last_of_bucket: Dict[int, int] = {}
+        for bid in range(num_bins):
+            last_of_bucket[int(bin_bucket[bid])] = bid
+
+        candidates = [
+            bid
+            for bid in last_of_bucket.values()
+            if plain[bid] and mask_all[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
+        ]
+        candidates.sort(key=lambda bid: len(bin_rows[bid]))
+
+        donors: set = set()
+        pinned: set = set()  # bins claimed as receivers: stay committed, one donor each
+        budget = self._SPILL_TOTAL_PODS
+        for bid in candidates:
+            rows = bin_rows[bid]
+            if len(rows) > budget or bid in pinned:
+                continue
+            g = buckets[int(bin_bucket[bid])].group_index
+            reqs_d = problem.requests[rows]
+            receiver = -1
+            for r in range(num_bins):
+                if r == bid or r in donors or r in pinned:
+                    continue
+                if not mask_all[r].any():  # bin falls back itself; phantom spare
+                    continue
+                if not problem.compat[g, cheapest_t[r]]:
+                    continue
+                if bool(np.all(reqs_d <= spare[r][None, :], axis=1).any()):
+                    receiver = r
+                    break
+            if receiver >= 0:
+                donors.add(bid)
+                pinned.add(receiver)
+                budget -= len(rows)
+        return donors
+
     # -- steps 4+5: verify & commit ------------------------------------------
 
     def _verify_and_commit(
         self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol, taken: Optional[np.ndarray] = None
     ) -> Tuple[int, List[int]]:
         from ..scheduler.node import VirtualNode
+        from ..scheduler.scheduler import filter_by_remaining_resources, subtract_max
 
         bin_of_row = sol["bin_of_row"]
         bin_bucket = sol["bin_bucket"]
@@ -868,6 +963,11 @@ class DenseSolver:
         usage = sol["usage"]
         bin_rows = sol["bin_rows"]
         mask_all = sol["mask_all"]
+        # Spill selection assumes every receiver commits; under active
+        # provisioner limits the limits filter can knock a receiver out
+        # mid-loop (phantom receiver), so the pass stays off — limits
+        # batches keep the plain per-bucket commit.
+        spill = set() if scheduler.remaining_resources else self._select_spill_donors(problem, buckets, sol)
 
         # identical dedicated bins share options lists; cache by content
         options_cache: Dict[bytes, list] = {}
@@ -913,6 +1013,9 @@ class DenseSolver:
 
         committed = 0
         for bid in range(num_bins):
+            if bid in spill:  # cross-bucket spill: the host loop re-packs
+                fallback_rows.extend(int(r) for r in bin_rows[bid])
+                continue
             bucket_key = int(bin_bucket[bid])
             bucket = buckets[bucket_key]
             group = problem.groups[bucket.group_index]
@@ -933,8 +1036,6 @@ class DenseSolver:
             # node (scheduler.go:263-284), via the host loop's own helpers
             remaining = scheduler.remaining_resources.get(template.provisioner_name)
             if remaining is not None:
-                from ..scheduler.scheduler import filter_by_remaining_resources
-
                 options = filter_by_remaining_resources(options, remaining)
                 if not options:
                     fallback_rows.extend(bin_rows[bid])
@@ -961,7 +1062,5 @@ class DenseSolver:
                 match_cache[bucket_key] = matching
             scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
             if remaining is not None:
-                from ..scheduler.scheduler import subtract_max
-
                 scheduler.remaining_resources[template.provisioner_name] = subtract_max(remaining, options)
         return committed, fallback_rows

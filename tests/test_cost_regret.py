@@ -1,0 +1,139 @@
+"""Cost-regret gate: scheduler node cost vs the exhaustive ILP optimum.
+
+The BASELINE target requires node cost within <=3% of an exhaustive ILP.
+These tests run the SAME pod batch through (a) the host FFD loop and (b) the
+dense TPU path, price the launched nodes, and compare both against
+`optimal_node_cost` (karpenter_tpu/solver/optimal.py, HiGHS MILP).
+
+Instance families mirror the BASELINE eval configs at MILP-tractable sizes:
+homogeneous pods (FFD parity config), mixed sizes, nodeSelector-constrained,
+and spot/on-demand mixed pricing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+pytest.importorskip("scipy.optimize")
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, Offering, instance_type, instance_types
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.solver.optimal import optimal_node_cost, problem_matrices
+
+from tests.helpers import make_pod, make_provisioner
+
+REGRET_GATE = 0.03  # BASELINE: <=3% node-cost overhead vs exhaustive ILP
+# The host FFD loop is reference-parity (the Go scheduler's algorithm) and
+# carries FFD's inherent approximation gap; it gets a sanity bound, not the
+# product gate. The dense TPU path is the product and must meet <=3% — on
+# these instances it typically lands exactly on the ILP optimum, beating FFD.
+HOST_FFD_SANITY = 0.25
+
+
+def scheduled_cost(pods, provider, provisioner, dense: bool) -> float:
+    solver = DenseSolver(min_batch=1) if dense else None
+    scheduler = build_scheduler([provisioner], provider, pods, dense_solver=solver)
+    results = scheduler.solve(pods)
+    placed = sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(n.pods) for n in results.existing_nodes
+    )
+    assert placed == len(pods), f"only {placed}/{len(pods)} pods scheduled"
+    if dense:
+        assert solver.stats.pods_committed > 0, "dense path never engaged"
+    return sum(min(it.price() for it in n.instance_type_options) for n in results.new_nodes)
+
+
+def assert_regret(pods, provider, provisioner, time_limit: float = 60.0):
+    template = NodeTemplate.from_provisioner(provisioner)
+    types = provider.get_instance_types(provisioner)
+    requests, caps, prices, compat = problem_matrices(pods, types, template)
+    opt = optimal_node_cost(requests, caps, prices, compat, time_limit=time_limit)
+    assert opt.ok, f"MILP did not reach optimality: {opt.status}"
+
+    for dense in (False, True):
+        cost = scheduled_cost(pods, provider, provisioner, dense)
+        # the MILP optimum is a true lower bound on any feasible layout
+        assert cost >= opt.cost - 1e-6, f"scheduler cost {cost} below ILP optimum {opt.cost}"
+        regret = (cost - opt.cost) / opt.cost
+        path = "dense" if dense else "host"
+        gate = REGRET_GATE if dense else HOST_FFD_SANITY
+        assert regret <= gate, (
+            f"{path} path cost {cost:.4f} vs ILP {opt.cost:.4f}: "
+            f"regret {regret:.1%} > {gate:.0%}"
+        )
+
+
+def test_homogeneous_pods_ffd_parity_config():
+    """1k-homogeneous/50-types BASELINE config at MILP scale: every pod the
+    same size against the incrementing corpus."""
+    provider = FakeCloudProvider(instance_types(10))
+    pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(20)]
+    assert_regret(pods, provider, make_provisioner())
+
+
+def test_mixed_pod_sizes():
+    rng = np.random.default_rng(7)
+    cpus = [0.25, 0.5, 1.0, 1.5]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi"]
+    provider = FakeCloudProvider(instance_types(8))
+    pods = [
+        make_pod(requests={"cpu": cpus[rng.integers(4)], "memory": mems[rng.integers(4)]})
+        for _ in range(18)
+    ]
+    assert_regret(pods, provider, make_provisioner())
+
+
+def test_node_selector_constrained():
+    """5k-selectors BASELINE config at MILP scale: a cohort pinned by
+    nodeSelector to a single instance type among the corpus."""
+    provider = FakeCloudProvider(instance_types(8))
+    pods = [make_pod(requests={"cpu": 0.5, "memory": "512Mi"}) for _ in range(10)]
+    # the integer label pins to the 4-cpu type; pricier than free choice
+    pods += [
+        make_pod(requests={"cpu": 0.5, "memory": "512Mi"}, node_selector={"integer": "4"})
+        for _ in range(6)
+    ]
+    assert_regret(pods, provider, make_provisioner())
+
+
+def test_spot_on_demand_mixed_pricing():
+    """Spot/OD BASELINE config at MILP scale: same shapes offered at
+    different prices; the solver should prefer the cheap capacity."""
+    types = []
+    for i in range(4):
+        cpu = 2 * (i + 1)
+        types.append(
+            instance_type(
+                f"od-{i}",
+                cpu=cpu,
+                memory=f"{cpu * 2}Gi",
+                pods=cpu * 8,
+                offerings=[Offering(capacity_type="on-demand", zone="test-zone-1")],
+                price=0.5 * cpu,
+            )
+        )
+        types.append(
+            instance_type(
+                f"spot-{i}",
+                cpu=cpu,
+                memory=f"{cpu * 2}Gi",
+                pods=cpu * 8,
+                offerings=[Offering(capacity_type="spot", zone="test-zone-1")],
+                price=0.15 * cpu,
+            )
+        )
+    provider = FakeCloudProvider(types)
+    pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(16)]
+    assert_regret(pods, provider, make_provisioner())
+
+
+def test_single_large_pod_picks_cheapest_fit():
+    """The instance-selection property (instance_selection_test.go:38): one
+    pod that only fits the upper half of the corpus must land on the
+    cheapest type that fits — regret exactly 0."""
+    provider = FakeCloudProvider(instance_types(10))
+    pods = [make_pod(requests={"cpu": 6, "memory": "2Gi"})]
+    assert_regret(pods, provider, make_provisioner())
