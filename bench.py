@@ -1,14 +1,22 @@
-"""Benchmark: the headline provisioning solve on real hardware.
+"""Benchmark: the BASELINE eval grid on real hardware.
 
-Measures the full Scheduler.solve wall-clock — dense encode, device solve,
-verify, commit — for the BASELINE.json headline config: 10k pending pods
-against 500 instance types with a mixed constraint workload (generic sizes,
-zonal topology spread, zonal self-affinity, hostname anti-affinity; the
-constraint mix mirrors the reference benchmark's, with self-consistent
-selectors as real deployments have).
+Runs every BASELINE.md eval config plus the reference's pod-count sweep
+(scheduling_benchmark_test.go:51-71,180-194) and a small-instance cost-regret
+measurement against the exhaustive MILP (solver/optimal.py).
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
+Configs (BASELINE.md target table):
+  1. ffd_parity_1k_x_50        — 1k homogeneous pods / 50 types
+  2. selectors_taints_5k_x_500 — 5k pods with nodeSelector cohorts + provisioner taints
+  3. anti_spread_10k_x_500     — HEADLINE: 10k pods, mixed anti-affinity + zonal spread
+  4. repack_2k_x_300           — whole-cluster repack: 2k pods onto 300 existing nodes
+  5. spot_od_multiprov_x_500   — spot/OD mixed pricing, weighted multi-provisioner
+
+Each solve measures full Scheduler.solve wall-clock: dense encode, device
+solve, verify, commit.
+
+Prints exactly ONE JSON line (the headline config):
+  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...,
+   "configs": {...}, "pods_per_sec_sweep": {...}, "cost_regret_vs_ilp": ...}
 
 vs_baseline is the speedup over the reference's enforced scheduler floor of
 100 pods/sec (pkg/controllers/provisioning/scheduling/
@@ -24,13 +32,23 @@ import time
 
 import numpy as np
 
-PODS = 10_000
-TYPES = 500
+HEADLINE_PODS = 10_000
+HEADLINE_TYPES = 500
 BASELINE_PODS_PER_SEC = 100.0
-TRIALS = 5  # median over 5: the tunnel's dispatch latency is jittery
+HEADLINE_TRIALS = 5  # median over 5: the tunnel's dispatch latency is jittery
+SIDE_TRIALS = 3  # non-headline configs
+SWEEP_PODS = (1, 50, 100, 500, 1000, 2000, 5000)  # scheduling_benchmark_test.go:51
+SWEEP_TYPES = 400
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def build_workload(count: int, seed: int = 42):
+    """The reference benchmark's mixed workload (scheduling_benchmark_test.go:
+    180-194): ~4/7 generic + zonal spread + zonal self-affinity + hostname
+    anti-affinity cohorts, with self-consistent selectors."""
     from karpenter_tpu.api.labels import LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE
     from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm, TopologySpreadConstraint
     from tests.helpers import make_pod
@@ -45,8 +63,7 @@ def build_workload(count: int, seed: int = 42):
 
     pods = []
     seventh = count // 7
-    # 1/7 zonal spread (self-selecting, 7 label cohorts)
-    for i in range(seventh):
+    for i in range(seventh):  # zonal spread, 7 label cohorts
         label = {"spread": values[rng.integers(7)]}
         pods.append(
             make_pod(
@@ -57,8 +74,7 @@ def build_workload(count: int, seed: int = 42):
                 ],
             )
         )
-    # 1/7 zonal self-affinity cohorts
-    for i in range(seventh):
+    for i in range(seventh):  # zonal self-affinity cohorts
         label = {"affinity": values[rng.integers(7)]}
         pods.append(
             make_pod(
@@ -67,8 +83,7 @@ def build_workload(count: int, seed: int = 42):
                 pod_requirements=[PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=label))],
             )
         )
-    # 1/7 hostname anti-affinity cohorts
-    for i in range(seventh):
+    for i in range(seventh):  # hostname anti-affinity cohorts
         label = {"anti": values[rng.integers(7)]}
         pods.append(
             make_pod(
@@ -77,66 +92,256 @@ def build_workload(count: int, seed: int = 42):
                 pod_anti_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=label))],
             )
         )
-    # remainder generic
     while len(pods) < count:
         pods.append(make_pod(labels={"app": values[rng.integers(7)]}, requests=size()))
     return pods
 
 
-def run_once(pods, provider, provisioner, solver):
+def build_selectors_taints_workload(count: int, seed: int = 7):
+    """BASELINE config 2: nodeSelector cohorts over zones, all pods tolerating
+    the provisioner's dedicated taint."""
+    from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE
+    from karpenter_tpu.api.objects import Toleration
+    from tests.helpers import make_pod
+
+    rng = np.random.default_rng(seed)
+    cpus = [0.25, 0.5, 1.0]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi"]
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    tol = [Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")]
+
+    pods = []
+    for i in range(count):
+        kwargs = dict(
+            requests={"cpu": cpus[rng.integers(3)], "memory": mems[rng.integers(4)]},
+            tolerations=tol,
+        )
+        if i % 2 == 0:  # half the pods pin a zone via nodeSelector
+            kwargs["node_selector"] = {LABEL_TOPOLOGY_ZONE: zones[rng.integers(3)]}
+        pods.append(make_pod(**kwargs))
+    return pods
+
+
+def build_repack_state(node_count: int):
+    """BASELINE config 4: a warm 300-node cluster to repack onto."""
+    from karpenter_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_INSTANCE_TYPE,
+        LABEL_TOPOLOGY_ZONE,
+        PROVISIONER_NAME_LABEL,
+    )
+    from tests.helpers import make_state_node
+
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    nodes = []
+    for i in range(node_count):
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-15",
+            LABEL_TOPOLOGY_ZONE: zones[i % 3],
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        nodes.append(
+            make_state_node(
+                labels=labels,
+                allocatable={"cpu": 16, "memory": "32Gi", "pods": 110},
+            )
+        )
+    return nodes
+
+
+def build_spot_od_types(total: int):
+    """BASELINE config 5: total/2 shapes, each offered spot (cheap) and
+    on-demand (pricey) as distinct types — mixed-pricing universe."""
+    from karpenter_tpu.cloudprovider.fake import Offering, instance_type
+
+    types = []
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    for i in range(total // 2):
+        cpu = (i % 32) + 1
+        mem = f"{cpu * 2}Gi"
+        pods = cpu * 10
+        offers = [Offering(capacity_type="on-demand", zone=z) for z in zones]
+        types.append(instance_type(f"od-{i}", cpu=cpu, memory=mem, pods=pods, offerings=offers, price=0.12 * cpu))
+        offers = [Offering(capacity_type="spot", zone=z) for z in zones]
+        types.append(instance_type(f"spot-{i}", cpu=cpu, memory=mem, pods=pods, offerings=offers, price=0.04 * cpu))
+    return types
+
+
+def run_once(pods, provider, provisioners, solver, state_nodes=()):
     from karpenter_tpu.scheduler import build_scheduler
     from karpenter_tpu.solver import DenseSolveStats
 
     solver.stats = DenseSolveStats()
-    scheduler = build_scheduler([provisioner], provider, pods, dense_solver=solver)
+    scheduler = build_scheduler(
+        provisioners, provider, pods, state_nodes=state_nodes, dense_solver=solver
+    )
     t0 = time.perf_counter()
     results = scheduler.solve(pods)
     elapsed = time.perf_counter() - t0
-    scheduled = sum(len(n.pods) for n in results.new_nodes)
+    scheduled = sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(v.pods) for v in results.existing_nodes
+    )
     cost = sum(n.instance_type_options[0].price() for n in results.new_nodes)
     return elapsed, scheduled, len(results.new_nodes), cost, solver.stats
 
 
-def main() -> None:
+def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS):
+    run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
+    times = []
+    for _ in range(trials):
+        elapsed, scheduled, nodes, cost, stats = run_once(
+            pods, provider, provisioners, solver, state_nodes
+        )
+        times.append(elapsed)
+        log(
+            f"  [{name}] trial {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f}"
+            f" fill {stats.fill_seconds*1000:.0f} device {stats.device_seconds*1000:.0f}"
+            f" commit {stats.commit_seconds*1000:.0f}) scheduled={scheduled}"
+            f" nodes={nodes} dense={stats.pods_committed} cost={cost:.1f}"
+        )
+        if scheduled < len(pods) * 0.99:
+            log(f"  [{name}] WARNING: only {scheduled}/{len(pods)} pods scheduled")
+    return float(np.median(times) * 1000), times
+
+
+def measure_cost_regret() -> float:
+    """Dense-path node-cost regret vs the exhaustive MILP on a MILP-tractable
+    mixed-size instance (the <=3% BASELINE gate, measured every round)."""
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_tpu.scheduler import build_scheduler
+    from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+    from karpenter_tpu.solver import DenseSolver
+    from karpenter_tpu.solver.optimal import optimal_node_cost, problem_matrices
+    from tests.helpers import make_pod, make_provisioner
+
+    rng = np.random.default_rng(11)
+    cpus = [0.25, 0.5, 1.0, 1.5]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi"]
+    provider = FakeCloudProvider(instance_types(8))
+    provisioner = make_provisioner()
+    pods = [
+        make_pod(requests={"cpu": cpus[rng.integers(4)], "memory": mems[rng.integers(4)]})
+        for _ in range(24)
+    ]
+    template = NodeTemplate.from_provisioner(provisioner)
+    types = provider.get_instance_types(provisioner)
+    requests, caps, prices, compat = problem_matrices(pods, types, template)
+    opt = optimal_node_cost(requests, caps, prices, compat, time_limit=60.0)
+    if not opt.ok:
+        log(f"  [regret] MILP not optimal ({opt.status}); skipping")
+        return -1.0
+    solver = DenseSolver(min_batch=1)
+    scheduler = build_scheduler([provisioner], provider, pods, dense_solver=solver)
+    results = scheduler.solve(pods)
+    placed = sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(v.pods) for v in results.existing_nodes
+    )
+    if placed != len(pods):
+        # an unscheduled pod would deflate the regret (nodes priced for fewer
+        # pods than the MILP packed) — report failure, not a bogus pass
+        log(f"  [regret] only {placed}/{len(pods)} pods scheduled; not comparable")
+        return -1.0
+    cost = sum(min(it.price() for it in n.instance_type_options) for n in results.new_nodes)
+    regret = (cost - opt.cost) / opt.cost
+    log(f"  [regret] dense cost {cost:.4f} vs ILP {opt.cost:.4f}: {regret:.2%}")
+    return round(regret, 4)
+
+
+def main() -> None:
+    from karpenter_tpu.api.objects import Taint
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_tpu.solver import DenseSolver
     from tests.helpers import make_provisioner
 
-    from karpenter_tpu.solver import DenseSolver
+    configs: dict = {}
 
-    provider = FakeCloudProvider(instance_types(TYPES))
-    provisioner = make_provisioner()
-    pods = build_workload(PODS)
+    # one long-lived solver per catalog, as the provisioning controller holds
+    # in practice (retains the uploaded device catalog between solves)
 
-    # one long-lived solver, as the provisioning controller holds in practice
-    # (retains the uploaded device catalog between solves)
-    solver = DenseSolver(min_batch=1)
+    # --- 1. FFD parity: 1k homogeneous pods / 50 types ---
+    log("config ffd_parity_1k_x_50")
+    from tests.helpers import make_pod
 
-    # warmup: compile + tunnel setup + catalog upload
-    run_once(pods, provider, provisioner, solver)
+    provider = FakeCloudProvider(instance_types(50))
+    pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(1000)]
+    ms, _ = run_config("ffd_1k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1))
+    configs["ffd_parity_1k_x_50"] = round(ms, 1)
 
-    times = []
-    scheduled = nodes = 0
-    cost = 0.0
-    for _ in range(TRIALS):
-        elapsed, scheduled, nodes, cost, stats = run_once(pods, provider, provisioner, solver)
-        times.append(elapsed)
-        print(
-            f"trial: {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f} device {stats.device_seconds*1000:.0f} "
-            f"commit {stats.commit_seconds*1000:.0f}) scheduled={scheduled} nodes={nodes} cost={cost:.1f}",
-            file=sys.stderr,
+    # --- 2. 5k pods with selectors + taints / 500 types ---
+    log("config selectors_taints_5k_x_500")
+    provider = FakeCloudProvider(instance_types(500))
+    pods = build_selectors_taints_workload(5000)
+    tainted = make_provisioner(taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")])
+    ms, _ = run_config("sel_taints_5k", pods, provider, [tainted], DenseSolver(min_batch=1))
+    configs["selectors_taints_5k_x_500"] = round(ms, 1)
+
+    # --- 3. HEADLINE: 10k pods, anti-affinity + zonal spread / 500 types ---
+    log("config anti_spread_10k_x_500 (headline)")
+    provider = FakeCloudProvider(instance_types(HEADLINE_TYPES))
+    pods = build_workload(HEADLINE_PODS)
+    headline_ms, _ = run_config(
+        "headline_10k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
+        trials=HEADLINE_TRIALS,
+    )
+    configs["anti_spread_10k_x_500"] = round(headline_ms, 1)
+
+    # --- 4. whole-cluster repack: 2k pods / 300 existing nodes ---
+    log("config repack_2k_x_300")
+    provider = FakeCloudProvider(instance_types(100))
+    pods = build_workload(2000, seed=3)
+    state_nodes = build_repack_state(300)
+    ms, _ = run_config(
+        "repack_2k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
+        state_nodes=state_nodes,
+    )
+    configs["repack_2k_x_300"] = round(ms, 1)
+
+    # --- 5. spot/OD mixed pricing, weighted multi-provisioner / 500 types ---
+    log("config spot_od_multiprov_x_500")
+    provider = FakeCloudProvider(build_spot_od_types(500))
+    pods = build_workload(5000, seed=5)
+    spot = make_provisioner(name="spot", weight=10)
+    od = make_provisioner(name="on-demand", weight=1)
+    ms, _ = run_config("spot_od_5k", pods, provider, [spot, od], DenseSolver(min_batch=1))
+    configs["spot_od_multiprov_x_500"] = round(ms, 1)
+
+    # --- reference pod-count sweep: 400 types x {1..5000} pods ---
+    log("sweep 400 types x {1,50,100,500,1000,2000,5000} pods")
+    sweep: dict = {}
+    provider = FakeCloudProvider(instance_types(SWEEP_TYPES))
+    sweep_solver = DenseSolver(min_batch=1)
+    provisioners = [make_provisioner()]
+    for count in SWEEP_PODS:
+        pods = build_workload(count, seed=13)
+        run_once(pods, provider, provisioners, sweep_solver)  # warmup this shape
+        elapsed, scheduled, nodes, _, _ = run_once(pods, provider, provisioners, sweep_solver)
+        pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+        sweep[str(count)] = round(pods_per_sec, 0)
+        log(
+            f"  [sweep] {count} pods: {elapsed*1000:.1f} ms, {pods_per_sec:,.0f} pods/sec,"
+            f" {nodes} nodes"
         )
 
-    value_ms = float(np.median(times) * 1000)
-    baseline_ms = PODS / BASELINE_PODS_PER_SEC * 1000
-    if scheduled < PODS * 0.99:
-        print(f"WARNING: only {scheduled}/{PODS} pods scheduled", file=sys.stderr)
+    # --- cost regret vs exhaustive MILP ---
+    log("cost regret vs ILP")
+    try:
+        regret = measure_cost_regret()
+    except Exception as exc:  # scipy missing or solver failure: report, don't die
+        log(f"  [regret] failed: {exc}")
+        regret = -1.0
+
+    baseline_ms = HEADLINE_PODS / BASELINE_PODS_PER_SEC * 1000
     print(
         json.dumps(
             {
-                "metric": f"solve_wall_clock_{PODS}_pods_x_{TYPES}_types",
-                "value": round(value_ms, 1),
+                "metric": f"solve_wall_clock_{HEADLINE_PODS}_pods_x_{HEADLINE_TYPES}_types",
+                "value": round(headline_ms, 1),
                 "unit": "ms",
-                "vs_baseline": round(baseline_ms / value_ms, 1),
+                "vs_baseline": round(baseline_ms / headline_ms, 1),
+                "configs": configs,
+                "pods_per_sec_sweep": sweep,
+                "cost_regret_vs_ilp": regret,
             }
         )
     )
