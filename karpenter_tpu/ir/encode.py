@@ -79,6 +79,11 @@ class GroupInfo:
     selector_signature: tuple = ()
     # dense row indices
     index: int = -1
+    # subkey of constraint_signature that determines the compat row: node
+    # selector + node-affinity terms + tolerations (what Requirements.from_pod
+    # and Taints.tolerates read) — pod labels/namespace/spread terms group
+    # pods but cannot change template/type compatibility
+    compat_sig: tuple = ()
 
 
 def resource_vector(rl: Dict[str, float]) -> Optional[np.ndarray]:
@@ -289,24 +294,76 @@ class DenseProblem:
         return self.templates[group.template_index]
 
 
-def encode_problem(
-    pods: Sequence[Pod],
+@dataclass
+class CatalogEncoding:
+    """Per-catalog dense matrices, cacheable across solves.
+
+    Everything here is a function of (templates, instance-type universe,
+    topology domains) only — independent of the pod batch — so a long-lived
+    solver reuses it for every solve against the same catalog (the
+    incremental device-state idea from SURVEY.md §7 applied to the host-side
+    encode). Contract: instance-type lists are immutable snapshots (the
+    reference's GetInstanceTypes returns cached objects the same way); a
+    provider that changes its universe must return a new list object.
+    `compat_cache` memoizes per-constraint-shape compat rows keyed by
+    GroupInfo.compat_sig; entries are (row [T] bool, template_index,
+    zone_allowed [Z] bool, ct_allowed [C] bool), with template_index == -1
+    marking shapes no template can host."""
+
+    key: tuple
+    # strong refs to the keyed instance-type lists: the cache key uses their
+    # id()s, which must not be recycled while this entry is alive
+    source_lists: tuple
+    type_list: List[InstanceType]
+    type_template_ids: List[int]
+    segment_bounds: List[Tuple[int, int]]
+    zone_list: List[str]
+    ct_list: List[str]
+    zone_index: Dict[str, int]
+    ct_index: Dict[str, int]
+    caps: np.ndarray  # [T, R]
+    prices: np.ndarray  # [T]
+    type_zone: np.ndarray  # [T, Z]
+    type_ct: np.ndarray  # [T, C]
+    empty_fit: np.ndarray  # [T] bool: overhead alone fits the type
+    compat_cache: Dict[tuple, tuple] = field(default_factory=dict)
+
+
+def template_signature(template: NodeTemplate) -> tuple:
+    """Content signature of the compat-relevant template fields (templates
+    are rebuilt from provisioners every solve; identity is useless)."""
+    reqs = tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in template.requirements.values()
+        )
+    )
+    taints = tuple(sorted((t.key, t.value, t.effect) for t in template.taints))
+    return (template.provisioner_name, taints, reqs)
+
+
+def catalog_key(
     templates: Sequence[NodeTemplate],
     instance_types: Dict[str, Sequence[InstanceType]],
-    daemon_overhead: Optional[Dict[str, Dict[str, float]]] = None,
     zones: Optional[Sequence[str]] = None,
     capacity_types: Optional[Sequence[str]] = None,
-) -> DenseProblem:
-    """Encode a batch against the weight-ordered node templates.
+) -> tuple:
+    return (
+        tuple(template_signature(t) for t in templates),
+        tuple(id(instance_types.get(t.provisioner_name)) for t in templates),
+        tuple(sorted(zones or ())),
+        tuple(sorted(capacity_types or ())),
+    )
 
-    Each group binds to the FIRST template (weight order) it is compatible
-    with and that offers at least one compatible instance type — the same
-    first-workable-template rule the host loop applies when opening a fresh
-    node (reference scheduler.go:207-232). The type axis is the concatenation
-    of every template's instance-type universe; a group's compat row is zero
-    outside its chosen template's segment, so the device argmin can never
-    pick a cross-template type.
-    """
+
+def encode_catalog(
+    templates: Sequence[NodeTemplate],
+    instance_types: Dict[str, Sequence[InstanceType]],
+    zones: Optional[Sequence[str]] = None,
+    capacity_types: Optional[Sequence[str]] = None,
+) -> CatalogEncoding:
+    """Build the batch-independent half of the encoding (type matrices,
+    offering masks, axes)."""
     templates = list(templates)
     type_list: List[InstanceType] = []
     type_template_ids: List[int] = []
@@ -318,7 +375,6 @@ def encode_problem(
         type_template_ids.extend([ti] * len(segment_types))
         segment_bounds.append((start, len(type_list)))
 
-    # -- axes ---------------------------------------------------------------
     zone_set: Set[str] = set(zones or ())
     ct_set: Set[str] = set(capacity_types or ())
     for it in type_list:
@@ -330,7 +386,6 @@ def encode_problem(
     zone_index = {z: i for i, z in enumerate(zone_list)}
     ct_index = {c: i for i, c in enumerate(ct_list)}
 
-    # -- instance-type matrices --------------------------------------------
     T = len(type_list)
     caps = np.zeros((T, R), dtype=np.float64)
     prices = np.zeros((T,), dtype=np.float64)
@@ -347,6 +402,62 @@ def encode_problem(
         for offering in it.offerings():
             type_zone[t, zone_index[offering.zone]] = True
             type_ct[t, ct_index[offering.capacity_type]] = True
+
+    empty_fit = np.array([res.fits(it.overhead(), it.resources()) for it in type_list], dtype=bool)
+    return CatalogEncoding(
+        key=catalog_key(templates, instance_types, zones, capacity_types),
+        source_lists=tuple(instance_types.get(t.provisioner_name) for t in templates),
+        type_list=type_list,
+        type_template_ids=type_template_ids,
+        segment_bounds=segment_bounds,
+        zone_list=zone_list,
+        ct_list=ct_list,
+        zone_index=zone_index,
+        ct_index=ct_index,
+        caps=caps,
+        prices=prices,
+        type_zone=type_zone,
+        type_ct=type_ct,
+        empty_fit=empty_fit,
+    )
+
+
+def encode_problem(
+    pods: Sequence[Pod],
+    templates: Sequence[NodeTemplate],
+    instance_types: Dict[str, Sequence[InstanceType]],
+    daemon_overhead: Optional[Dict[str, Dict[str, float]]] = None,
+    zones: Optional[Sequence[str]] = None,
+    capacity_types: Optional[Sequence[str]] = None,
+    catalog: Optional[CatalogEncoding] = None,
+) -> DenseProblem:
+    """Encode a batch against the weight-ordered node templates.
+
+    Each group binds to the FIRST template (weight order) it is compatible
+    with and that offers at least one compatible instance type — the same
+    first-workable-template rule the host loop applies when opening a fresh
+    node (reference scheduler.go:207-232). The type axis is the concatenation
+    of every template's instance-type universe; a group's compat row is zero
+    outside its chosen template's segment, so the device argmin can never
+    pick a cross-template type.
+    """
+    templates = list(templates)
+    if catalog is None:
+        catalog = encode_catalog(templates, instance_types, zones, capacity_types)
+    elif catalog.key != catalog_key(templates, instance_types, zones, capacity_types):
+        # a stale catalog would silently bind groups to the wrong template's
+        # type segment — fail loud instead
+        raise ValueError("CatalogEncoding does not match the supplied templates/instance_types/domains")
+    type_list = catalog.type_list
+    type_template_ids = catalog.type_template_ids
+    segment_bounds = catalog.segment_bounds
+    zone_list = catalog.zone_list
+    ct_list = catalog.ct_list
+    T = len(type_list)
+    caps = catalog.caps
+    prices = catalog.prices
+    type_zone = catalog.type_zone
+    type_ct = catalog.type_ct
 
     # daemonset overhead per type column = its template's overhead
     overhead_by_template: List[np.ndarray] = []
@@ -398,6 +509,9 @@ def encode_problem(
             if kind != GroupKind.HOST:
                 group.requirements = Requirements.from_pod(pod)
                 group.index = len(groups)
+                # node_selector + node-affinity + tolerations slots of the
+                # constraint signature (see GroupInfo.compat_sig)
+                group.compat_sig = (sig[2], sig[3][0] if sig[3] else (), sig[5])
                 groups.append(group)
             group_by_sig[sig] = group
         if group.kind == GroupKind.HOST:
@@ -417,9 +531,22 @@ def encode_problem(
     from ..scheduler.node import type_is_compatible, type_has_offering
 
     # overhead-fits-resources holds independently of the group (requests are
-    # checked per bin later); precompute once per catalog
-    empty_fit = np.array([res.fits(it.overhead(), it.resources()) for it in type_list], dtype=bool)
+    # checked per bin later); precomputed once per catalog
+    empty_fit = catalog.empty_fit
+    if len(catalog.compat_cache) > 4096:  # unbounded user labels can't leak
+        catalog.compat_cache.clear()
     for group in groups:
+        cached_row = catalog.compat_cache.get(group.compat_sig)
+        if cached_row is not None:
+            row, ti, z_allow, c_allow = cached_row
+            if ti < 0:
+                group.kind = GroupKind.HOST
+            else:
+                compat[group.index] = row
+                group.template_index = ti
+                group_zone_allowed[group.index] = z_allow
+                group_ct_allowed[group.index] = c_allow
+            continue
         pod = group.pods[0]
         # first workable template in weight order (scheduler.go:207-232):
         # taints tolerated, requirements compatible, >=1 compatible type
@@ -451,6 +578,14 @@ def encode_problem(
             # no template can open a node for this shape (compat row is
             # all-False): exact host loop owns the (identical) failure message
             group.kind = GroupKind.HOST
+            catalog.compat_cache[group.compat_sig] = (None, -1, None, None)
+        else:
+            catalog.compat_cache[group.compat_sig] = (
+                compat[group.index].copy(),
+                chosen,
+                group_zone_allowed[group.index].copy(),
+                group_ct_allowed[group.index].copy(),
+            )
 
     # groups demoted to HOST during compat: move their pods to host_pods
     if any(g.kind == GroupKind.HOST for g in groups):

@@ -55,16 +55,23 @@ class VirtualNode:
         topology: Topology,
         daemon_resources: Dict[str, float],
         instance_types: Sequence[InstanceType],
+        register: bool = True,
     ) -> "VirtualNode":
         """Fast constructor for the dense commit path (solver/dense.py):
         the caller supplies an already-validated Requirements set, so the
         template is rebuilt around it instead of deep-copied. Immutable
         template fields (labels, taints, kubelet config) are shared by
         reference — nothing mutates them after construction; `add` replaces
-        `template.requirements` wholesale rather than editing in place."""
+        `template.requirements` wholesale rather than editing in place.
+
+        With register=False the placeholder hostname is NOT made visible to
+        topology — the caller is building the node speculatively (under the
+        device round trip) and must call register_hostname() before the node
+        joins the schedule."""
         node = cls.__new__(cls)
         hostname = f"hostname-placeholder-{next(_hostname_counter):04d}"
-        topology.register(lbl.LABEL_HOSTNAME, hostname)
+        if register:
+            topology.register(lbl.LABEL_HOSTNAME, hostname)
         node._hostname = hostname
         node.template = NodeTemplate(
             provisioner_name=template.provisioner_name,
@@ -131,6 +138,11 @@ class VirtualNode:
         self.template.requirements = node_requirements
         self.topology.record(pod, node_requirements)
         self.host_port_usage.add(pod)
+
+    def register_hostname(self) -> None:
+        """Make the placeholder hostname visible to topology groups — the
+        deferred half of open_prepared(register=False)."""
+        self.topology.register(lbl.LABEL_HOSTNAME, self._hostname)
 
     def finalize_scheduling(self) -> None:
         """Strip the placeholder hostname before launch (node.go:113-117)."""

@@ -51,7 +51,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
-from ..ir.encode import DenseProblem, GroupKind, encode_problem, resource_vector
+from ..ir.encode import DenseProblem, GroupKind, catalog_key, encode_catalog, encode_problem, resource_vector
 from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
@@ -62,8 +62,11 @@ _PAD = 128  # pad the pod axis to multiples of this for compile caching
 def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):
     """Host preview of ops/feasibility.py:bucket_type_cost — same formula,
     numpy float32 — used to speculate while the device round trip is in
-    flight. Disagreements (f32 rounding ties) are reconciled by repacking
-    against the device's authoritative answer."""
+    flight. Returns (tstar [B], feasible [B], key [B, T]): the key matrix
+    lets the caller judge whether a device disagreement is material (a
+    genuinely cheaper choice) or a sub-ulp argmin tie (TPU division rounds
+    differently by 1 ulp, and price-proportional catalogs make frac*price
+    near-constant across types, so ties are systematic, not rare)."""
     eps = np.float32(1e-9)
     sum_req, max_req = bucket_stats[0], bucket_stats[1]
     safe_caps = np.maximum(caps, eps)
@@ -76,7 +79,7 @@ def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.nd
     ok = allowed & pod_fits & np.isfinite(frac)
     key = frac * prices[None, :] + bins * np.float32(1e-4) + prices[None, :] * np.float32(1e-7)
     key = np.where(ok, key, np.inf)
-    return np.argmin(key, axis=1).astype(np.int32), ok.any(axis=1)
+    return np.argmin(key, axis=1).astype(np.int32), ok.any(axis=1), key
 
 
 @dataclass
@@ -130,6 +133,12 @@ class DenseSolver:
         # toggle) never evicts the other flavor of the same catalog.
         self._device_catalog: Dict[str, Dict[tuple, tuple]] = {}
         self._catalogs_per_flavor = 4
+        # host-side catalog encodings (type matrices + compat rows), same
+        # lifetime story: batch-independent, rebuilt only when the template
+        # set / type universe / domain axes change (ir/encode.py
+        # CatalogEncoding — holds refs to the keyed lists, so FIFO eviction
+        # here also releases them)
+        self._catalog_encodings: Dict[tuple, object] = {}
         # explicit mesh wins; otherwise auto-detect on first device solve
         self._mesh = mesh
         self._mesh_checked = mesh is not None
@@ -188,13 +197,23 @@ class DenseSolver:
         self.stats.pods_in += len(pods)
 
         t0 = time.perf_counter()
+        zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
+        capacity_types = scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ())
+        ckey = catalog_key(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
+        catalog = self._catalog_encodings.get(ckey)
+        if catalog is None:
+            catalog = encode_catalog(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
+            while len(self._catalog_encodings) >= self._catalogs_per_flavor:
+                self._catalog_encodings.pop(next(iter(self._catalog_encodings)))  # FIFO
+            self._catalog_encodings[ckey] = catalog
         problem = encode_problem(
             pods,
             scheduler.node_templates,
             scheduler.instance_types,
             daemon_overhead=scheduler.daemon_overhead,
-            zones=scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ()),
-            capacity_types=scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ()),
+            zones=zones,
+            capacity_types=capacity_types,
+            catalog=catalog,
         )
         leftover = list(problem.host_pods)
         if problem.P == 0:
@@ -210,9 +229,9 @@ class DenseSolver:
             buckets = [b for b in buckets if b.pod_rows]
         t1 = time.perf_counter()
         if buckets:
-            assignment = self._device_solve(problem, buckets)
+            prep = self._device_solve(scheduler, problem, buckets, taken)
             t2 = time.perf_counter()
-            committed, fallback_rows = self._verify_and_commit(scheduler, problem, buckets, assignment, taken)
+            committed, fallback_rows = self._apply_commit(scheduler, prep)
         else:
             t2 = time.perf_counter()
             unassigned = np.arange(problem.P) if taken is None else np.nonzero(~taken)[0]
@@ -581,7 +600,7 @@ class DenseSolver:
 
     # -- step 3: device solve -------------------------------------------------
 
-    def _device_solve(self, problem: DenseProblem, buckets: List[_Bucket]):
+    def _device_solve(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], taken: Optional[np.ndarray] = None):
         """Bucket→type choice on device; packing via counts (see
         pack_counts.py for why the per-pod scan is the wrong shape for TPU).
 
@@ -589,12 +608,14 @@ class DenseSolver:
         tunnel is pure latency (~70 ms), so the host *speculates*: it previews
         the same argmin formula in numpy float32 and packs every bucket while
         the device result is in flight. When the result lands it is
-        authoritative — any bucket where the device disagrees with the
-        preview is repacked against the device's choice. On directly-attached
-        TPU (us-scale dispatch) the speculation is simply always-confirmed
-        work that overlapped nothing.
+        authoritative — any bucket where the device *materially* disagrees
+        with the preview (feasibility flip, or a strictly cheaper choice
+        beyond f32 tie noise) is repacked against the device's choice. On
+        directly-attached TPU (us-scale dispatch) the speculation is simply
+        always-confirmed work that overlapped nothing.
 
-        Returns per-pod row→bin assignment plus per-bin metadata.
+        Returns the prepared-commit dict from _prepare_commit (records,
+        fallback_rows, remaining, committed) for _apply_commit to make real.
         """
         import jax.numpy as jnp
 
@@ -702,9 +723,20 @@ class DenseSolver:
                 packed_fut = _plain_dispatch()
         if mesh is not None:
             self.stats.sharded_batches += 1
+        # start the device->host copy as soon as the result is ready, so the
+        # fetch overlaps the speculation below instead of starting at the
+        # blocking asarray. Errors stay deferred to the guarded blocking
+        # np.asarray below — a runtime failure surfacing here must not bypass
+        # the pallas/mesh retirement fallbacks.
+        try:
+            copy_async = getattr(packed_fut, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        except Exception:
+            pass  # the blocking fetch below re-raises under its handlers
 
         # speculate under the in-flight round trip
-        prev_tstar, prev_feasible = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
+        prev_tstar, prev_feasible, prev_key = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
         local: List[tuple] = []
         for b, bucket in enumerate(buckets):
             rows = np.asarray(bucket.pod_rows, dtype=np.int64)
@@ -712,8 +744,10 @@ class DenseSolver:
             pack = self._pack_bucket(bucket, reqs, caps_eff[prev_tstar[b]]) if prev_feasible[b] else None
             local.append((rows, reqs, pack))
 
-        # speculative assembly + audit, still under the in-flight round trip
+        # speculative assembly + audit + full commit preparation (node
+        # construction), still under the in-flight round trip
         sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
+        prep = self._prepare_commit(scheduler, problem, buckets, sol, taken)
 
         try:
             packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
@@ -733,15 +767,31 @@ class DenseSolver:
         tstar, feasible = packed[0], packed[2].astype(bool)
         changed = False
         for b, bucket in enumerate(buckets):
-            if bool(feasible[b]) != bool(prev_feasible[b]) or (feasible[b] and tstar[b] != prev_tstar[b]):
+            if bool(feasible[b]) != bool(prev_feasible[b]):
                 rows, reqs, _ = local[b]
                 pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]]) if feasible[b] else None
                 local[b] = (rows, reqs, pack)
                 changed = True
-        if changed:  # rare: an f32 rounding tie broke differently on device
+            elif feasible[b] and tstar[b] != prev_tstar[b]:
+                # TPU f32 division rounds differently by ~1 ulp, and
+                # price-proportional catalogs make the cost key near-constant
+                # across types — so index disagreements are usually sub-ulp
+                # argmin ties, not information. Repack only when the device's
+                # choice is *materially* cheaper than the speculated one
+                # (beyond f32 tie noise); cost-equivalent choices keep the
+                # speculative pack (commit-time audits are exact either way).
+                k_prev = prev_key[b, prev_tstar[b]]
+                k_dev = prev_key[b, tstar[b]]
+                if not (np.isfinite(k_dev) and k_dev < k_prev * np.float32(1.0 - 1e-5)):
+                    continue
+                rows, reqs, _ = local[b]
+                pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]])
+                local[b] = (rows, reqs, pack)
+                changed = True
+        if changed:  # genuine disagreement: re-run assembly + preparation
             sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
-        sol["tstar"] = tstar
-        return sol
+            prep = self._prepare_commit(scheduler, problem, buckets, sol, taken)
+        return prep
 
     def _sharded_dispatch(self, mesh, catalog, bucket_stats: np.ndarray, allowed: np.ndarray):
         """Dispatch the bucket->type choice over the multi-device mesh.
@@ -941,10 +991,16 @@ class DenseSolver:
         return donors
 
     # -- steps 4+5: verify & commit ------------------------------------------
+    # Split into a *pure* preparation half (_prepare_commit — builds every
+    # VirtualNode, options list, and the fallback set without touching
+    # scheduler state, so it runs speculatively under the device round trip)
+    # and a cheap mutation half (_apply_commit — registers hostnames, appends
+    # nodes, records topology counts) that runs once the device result is
+    # confirmed.
 
-    def _verify_and_commit(
+    def _prepare_commit(
         self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol, taken: Optional[np.ndarray] = None
-    ) -> Tuple[int, List[int]]:
+    ) -> dict:
         from ..scheduler.node import VirtualNode
         from ..scheduler.scheduler import filter_by_remaining_resources, subtract_max
 
@@ -957,8 +1013,9 @@ class DenseSolver:
             unplaced = unplaced[~taken[unplaced]]
         fallback_rows: List[int] = [int(r) for r in unplaced]
 
+        prep: dict = {"fallback_rows": fallback_rows, "records": [], "remaining": None, "committed": 0, "inverse_by_uid": {}}
         if num_bins == 0:
-            return 0, fallback_rows
+            return prep
 
         usage = sol["usage"]
         bin_rows = sol["bin_rows"]
@@ -979,6 +1036,11 @@ class DenseSolver:
         # own requirements.
         match_cache: Dict[int, list] = {}
         inverse_by_uid = scheduler.topology.inverse_owner_index()
+        prep["inverse_by_uid"] = inverse_by_uid
+        # limits simulation runs against a local copy: the sequential
+        # filter→subtractMax chain must see earlier bins' pessimism, but
+        # scheduler state stays untouched until _apply_commit
+        remaining_local = dict(scheduler.remaining_resources)
 
         # per-bucket prototype requirements: template ∩ group ∩ zone/ct is a
         # bucket-level fact; each bin copies the prototype and adds only its
@@ -1034,7 +1096,7 @@ class DenseSolver:
             # breach, then apply the subtractMax pessimism after commit —
             # the exact sequential invariant the host loop keeps per opened
             # node (scheduler.go:263-284), via the host loop's own helpers
-            remaining = scheduler.remaining_resources.get(template.provisioner_name)
+            remaining = remaining_local.get(template.provisioner_name)
             if remaining is not None:
                 options = filter_by_remaining_resources(options, remaining)
                 if not options:
@@ -1045,22 +1107,38 @@ class DenseSolver:
                 fallback_rows.extend(bin_rows[bid])
                 continue
             daemon = scheduler.daemon_overhead.get(template.provisioner_name, {})
-            node = VirtualNode.open_prepared(template, proto.copy(), scheduler.topology, daemon, options)
+            node = VirtualNode.open_prepared(
+                template, proto.copy(), scheduler.topology, daemon, options, register=False
+            )
             reqs = node.template.requirements
 
             node.pods = [problem.pods[row] for row in bin_rows[bid]]
             node.requests = res.merge(
                 node.requests, {name: float(v) for name, v in zip(problem.resource_names, usage[bid]) if v > 0}
             )
-            scheduler.nodes.append(node)
-            n_pods = len(node.pods)
-            committed += n_pods
+            committed += len(node.pods)
 
             matching = match_cache.get(bucket_key)
             if matching is None:
                 matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
                 match_cache[bucket_key] = matching
-            scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
+            prep["records"].append((node, reqs, matching))
             if remaining is not None:
-                scheduler.remaining_resources[template.provisioner_name] = subtract_max(remaining, options)
-        return committed, fallback_rows
+                remaining_local[template.provisioner_name] = subtract_max(remaining, options)
+        prep["committed"] = committed
+        prep["remaining"] = remaining_local
+        return prep
+
+    def _apply_commit(self, scheduler, prep: dict) -> Tuple[int, List[int]]:
+        """Make a prepared commit real: per bin (in pack order) register the
+        placeholder hostname, append the node, and record topology counts —
+        the only scheduler-state mutations of the dense path."""
+        inverse_by_uid = prep["inverse_by_uid"]
+        for node, reqs, matching in prep["records"]:
+            node.register_hostname()
+            scheduler.nodes.append(node)
+            scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
+        if prep["remaining"] is not None:
+            scheduler.remaining_resources.clear()
+            scheduler.remaining_resources.update(prep["remaining"])
+        return prep["committed"], prep["fallback_rows"]
