@@ -430,6 +430,7 @@ def encode_problem(
     zones: Optional[Sequence[str]] = None,
     capacity_types: Optional[Sequence[str]] = None,
     catalog: Optional[CatalogEncoding] = None,
+    catalog_key_hint: Optional[tuple] = None,
 ) -> DenseProblem:
     """Encode a batch against the weight-ordered node templates.
 
@@ -444,10 +445,14 @@ def encode_problem(
     templates = list(templates)
     if catalog is None:
         catalog = encode_catalog(templates, instance_types, zones, capacity_types)
-    elif catalog.key != catalog_key(templates, instance_types, zones, capacity_types):
+    else:
         # a stale catalog would silently bind groups to the wrong template's
-        # type segment — fail loud instead
-        raise ValueError("CatalogEncoding does not match the supplied templates/instance_types/domains")
+        # type segment — fail loud instead. A caller that just looked the
+        # catalog up under its key passes it as catalog_key_hint to avoid
+        # recomputing template signatures on the hot path.
+        expected = catalog_key_hint if catalog_key_hint is not None else catalog_key(templates, instance_types, zones, capacity_types)
+        if catalog.key != expected:
+            raise ValueError("CatalogEncoding does not match the supplied templates/instance_types/domains")
     type_list = catalog.type_list
     type_template_ids = catalog.type_template_ids
     segment_bounds = catalog.segment_bounds

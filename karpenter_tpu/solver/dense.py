@@ -214,6 +214,7 @@ class DenseSolver:
             zones=zones,
             capacity_types=capacity_types,
             catalog=catalog,
+            catalog_key_hint=ckey,
         )
         leftover = list(problem.host_pods)
         if problem.P == 0:
@@ -275,8 +276,22 @@ class DenseSolver:
                     buckets.extend(self._water_fill(problem, topology, group, rows, problem.capacity_types, problem.group_ct_allowed[g], "ct"))
             elif group.kind == GroupKind.AFFINITY:
                 if group.topology_key == lbl.LABEL_HOSTNAME:
-                    # whole component shares one node
-                    buckets.append(_Bucket(group_index=g, single_bin=True, pod_rows=rows))
+                    # Required self-affinity pins the component to an
+                    # *already-populated* domain when one exists
+                    # (topologygroup.py _next_domain_affinity): a fresh-host
+                    # bin would violate it, so populated groups take the
+                    # exact host loop. Zero-count groups bootstrap: the
+                    # whole component shares one (possibly fresh) node.
+                    populated = any(
+                        count > 0
+                        for tg in topology.topologies.values()
+                        if tg.key == lbl.LABEL_HOSTNAME and tg.is_owned_by(group.pods[0].uid)
+                        for count in tg.domains.values()
+                    )
+                    if populated:
+                        buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
+                    else:
+                        buckets.append(_Bucket(group_index=g, single_bin=True, pod_rows=rows))
                 else:
                     zone = self._pick_affinity_zone(problem, topology, group)
                     if zone is None:
@@ -483,11 +498,53 @@ class DenseSolver:
             if not bucket.pod_rows or bucket.zone == "__infeasible__":
                 continue
             if bucket.dedicated or bucket.single_bin:
-                # per-host zero-count checks (anti-affinity, hostname spread/
-                # affinity) need the exact host protocol, which also fills
-                # existing nodes first — route these pods there rather than
-                # densely opening fresh nodes while existing capacity idles
-                bucket.pod_rows = []
+                # Per-host zero-count constraints (anti-affinity, hostname
+                # spread, hostname affinity). Fill existing capacity through
+                # the exact view.add protocol — it enforces the per-host
+                # count rules — then leave the remainder IN the bucket for
+                # the dense new-bin pack (fresh hostnames are zero-count by
+                # construction), instead of routing hundreds of pods through
+                # the O(pods x views) host loop.
+                group = problem.groups[bucket.group_index]
+                rows = bucket.pod_rows
+                order = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
+                queue = [rows[i] for i in order]
+                viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
+                if bucket.single_bin:
+                    # whole component shares one host: only a view whose free
+                    # capacity swallows the entire component is safe (greedy
+                    # adds cannot backtrack a half-placed component)
+                    total = problem.requests[rows].sum(axis=0)
+                    for vi in viable:
+                        if tols[vi] is None or not np.all(total <= frees[vi] + tols[vi]):
+                            continue
+                        if commit(vi, queue[0]):
+                            for row in queue[1:]:
+                                if not commit(vi, row):
+                                    # rare (ports/volume veto mid-component):
+                                    # the host loop owns the remainder — it
+                                    # sees the recorded affinity domain and
+                                    # applies the exact bootstrap rules
+                                    bucket.zone = "__infeasible__"
+                                    break
+                            break  # component is bound to this host now
+                else:
+                    # dedicated: at most one pod per host; for each view take
+                    # the first (largest-first) pod that fits, so a small
+                    # view still serves a small pod. A commit veto on a
+                    # capacity-checked pod is group-level for these buckets
+                    # (taints/requirements/zero-count on this host), so give
+                    # the view up rather than retrying every pod on it.
+                    for vi in viable:
+                        if not queue:
+                            break
+                        for qi, row in enumerate(queue):
+                            if not np.all(problem.requests[row] <= frees[vi] + tols[vi]):
+                                continue
+                            if commit(vi, row):
+                                queue.pop(qi)
+                            break
+                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
                 continue
             group = problem.groups[bucket.group_index]
             if group.kind == GroupKind.SPREAD:
@@ -776,14 +833,17 @@ class DenseSolver:
                 # TPU f32 division rounds differently by ~1 ulp, and
                 # price-proportional catalogs make the cost key near-constant
                 # across types — so index disagreements are usually sub-ulp
-                # argmin ties, not information. Repack only when the device's
-                # choice is *materially* cheaper than the speculated one
-                # (beyond f32 tie noise); cost-equivalent choices keep the
-                # speculative pack (commit-time audits are exact either way).
-                k_prev = prev_key[b, prev_tstar[b]]
-                k_dev = prev_key[b, tstar[b]]
-                if not (np.isfinite(k_dev) and k_dev < k_prev * np.float32(1.0 - 1e-5)):
-                    continue
+                # argmin ties, not information (prev_tstar is the argmin of
+                # prev_key, so any type the host also scored can only be >=
+                # its choice). The one case where the device's answer carries
+                # new information: the host preview scored the device's type
+                # INFEASIBLE (a boundary f32 fit the TPU rounded the other
+                # way). Adopt it when it is genuinely cheaper; the exact
+                # f64 audit in _assemble remains the authority either way.
+                if np.isfinite(prev_key[b, tstar[b]]):
+                    continue  # host scored it: no better than its own argmin
+                if problem.prices[tstar[b]] >= problem.prices[prev_tstar[b]]:
+                    continue  # not cheaper; keep the speculative pack
                 rows, reqs, _ = local[b]
                 pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]])
                 local[b] = (rows, reqs, pack)
@@ -901,20 +961,20 @@ class DenseSolver:
     _SPILL_BIN_PODS = 64  # donor bins larger than this stay dense
     _SPILL_TOTAL_PODS = 256  # pass budget: beyond this, host-loop time would bite
 
-    def _select_spill_donors(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> set:
-        """Pick bins to route to the exact host loop for cross-bucket packing.
+    def _select_spill_donors(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> Dict[int, int]:
+        """Nominate donor bins for cross-bucket packing; returns
+        {donor bin -> receiver bin}.
 
         The per-bucket dense pack cannot share one node between two
         constraint groups, so each bucket's remainder bin may open a node
         whose pods would have fit spare capacity on another bucket's bin —
         the one structural cost gap vs the ILP optimum (measured by
-        tests/test_cost_regret.py). The host loop already expresses the
-        sharing exactly: it fills in-flight nodes (the committed dense bins)
-        before opening new ones (scheduler.go:191-205). So: any small
-        remainder bin of a PLAIN bucket whose pods could fit another bin's
-        cost-neutral spare is *not committed*; its pods fall back to the
-        host loop, which re-packs them — onto committed bins when the exact
-        protocol admits them, onto a fresh FFD node otherwise.
+        tests/test_cost_regret.py). A donor's pods are not committed as
+        their own bin; _apply_commit re-adds each one directly onto the
+        nominated receiver's VirtualNode through the exact add protocol
+        (node.py:add — the same per-pod checks the host loop would run,
+        without its O(pods x open-nodes) scan); pods the protocol vetoes
+        fall back to the host loop.
 
         Cost-neutral spare: free capacity under the bin's cheapest surviving
         type, so absorbing a spilled pod can never raise that bin's launch
@@ -932,7 +992,7 @@ class DenseSolver:
         """
         num_bins = sol["num_bins"]
         if num_bins < 2:
-            return set()
+            return {}
         bin_bucket = sol["bin_bucket"]
         bin_rows = sol["bin_rows"]
         usage = sol["usage"]
@@ -964,7 +1024,7 @@ class DenseSolver:
         ]
         candidates.sort(key=lambda bid: len(bin_rows[bid]))
 
-        donors: set = set()
+        donors: Dict[int, int] = {}  # donor bin -> nominated receiver bin
         pinned: set = set()  # bins claimed as receivers: stay committed, one donor each
         budget = self._SPILL_TOTAL_PODS
         for bid in candidates:
@@ -985,7 +1045,7 @@ class DenseSolver:
                     receiver = r
                     break
             if receiver >= 0:
-                donors.add(bid)
+                donors[bid] = receiver
                 pinned.add(receiver)
                 budget -= len(rows)
         return donors
@@ -1013,7 +1073,15 @@ class DenseSolver:
             unplaced = unplaced[~taken[unplaced]]
         fallback_rows: List[int] = [int(r) for r in unplaced]
 
-        prep: dict = {"fallback_rows": fallback_rows, "records": [], "remaining": None, "committed": 0, "inverse_by_uid": {}}
+        prep: dict = {
+            "fallback_rows": fallback_rows,
+            "records": [],
+            "remaining": None,
+            "committed": 0,
+            "inverse_by_uid": {},
+            "spill_pods": [],
+            "pods": problem.pods,
+        }
         if num_bins == 0:
             return prep
 
@@ -1024,7 +1092,7 @@ class DenseSolver:
         # provisioner limits the limits filter can knock a receiver out
         # mid-loop (phantom receiver), so the pass stays off — limits
         # batches keep the plain per-bucket commit.
-        spill = set() if scheduler.remaining_resources else self._select_spill_donors(problem, buckets, sol)
+        spill = {} if scheduler.remaining_resources else self._select_spill_donors(problem, buckets, sol)
 
         # identical dedicated bins share options lists; cache by content
         options_cache: Dict[bytes, list] = {}
@@ -1074,9 +1142,11 @@ class DenseSolver:
             return proto
 
         committed = 0
+        record_of_bid: Dict[int, int] = {}  # receiver bin -> index into records
+        spill_pods: List[tuple] = []  # (row, receiver bid)
         for bid in range(num_bins):
-            if bid in spill:  # cross-bucket spill: the host loop re-packs
-                fallback_rows.extend(int(r) for r in bin_rows[bid])
+            if bid in spill:  # cross-bucket spill: re-add onto the receiver
+                spill_pods.extend((int(r), spill[bid]) for r in bin_rows[bid])
                 continue
             bucket_key = int(bin_bucket[bid])
             bucket = buckets[bucket_key]
@@ -1122,9 +1192,17 @@ class DenseSolver:
             if matching is None:
                 matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
                 match_cache[bucket_key] = matching
+            record_of_bid[bid] = len(prep["records"])
             prep["records"].append((node, reqs, matching))
             if remaining is not None:
                 remaining_local[template.provisioner_name] = subtract_max(remaining, options)
+        # spill donors whose receiver never committed (audit/proto drop) have
+        # no node to land on — host loop
+        for row, rbid in spill_pods:
+            if rbid in record_of_bid:
+                prep["spill_pods"].append((row, record_of_bid[rbid]))
+            else:
+                fallback_rows.append(row)
         prep["committed"] = committed
         prep["remaining"] = remaining_local
         return prep
@@ -1132,7 +1210,11 @@ class DenseSolver:
     def _apply_commit(self, scheduler, prep: dict) -> Tuple[int, List[int]]:
         """Make a prepared commit real: per bin (in pack order) register the
         placeholder hostname, append the node, and record topology counts —
-        the only scheduler-state mutations of the dense path."""
+        the only scheduler-state mutations of the dense path. Spilled pods
+        then re-add directly onto their nominated receiver node through the
+        exact protocol; vetoes fall back to the host loop."""
+        from ..scheduler.errors import IncompatibleError
+
         inverse_by_uid = prep["inverse_by_uid"]
         for node, reqs, matching in prep["records"]:
             node.register_hostname()
@@ -1141,4 +1223,13 @@ class DenseSolver:
         if prep["remaining"] is not None:
             scheduler.remaining_resources.clear()
             scheduler.remaining_resources.update(prep["remaining"])
-        return prep["committed"], prep["fallback_rows"]
+        committed = prep["committed"]
+        fallback_rows = prep["fallback_rows"]
+        for row, rec_index in prep["spill_pods"]:
+            node = prep["records"][rec_index][0]
+            try:
+                node.add(prep["pods"][row])
+                committed += 1
+            except IncompatibleError:
+                fallback_rows.append(row)
+        return committed, fallback_rows

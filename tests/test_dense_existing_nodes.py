@@ -244,3 +244,53 @@ class TestConsolidationUsesDensePath:
         assert solver.stats.pods_on_existing == 12
         assert not results.new_nodes, "pods fit on surviving capacity -> delete candidate"
         assert all_scheduled_names(results) == {p.name for p in pods}
+
+
+class TestPopulatedAffinityDomain:
+    """Required hostname self-affinity with an already-populated domain must
+    never dense-pack onto a fresh host (topologygroup.py
+    _next_domain_affinity pins the populated domain); the exact host loop
+    owns those pods. Regression: round-2 briefly let the single_bin
+    remainder open fresh bins."""
+
+    def _run(self, allocatable, expect_placed):
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+        from karpenter_tpu.api.labels import LABEL_HOSTNAME
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from tests.helpers import make_node
+
+        kube = KubeCluster()
+        label = {"app": "aff-cohort"}
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=label))
+        node = make_node(name="host-a", labels=base_labels(), allocatable=allocatable)
+        kube.create(node)
+        # a running cohort member bound to host-a populates the domain
+        kube.create(make_pod(labels=label, requests={"cpu": 0.5}, node_name="host-a", phase="Running", unschedulable=False))
+
+        pods = [
+            make_pod(labels=label, requests={"cpu": 0.5, "memory": "256Mi"}, pod_requirements=[term])
+            for _ in range(3)
+        ]
+        view = make_state_node(node=node, available=allocatable)
+        provisioners = [make_provisioner()]
+        provider = FakeCloudProvider(instance_types(20))
+        solver = DenseSolver(min_batch=1)
+        scheduler = build_scheduler(
+            provisioners, provider, pods, kube=kube, state_nodes=[view], dense_solver=solver
+        )
+        results = scheduler.solve(pods)
+        placed_on_view = sum(len(v.pods) for v in results.existing_nodes)
+        placed_fresh = sum(len(n.pods) for n in results.new_nodes)
+        assert placed_fresh == 0, "fresh node violates populated required affinity"
+        assert placed_on_view == expect_placed
+        return results
+
+    def test_cohort_joins_populated_host(self):
+        results = self._run({"cpu": 16, "memory": "32Gi", "pods": 110}, expect_placed=3)
+        assert not results.unschedulable
+
+    def test_cohort_unschedulable_when_populated_host_full(self):
+        # host-a has room for only one more pod; the rest must NOT open a
+        # fresh host (required affinity pins host-a) -> unschedulable
+        results = self._run({"cpu": 0.9, "memory": "32Gi", "pods": 110}, expect_placed=1)
+        assert len(results.unschedulable) == 2
