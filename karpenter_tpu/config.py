@@ -12,13 +12,20 @@ from typing import Callable, List
 
 DEFAULT_BATCH_MAX_DURATION = 10.0
 DEFAULT_BATCH_IDLE_DURATION = 1.0
+DEFAULT_LOG_LEVEL = "info"
 
 
 class Config:
-    def __init__(self, batch_max_duration: float = DEFAULT_BATCH_MAX_DURATION, batch_idle_duration: float = DEFAULT_BATCH_IDLE_DURATION):
+    def __init__(
+        self,
+        batch_max_duration: float = DEFAULT_BATCH_MAX_DURATION,
+        batch_idle_duration: float = DEFAULT_BATCH_IDLE_DURATION,
+        log_level: str = DEFAULT_LOG_LEVEL,
+    ):
         self._lock = threading.Lock()
         self._batch_max_duration = batch_max_duration
         self._batch_idle_duration = batch_idle_duration
+        self._log_level = log_level
         self._handlers: List[Callable[["Config"], None]] = []
 
     @property
@@ -31,11 +38,16 @@ class Config:
         with self._lock:
             return self._batch_idle_duration
 
+    @property
+    def log_level(self) -> str:
+        with self._lock:
+            return self._log_level
+
     def on_change(self, handler: Callable[["Config"], None]) -> None:
         with self._lock:
             self._handlers.append(handler)
 
-    def update(self, batch_max_duration=None, batch_idle_duration=None) -> None:
+    def update(self, batch_max_duration=None, batch_idle_duration=None, log_level=None) -> None:
         changed = False
         with self._lock:
             if batch_max_duration is not None and batch_max_duration != self._batch_max_duration:
@@ -43,6 +55,9 @@ class Config:
                 changed = True
             if batch_idle_duration is not None and batch_idle_duration != self._batch_idle_duration:
                 self._batch_idle_duration = batch_idle_duration
+                changed = True
+            if log_level is not None and log_level != self._log_level:
+                self._log_level = log_level
                 changed = True
             handlers = list(self._handlers)
         if changed:

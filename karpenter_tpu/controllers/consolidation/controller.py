@@ -31,8 +31,11 @@ from ...kube.cluster import KubeCluster
 from ...scheduler import SchedulerOptions
 from ...utils import pod as podutils
 from ..state.cluster import Cluster, StateNode
+from ...logsetup import get_logger
 from .helpers import disruption_cost, lifetime_remaining
 from .pdblimits import PDBLimits
+
+log = get_logger("consolidation")
 
 
 class ActionType(enum.Enum):
@@ -250,6 +253,7 @@ class ConsolidationController:
             )
             self.kube.create(node)
             action.replacement_name = node.name
+            log.info("consolidation replace: launching %s to replace %s (%s)", node.name, ", ".join(n.name for n in action.nodes), action.reason)
             self.metrics.nodes_created += 1
             # nominate so emptiness/other consolidation passes don't reap the
             # replacement before the old node's pods migrate to it
@@ -266,6 +270,7 @@ class ConsolidationController:
 
     def _terminate(self, action: ConsolidationAction) -> None:
         for node in action.nodes:
+            log.info("consolidation %s: terminating %s (%s)", action.type.value, node.name, action.reason)
             self.recorder.terminating_node(node, f"consolidation: {action.reason}")
             self.kube.delete(node)
             self.metrics.nodes_terminated += 1

@@ -24,9 +24,12 @@ from ...api import labels as lbl
 from ...api.objects import Node, OwnerReference
 from ...api.provisioner import Provisioner
 from ...kube.cluster import KubeCluster
+from ...logsetup import get_logger
 from ...utils import pod as podutils
 from ...utils import resources as res
 from ..state.cluster import Cluster
+
+log = get_logger("node")
 
 
 class NodeController:
@@ -91,6 +94,7 @@ class NodeController:
         if not self._extended_resources_registered(node):
             return False
         node.metadata.labels[lbl.LABEL_NODE_INITIALIZED] = "true"
+        log.info("node %s initialized (ready, startup taints cleared, extended resources registered)", node.name)
         return True
 
     def _extended_resources_registered(self, node: Node) -> bool:
@@ -135,6 +139,7 @@ class NodeController:
         if stamp is None:
             return
         if self.clock.now() - float(stamp) >= ttl:
+            log.info("deleting node %s: empty past ttlSecondsAfterEmpty=%.0fs", node.name, ttl)
             self.kube.delete(node)
 
     # -- expiration --------------------------------------------------------------
@@ -144,4 +149,5 @@ class NodeController:
         if ttl is None:
             return
         if self.clock.now() - node.metadata.creation_timestamp >= ttl:
+            log.info("deleting node %s: expired past ttlSecondsUntilExpired=%.0fs", node.name, ttl)
             self.kube.delete(node)

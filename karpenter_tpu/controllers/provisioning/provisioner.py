@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ...api import labels as lbl
 from ...api.objects import Pod
+from ...logsetup import get_logger
 from ...api.provisioner import Provisioner, order_by_weight
 from ...cloudprovider.types import CloudProvider, NodeRequest
 from ...config import Config
@@ -30,6 +31,8 @@ from ...utils import resources as res
 from ..state.cluster import Cluster
 from .batcher import Batcher
 from .volumetopology import VolumeTopology
+
+log = get_logger("provisioning")
 
 
 class ProvisionerController:
@@ -80,9 +83,7 @@ class ProvisionerController:
             try:
                 self.provision()
             except Exception:  # noqa: BLE001 - the loop is self-healing
-                import traceback
-
-                traceback.print_exc()
+                log.exception("provisioning round failed; next batch retries")
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -103,8 +104,19 @@ class ProvisionerController:
 
         state_nodes = self.cluster.nodes_snapshot()
         pods = self.get_pods()
+        start = self.clock.now()
         results = self.schedule(pods, state_nodes)
-        self.launch_nodes(results)
+        launched = self.launch_nodes(results)
+        if pods:
+            log.info(
+                "provisioned batch: %d pods -> %d new nodes (%d launched), %d on existing, %d unschedulable in %.0f ms",
+                len(pods),
+                len([n for n in results.new_nodes if n.pods]),
+                len(launched),
+                sum(len(v.pods) for v in results.existing_nodes),
+                len(results.unschedulable),
+                (self.clock.now() - start) * 1000,
+            )
         self.last_results = results
         return results
 
@@ -175,6 +187,7 @@ class ProvisionerController:
             usage = self._provisioner_usage(virtual_node.provisioner_name)
             reason = provisioner.spec.limits.exceeded_by(usage)
             if reason is not None:
+                log.warning("not launching node for provisioner %s: limits exceeded: %s", virtual_node.provisioner_name, reason)
                 for pod in virtual_node.pods:
                     self.recorder.pod_failed_to_schedule(pod, f"limits exceeded: {reason}")
                 return None
@@ -183,6 +196,7 @@ class ProvisionerController:
                 NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
             )
         except Exception as e:  # noqa: BLE001 - capacity errors self-heal next batch
+            log.warning("node launch failed for provisioner %s: %s", virtual_node.provisioner_name, e)
             for pod in virtual_node.pods:
                 self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
             return None

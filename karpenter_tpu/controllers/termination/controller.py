@@ -17,9 +17,12 @@ from ...api import labels as lbl
 from ...api.objects import NO_SCHEDULE, Node, Taint
 from ...cloudprovider.types import CloudProvider
 from ...events import Recorder
+from ...logsetup import get_logger
 from ...kube.cluster import KubeCluster
 from ...utils import pod as podutils
 from .eviction import EvictionQueue
+
+log = get_logger("termination")
 
 
 class TerminationController:
@@ -43,9 +46,11 @@ class TerminationController:
             return
         self.cordon(node)
         if not self.drain(node):
+            log.debug("draining %s: pods still evicting", node.name)
             return  # pods still evicting; re-reconcile later
         self.cloud_provider.delete(node)
         self.kube.finalize(node)
+        log.info("terminated node %s: drained, instance deleted, finalizer removed", node.name)
         if node.metadata.deletion_timestamp is not None:
             self.termination_durations.append(self.clock.now() - node.metadata.deletion_timestamp)
         self.recorder.terminating_node(node, "deleted node and cloud instance")

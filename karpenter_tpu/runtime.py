@@ -30,8 +30,11 @@ from .controllers.state.cluster import Cluster
 from .controllers.termination import TerminationController
 from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
+from .logsetup import configure as configure_logging, get_logger, set_level
 from .metrics import REGISTRY
 from .utils.options import Options
+
+log = get_logger("runtime")
 
 
 class LeaderElector:
@@ -64,7 +67,11 @@ class Runtime:
     dense_solver: object = None
 
     def __post_init__(self):
-        self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration)
+        configure_logging(self.options.log_level)
+        self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
+        # live log-level reload, the config-logging ConfigMap analog
+        # (controllers.go:240-248): a config update re-levels the tree
+        self.config.on_change(lambda cfg: set_level(cfg.log_level))
         self.recorder = DedupeRecorder(Recorder(), clock=self.kube.clock)
         self.cloud_provider = decorate(self.cloud_provider)
         webhooks.register(self.kube)
@@ -110,6 +117,12 @@ class Runtime:
             while not self.elector.try_acquire():
                 if self._stop.wait(timeout=0.5):
                     return
+            log.info("leader election won by %s", self.elector.identity)
+        log.info(
+            "runtime starting: provider=%s dense_solver=%s batch window idle=%.2fs max=%.2fs",
+            self.cloud_provider.name(), self.dense_solver is not None,
+            self.config.batch_idle_duration, self.config.batch_max_duration,
+        )
         self.provisioner.start()
         self._spawn(self._lifecycle_loop, "node-lifecycle")
         self._spawn(self._consolidation_loop, "consolidation")

@@ -37,7 +37,11 @@ class Topology:
         # the batch being scheduled must not count toward its own topologies
         self.excluded_pods: Set[str] = {p.uid for p in pods}
         # pods that have registered ownership at least once: update() only
-        # needs its remove-ownership sweep (O(groups)) for these
+        # needs its remove-ownership sweep (O(groups)) for these.
+        # INVARIANT: ownership enters self.topologies only through update()
+        # (relaxation copies preserve pod.uid, preferences.py). Any new code
+        # path that calls add_owner on a group directly must also add the uid
+        # here, or the skipped sweep will leave stale owners behind.
         self._registered: Set[str] = set()
         self._update_inverse_affinities()
         for p in pods:

@@ -643,6 +643,11 @@ class DenseSolver:
                 # production path off-TPU
                 cls._pallas_ok = False
                 return False
+            # Probe limitation: this compiles only the smallest padded shape
+            # class (Bp=8, Tp=128); a larger production shape class can still
+            # fail Mosaic compilation later. That failure is handled at
+            # dispatch time by _device_solve's retire-and-fallback, so the
+            # probe only needs to catch "Pallas is wholly unavailable".
             try:
                 from ..ops.pallas_kernels import bucket_type_cost_pallas
 

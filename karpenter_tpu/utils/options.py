@@ -25,6 +25,7 @@ class Options:
     dense_solver_enabled: bool = True
     dense_min_batch: int = 32
     cluster_name: str = ""
+    log_level: str = "info"
 
     def validate(self) -> List[str]:
         errs = []
@@ -36,6 +37,10 @@ class Options:
             errs.append("kube client qps must be positive")
         if self.batch_idle_duration <= 0 or self.batch_max_duration < self.batch_idle_duration:
             errs.append("batch durations must satisfy 0 < idle <= max")
+        from ..logsetup import _LEVELS
+
+        if self.log_level.lower() not in _LEVELS:
+            errs.append(f"invalid log level {self.log_level!r}")
         return errs
 
 
@@ -65,6 +70,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--disable-dense-solver", dest="dense_solver_enabled", action="store_false", default=_env("DENSE_SOLVER_ENABLED", defaults.dense_solver_enabled))
     parser.add_argument("--dense-min-batch", type=int, default=_env("DENSE_MIN_BATCH", defaults.dense_min_batch))
     parser.add_argument("--cluster-name", default=_env("CLUSTER_NAME", defaults.cluster_name))
+    parser.add_argument("--log-level", default=_env("LOG_LEVEL", defaults.log_level))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))
     errs = options.validate()

@@ -294,3 +294,60 @@ class TestPopulatedAffinityDomain:
         # fresh host (required affinity pins host-a) -> unschedulable
         results = self._run({"cpu": 0.9, "memory": "32Gi", "pods": 110}, expect_placed=1)
         assert len(results.unschedulable) == 2
+
+
+class TestSingleBinExistingFill:
+    """Bootstrap hostname-affinity components (zero-count domain) fill an
+    existing view when its free capacity swallows the WHOLE component; the
+    exact add protocol commits every member onto that one host."""
+
+    def _cohort(self, n, cpu):
+        from karpenter_tpu.api.labels import LABEL_HOSTNAME
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+        label = {"app": "bootstrap-aff"}
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=label))
+        return [
+            make_pod(labels=label, requests={"cpu": cpu, "memory": "256Mi"}, pod_requirements=[term])
+            for _ in range(n)
+        ]
+
+    def test_whole_component_fills_one_existing_view(self):
+        view = make_state_node(labels=base_labels(), allocatable={"cpu": 8, "memory": "16Gi", "pods": 50})
+        results, solver = solve_dense(self._cohort(4, 0.5), state_nodes=[view])
+        assert sum(len(v.pods) for v in results.existing_nodes) == 4
+        assert sum(len(n.pods) for n in results.new_nodes) == 0
+        assert solver.stats.pods_on_existing == 4
+        # all four share exactly one host
+        hosts = {id(v) for v in results.existing_nodes if v.pods}
+        assert len(hosts) == 1
+
+    def test_component_too_big_for_any_view_takes_fresh_host(self):
+        # component total (4 cpu) exceeds the view's free capacity: nothing
+        # commits onto the view (no half-placed component) and the whole
+        # cohort bootstraps one fresh node
+        view = make_state_node(labels=base_labels(), allocatable={"cpu": 2, "memory": "16Gi", "pods": 50})
+        results, solver = solve_dense(self._cohort(8, 0.5), state_nodes=[view])
+        assert sum(len(v.pods) for v in results.existing_nodes) == 0
+        new_with_pods = [n for n in results.new_nodes if n.pods]
+        assert len(new_with_pods) == 1 and len(new_with_pods[0].pods) == 8
+
+
+class TestSpillReceiverDropped:
+    """A spill donor whose nominated receiver never commits must fall back to
+    the host loop, never vanish (dense.py _prepare_commit guard)."""
+
+    def test_bogus_receiver_routes_donor_to_host_loop(self, monkeypatch):
+        from karpenter_tpu.solver.dense import DenseSolver as DS
+
+        pods = make_pods(10, requests={"cpu": 0.5, "memory": "512Mi"})
+        # nominate a receiver bin id that no record will ever have
+        monkeypatch.setattr(
+            DS, "_select_spill_donors", lambda self, problem, buckets, sol: {0: 10**6}
+        )
+        results, solver = solve_dense(pods)
+        placed = sum(len(n.pods) for n in results.new_nodes) + sum(
+            len(v.pods) for v in results.existing_nodes
+        )
+        assert placed == 10, "donor pods of a dropped receiver must reach the host loop"
+        assert not results.unschedulable

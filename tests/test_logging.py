@@ -1,0 +1,74 @@
+"""Logging subsystem: structured setup, live level reload, decision-point
+coverage (the zap-with-live-reload analog, controllers.go:240-248)."""
+
+import logging
+
+from karpenter_tpu import logsetup
+from karpenter_tpu.config import Config
+
+
+def teardown_function(_fn):
+    logsetup.reset_for_tests()
+    logsetup.set_level("info")
+
+
+def test_configure_is_idempotent_and_scoped():
+    root = logsetup.configure("info")
+    logsetup.configure("info")
+    assert len(root.handlers) == 1
+    assert root.propagate is False  # embedding apps keep their own topology
+    assert logging.getLogger().handlers == [] or root not in logging.getLogger().handlers
+
+
+def test_get_logger_namespaces_short_names():
+    assert logsetup.get_logger("provisioning").name == "karpenter_tpu.provisioning"
+    assert logsetup.get_logger("karpenter_tpu.solver").name == "karpenter_tpu.solver"
+
+
+def test_set_level_relevels_the_tree():
+    logsetup.configure("info")
+    child = logsetup.get_logger("provisioning")
+    assert not child.isEnabledFor(logging.DEBUG)
+    logsetup.set_level("debug")
+    assert child.isEnabledFor(logging.DEBUG)
+    logsetup.set_level("bogus")  # bad value falls back to info, never raises
+    assert logsetup.current_level() == "info"
+
+
+def test_config_live_reload_drives_log_level():
+    logsetup.configure("info")
+    config = Config()
+    config.on_change(lambda cfg: logsetup.set_level(cfg.log_level))
+    config.update(log_level="debug")
+    assert logsetup.current_level() == "debug"
+    config.update(log_level="warning")
+    assert logsetup.current_level() == "warning"
+
+
+def test_provisioning_round_logs_summary(caplog):
+    from tests.env import Environment
+    from tests.helpers import make_pod, make_provisioner
+
+    env = Environment()
+    env.kube.create(make_provisioner())
+    env.kube.create(make_pod(requests={"cpu": 1}))
+    with caplog.at_level(logging.INFO, logger="karpenter_tpu"):
+        env.provision()
+    assert any("provisioned batch" in r.getMessage() for r in caplog.records)
+
+
+def test_termination_logs_node_teardown(caplog):
+    from karpenter_tpu.controllers.termination import TerminationController
+    from tests.env import Environment
+    from tests.helpers import make_pod, make_provisioner
+
+    env = Environment()
+    env.kube.create(make_provisioner())
+    env.kube.create(make_pod(requests={"cpu": 1}))
+    env.provision()
+    termination = TerminationController(env.kube, env.provider, env.recorder, clock=env.clock)
+    node = env.kube.list_nodes()[0]
+    env.kube.delete(node)
+    with caplog.at_level(logging.INFO, logger="karpenter_tpu"):
+        termination.reconcile_all()
+    assert any("terminated node" in r.getMessage() for r in caplog.records)
