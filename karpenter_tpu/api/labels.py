@@ -8,6 +8,8 @@ normalization of deprecated beta labels onto their stable equivalents.
 
 from __future__ import annotations
 
+from typing import List
+
 # Kubernetes stable labels
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
@@ -93,3 +95,65 @@ def is_restricted_label(key: str) -> bool:
     if key in WELL_KNOWN_LABELS:
         return False
     return is_restricted_node_label(key)
+
+
+# -- label syntax validation (k8s.io/apimachinery util/validation) -----------
+
+import re as _re
+
+_NAME_RE = _re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+_DNS1123_SUBDOMAIN_RE = _re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+_DNS1123_LABEL_RE = _re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def qualified_name_errors(key: str) -> List[str]:
+    """validation.IsQualifiedName: optional DNS-subdomain prefix + '/' + name
+    of <=63 alphanumeric/-_. characters."""
+    errs: List[str] = []
+    if not key:
+        return ["name part must be non-empty"]
+    parts = key.split("/")
+    if len(parts) > 2:
+        return [f"a qualified name must have at most one '/': {key!r}"]
+    if len(parts) == 2:
+        prefix, name = parts
+        if not prefix:
+            errs.append(f"prefix part of {key!r} must be non-empty")
+        elif len(prefix) > 253 or not _DNS1123_SUBDOMAIN_RE.match(prefix):
+            errs.append(f"prefix part of {key!r} must be a valid DNS subdomain")
+    else:
+        name = parts[0]
+    if not name:
+        errs.append(f"name part of {key!r} must be non-empty")
+    elif len(name) > 63:
+        errs.append(f"name part of {key!r} must be 63 characters or less")
+    elif not _NAME_RE.match(name):
+        errs.append(
+            f"name part of {key!r} must consist of alphanumeric characters, '-', '_' or '.', "
+            "starting and ending alphanumeric"
+        )
+    return errs
+
+
+def label_value_errors(value: str) -> List[str]:
+    """validation.IsValidLabelValue: empty OK, else <=63 chars of the
+    qualified-name character class."""
+    if not value:
+        return []
+    if len(value) > 63:
+        return [f"label value {value!r} must be 63 characters or less"]
+    if not _NAME_RE.match(value):
+        return [
+            f"label value {value!r} must consist of alphanumeric characters, '-', '_' or '.', "
+            "starting and ending alphanumeric"
+        ]
+    return []
+
+
+def dns1123_name_errors(name: str) -> List[str]:
+    """Object-name validation (apis.ValidateObjectMetadata analog)."""
+    if not name:
+        return ["name is required"]
+    if len(name) > 253 or not _DNS1123_SUBDOMAIN_RE.match(name):
+        return [f"name {name!r} must be a lowercase DNS subdomain"]
+    return []

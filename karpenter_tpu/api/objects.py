@@ -410,6 +410,17 @@ class Namespace:
 
 
 @dataclass
+class ConfigMap:
+    """Key/value configuration object (the karpenter-global-settings
+    carrier, pkg/config/config.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    kind = "ConfigMap"
+
+
+@dataclass
 class DaemonSet:
     """A daemonset: its pod template contributes per-node overhead during
     scheduling (provisioner.go:339-360)."""

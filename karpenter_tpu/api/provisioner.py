@@ -88,39 +88,101 @@ def order_by_weight(provisioners: List[Provisioner]) -> List[Provisioner]:
     return sorted(provisioners, key=lambda p: -(p.spec.weight or 0))
 
 
-def validate_provisioner(provisioner: Provisioner) -> List[str]:
-    """Admission-style validation, equivalent of provisioner_validation.go.
+VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
 
-    Returns a list of human-readable violations (empty == valid).
-    """
+
+def validate_requirement(req: NodeSelectorRequirement) -> List[str]:
+    """Single-requirement rule set (ValidateRequirement,
+    provisioner_validation.go:177-209): normalization first, then operator
+    support, restricted-label, key/value syntax, and per-operator arity."""
     from .objects import OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN, OP_LT, OP_NOT_IN
 
     errs: List[str] = []
-    spec = provisioner.spec
-    for key in spec.labels:
-        if lbl.is_restricted_label(key):
-            errs.append(f"label {key} is restricted")
-    for taint in spec.taints + spec.startup_taints:
+    key = lbl.normalize_label(req.key)
+    if req.operator not in (OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT):
+        errs.append(f"key {key} has an unsupported operator {req.operator!r}")
+    if lbl.is_restricted_label(key):
+        errs.append(f"label {key} is restricted")
+    for e in lbl.qualified_name_errors(key):
+        errs.append(f"key {key} is not a qualified name, {e}")
+    for value in req.values:
+        for e in lbl.label_value_errors(value):
+            errs.append(f"invalid value {value!r} for key {key}, {e}")
+    if req.operator == OP_IN and not req.values:
+        errs.append(f"key {key} with operator {req.operator} must have a value defined")
+    if req.operator in (OP_EXISTS, OP_DOES_NOT_EXIST) and req.values:
+        errs.append(f"key {key} with operator {req.operator} must not have values")
+    if req.operator in (OP_GT, OP_LT):
+        ok = len(req.values) == 1 and req.values[0].isdigit()
+        if not ok:
+            errs.append(f"key {key} with operator {req.operator} must have a single positive integer value")
+    return errs
+
+
+def _validate_taints_field(taints: List[Taint], existing: set, field_name: str) -> List[str]:
+    errs: List[str] = []
+    for i, taint in enumerate(taints):
         if not taint.key:
-            errs.append("taint key is required")
-        if taint.effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
-            errs.append(f"invalid taint effect {taint.effect!r}")
-    for req in spec.requirements:
-        if req.operator not in (OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT):
-            errs.append(f"invalid requirement operator {req.operator!r}")
-        if req.operator in (OP_IN, OP_NOT_IN) and not req.values:
-            errs.append(f"requirement {req.key} with operator {req.operator} must have values")
-        if req.operator in (OP_EXISTS, OP_DOES_NOT_EXIST) and req.values:
-            errs.append(f"requirement {req.key} with operator {req.operator} must not have values")
-        if req.operator in (OP_GT, OP_LT):
-            if len(req.values) != 1 or not req.values[0].lstrip("-").isdigit():
-                errs.append(f"requirement {req.key} with operator {req.operator} needs a single integer value")
-        if lbl.is_restricted_label(req.key):
-            errs.append(f"requirement key {req.key} is restricted")
+            errs.append(f"{field_name}[{i}]: taint key is required")
+        else:
+            for e in lbl.qualified_name_errors(taint.key):
+                errs.append(f"{field_name}[{i}]: {e}")
+        if taint.value:
+            for e in lbl.label_value_errors(taint.value):
+                errs.append(f"{field_name}[{i}]: invalid value, {e}")
+        if taint.effect not in VALID_TAINT_EFFECTS + ("",):
+            errs.append(f"{field_name}[{i}]: invalid taint effect {taint.effect!r}")
+        pair = (taint.key, taint.effect)
+        if pair in existing:
+            errs.append(f"{field_name}[{i}]: duplicate taint Key/Effect pair {taint.key}={taint.effect}")
+        existing.add(pair)
+    return errs
+
+
+def validate_provisioner(provisioner: Provisioner) -> List[str]:
+    """Admission-style validation — the full rule set of
+    provisioner_validation.go (metadata, labels, taints incl. duplicate
+    key/effect pairs across taints+startupTaints, requirements, TTLs,
+    provider exclusivity). Returns human-readable violations (empty ==
+    valid)."""
+    errs: List[str] = []
+    spec = provisioner.spec
+
+    errs.extend(f"metadata: {e}" for e in lbl.dns1123_name_errors(provisioner.metadata.name))
+
+    # labels (validateLabels): restricted keys incl. the provisioner-name
+    # label itself, plus key/value syntax
+    for key, value in spec.labels.items():
+        if key == lbl.PROVISIONER_NAME_LABEL:
+            errs.append(f"label {key} is restricted")
+        errs.extend(f"labels: {e}" for e in lbl.qualified_name_errors(key))
+        errs.extend(f"labels[{key}]: {e}" for e in lbl.label_value_errors(value))
+        if key != lbl.PROVISIONER_NAME_LABEL and lbl.is_restricted_label(key):
+            errs.append(f"label {key} is restricted")
+
+    # taints + startupTaints share the duplicate-pair namespace
+    seen: set = set()
+    errs.extend(_validate_taints_field(spec.taints, seen, "taints"))
+    errs.extend(_validate_taints_field(spec.startup_taints, seen, "startupTaints"))
+
+    # requirements (validateRequirements)
+    for i, req in enumerate(spec.requirements):
+        if lbl.normalize_label(req.key) == lbl.PROVISIONER_NAME_LABEL:
+            errs.append(f"requirements[{i}]: {req.key} is restricted")
+        errs.extend(f"requirements[{i}]: {e}" for e in validate_requirement(req))
+
+    if spec.ttl_seconds_until_expired is not None and spec.ttl_seconds_until_expired < 0:
+        errs.append("ttlSecondsUntilExpired cannot be negative")
     if spec.ttl_seconds_after_empty is not None and spec.ttl_seconds_after_empty < 0:
-        errs.append("ttlSecondsAfterEmpty must be non-negative")
+        errs.append("ttlSecondsAfterEmpty cannot be negative")
     if spec.ttl_seconds_after_empty is not None and spec.consolidation and spec.consolidation.enabled:
         errs.append("ttlSecondsAfterEmpty is mutually exclusive with consolidation.enabled")
+    if spec.provider is not None and spec.provider_ref is not None:
+        errs.append("provider and providerRef are mutually exclusive")
     if spec.weight is not None and not (0 <= spec.weight <= 100):
         errs.append("weight must be within [0, 100]")
+    if spec.limits is not None:
+        for name, value in spec.limits.resources.items():
+            if value < 0:
+                errs.append(f"limits.resources[{name}] cannot be negative")
     return errs

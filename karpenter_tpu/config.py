@@ -63,3 +63,86 @@ class Config:
         if changed:
             for handler in handlers:
                 handler(self)
+
+
+# -- live ConfigMap watch (pkg/config/config.go:84-170) ----------------------
+
+CONFIGMAP_NAME = "karpenter-global-settings"
+
+DEFAULT_CONFIGMAP_DATA = {
+    "batchMaxDuration": "10s",
+    "batchIdleDuration": "1s",
+    "logLevel": DEFAULT_LOG_LEVEL,
+}
+
+_DURATION_SUFFIXES = (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0))
+
+
+def parse_duration(value: str) -> float:
+    """Go-style duration strings ('10s', '500ms', '1.5m') or bare seconds."""
+    text = str(value).strip()
+    for suffix, scale in _DURATION_SUFFIXES:
+        if text.endswith(suffix) and text[: -len(suffix)].replace(".", "", 1).lstrip("-").isdigit():
+            return float(text[: -len(suffix)]) * scale
+    return float(text)
+
+
+def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME) -> None:
+    """Subscribe the Config to the settings ConfigMap.
+
+    Mirrors the reference watcher (config.go:84-170): a content hash
+    suppresses redundant change notifications (hashCM), and a malformed or
+    invariant-violating value keeps the previous setting rather than taking
+    the controller down. Missing keys fall back to the Config's values at
+    watch time — i.e. CLI flags/env stay authoritative until the ConfigMap
+    explicitly sets a key (three-tier config: flags < live ConfigMap);
+    deleting the ConfigMap restores them.
+    """
+    from .logsetup import get_logger
+
+    log = get_logger("config")
+    # the launch-time configuration is the fallback for unset/removed keys
+    base = {
+        "batchMaxDuration": f"{config.batch_max_duration}s",
+        "batchIdleDuration": f"{config.batch_idle_duration}s",
+        "logLevel": config.log_level,
+    }
+    state = {"hash": None}
+
+    def on_event(event) -> None:
+        cm = event.obj
+        if cm.metadata.name != name:
+            return
+        if getattr(event, "type", None) == "DELETED":
+            data = dict(base)
+        else:
+            data = {**base, **(cm.data or {})}
+        content = tuple(sorted(data.items()))
+        digest = hash(content)
+        if digest == state["hash"]:
+            return
+        if state["hash"] is not None:
+            log.info("configuration change detected in %s", name)
+        state["hash"] = digest
+        updates = {}
+        for key, field_name in (("batchMaxDuration", "batch_max_duration"), ("batchIdleDuration", "batch_idle_duration")):
+            try:
+                seconds = parse_duration(data[key])
+            except ValueError:
+                log.warning("invalid %s %r; keeping previous", key, data[key])
+                continue
+            if seconds <= 0:
+                log.warning("invalid %s %r: must be positive; keeping previous", key, data[key])
+                continue
+            updates[field_name] = seconds
+        # the same invariant Options.validate enforces at boot: idle <= max
+        idle = updates.get("batch_idle_duration", config.batch_idle_duration)
+        max_ = updates.get("batch_max_duration", config.batch_max_duration)
+        if idle > max_:
+            log.warning("batchIdleDuration %.3fs > batchMaxDuration %.3fs; keeping previous durations", idle, max_)
+            updates.pop("batch_idle_duration", None)
+            updates.pop("batch_max_duration", None)
+        updates["log_level"] = str(data["logLevel"])
+        config.update(**updates)
+
+    kube.watch("ConfigMap", on_event)

@@ -24,12 +24,17 @@ from typing import Optional
 
 ROOT = "karpenter_tpu"
 
-_LEVELS = {
+LEVELS = {
     "debug": logging.DEBUG,
     "info": logging.INFO,
     "warning": logging.WARNING,
     "error": logging.ERROR,
 }
+_LEVELS = LEVELS  # backwards-compatible alias
+
+
+def is_valid_level(name: str) -> bool:
+    return str(name).lower() in LEVELS
 
 _lock = threading.Lock()
 _configured = False

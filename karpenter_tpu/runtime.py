@@ -20,7 +20,7 @@ from typing import List, Optional
 from . import webhooks
 from .cloudprovider.metrics import decorate
 from .cloudprovider.types import CloudProvider
-from .config import Config
+from .config import Config, watch_config
 from .controllers.consolidation import ConsolidationController
 from .controllers.counter import CounterController
 from .controllers.metrics import NodeMetricsScraper, PodMetricsController, ProvisionerMetricsController
@@ -72,9 +72,11 @@ class Runtime:
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree
         self.config.on_change(lambda cfg: set_level(cfg.log_level))
+        # live settings from the karpenter-global-settings ConfigMap
+        watch_config(self.kube, self.config)
         self.recorder = DedupeRecorder(Recorder(), clock=self.kube.clock)
         self.cloud_provider = decorate(self.cloud_provider)
-        webhooks.register(self.kube)
+        webhooks.register(self.kube, self.cloud_provider)
         self.cluster = Cluster(self.kube, self.cloud_provider, clock=self.kube.clock)
         if self.dense_solver is None and self.options.dense_solver_enabled:
             from .solver import DenseSolver

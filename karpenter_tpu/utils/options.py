@@ -37,9 +37,9 @@ class Options:
             errs.append("kube client qps must be positive")
         if self.batch_idle_duration <= 0 or self.batch_max_duration < self.batch_idle_duration:
             errs.append("batch durations must satisfy 0 < idle <= max")
-        from ..logsetup import _LEVELS
+        from ..logsetup import is_valid_level
 
-        if self.log_level.lower() not in _LEVELS:
+        if not is_valid_level(self.log_level):
             errs.append(f"invalid log level {self.log_level!r}")
         return errs
 

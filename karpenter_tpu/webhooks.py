@@ -15,36 +15,45 @@ class AdmissionError(RuntimeError):
     pass
 
 
-def default_provisioner(provisioner: Provisioner) -> None:
-    """Defaulting webhook: fill canonical defaults in place."""
+def default_provisioner(provisioner: Provisioner, cloud_provider=None) -> None:
+    """Defaulting webhook: fill canonical defaults in place, then give the
+    cloud provider its hook (the DefaultHook seam the reference's AWS
+    provider registers, cloudprovider.go:119-120)."""
     spec = provisioner.spec
     if spec.weight is None:
         spec.weight = 0
     for taint in list(spec.taints) + list(spec.startup_taints):
         if not taint.effect:
             taint.effect = "NoSchedule"
+    hook = getattr(cloud_provider, "default_provisioner", None)
+    if hook is not None:
+        hook(provisioner)
 
 
-def validate_or_raise(provisioner: Provisioner) -> None:
-    errs = validate_provisioner(provisioner)
+def validate_or_raise(provisioner: Provisioner, cloud_provider=None) -> None:
+    errs = list(validate_provisioner(provisioner))
+    hook = getattr(cloud_provider, "validate_provisioner", None)
+    if hook is not None:
+        errs.extend(hook(provisioner) or ())
     if errs:
         raise AdmissionError("; ".join(errs))
 
 
-def register(kube: KubeCluster) -> None:
-    """Install the admission chain on Provisioner writes."""
+def register(kube: KubeCluster, cloud_provider=None) -> None:
+    """Install the admission chain on Provisioner writes: defaulting first,
+    then validation (core rule set + provider hooks), rejection raises."""
     original_create, original_update = kube.create, kube.update
 
     def admitted_create(obj):
         if isinstance(obj, Provisioner):
-            default_provisioner(obj)
-            validate_or_raise(obj)
+            default_provisioner(obj, cloud_provider)
+            validate_or_raise(obj, cloud_provider)
         return original_create(obj)
 
     def admitted_update(obj):
         if isinstance(obj, Provisioner):
-            default_provisioner(obj)
-            validate_or_raise(obj)
+            default_provisioner(obj, cloud_provider)
+            validate_or_raise(obj, cloud_provider)
         return original_update(obj)
 
     kube.create = admitted_create  # type: ignore[method-assign]

@@ -1,0 +1,284 @@
+"""Provisioner validation/defaulting + live config reload.
+
+Mirrors the reference's apis suite
+(/root/reference/pkg/apis/provisioning/v1alpha5/suite_test.go, 270 LoC) and
+the config suite (/root/reference/pkg/config/suite_test.go): full
+provisioner_validation.go rule set, webhook defaulting chain with provider
+hooks, and the karpenter-global-settings ConfigMap watch with hash dedupe.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu import webhooks
+from karpenter_tpu.api.labels import LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE, PROVISIONER_NAME_LABEL
+from karpenter_tpu.api.objects import ConfigMap, NodeSelectorRequirement, ObjectMeta, Taint
+from karpenter_tpu.api.provisioner import validate_provisioner
+from karpenter_tpu.config import CONFIGMAP_NAME, Config, parse_duration, watch_config
+from karpenter_tpu.kube.cluster import KubeCluster
+
+from tests.helpers import make_provisioner
+
+
+def errs_of(prov):
+    return validate_provisioner(prov)
+
+
+class TestValidation:
+    def test_valid_provisioner_passes(self):
+        assert errs_of(make_provisioner()) == []
+
+    def test_metadata_name_required_and_dns1123(self):
+        p = make_provisioner()
+        p.metadata.name = ""  # ObjectMeta auto-names empty constructions
+        assert any("name is required" in e for e in errs_of(p))
+        p = make_provisioner(name="Not_DNS")
+        assert any("DNS subdomain" in e for e in errs_of(p))
+
+    # -- labels (validateLabels) --------------------------------------------
+
+    def test_label_restricted_provisioner_name(self):
+        p = make_provisioner(labels={PROVISIONER_NAME_LABEL: "self"})
+        assert any("restricted" in e for e in errs_of(p))
+
+    def test_label_restricted_domains(self):
+        for key in ("kubernetes.io/hostname", "karpenter.sh/custom", "sub.k8s.io/x"):
+            p = make_provisioner(labels={key: "v"})
+            assert any("restricted" in e for e in errs_of(p)), key
+
+    def test_label_domain_exceptions_allowed(self):
+        p = make_provisioner(labels={"kops.k8s.io/instancegroup": "nodes"})
+        assert errs_of(p) == []
+
+    def test_label_key_and_value_syntax(self):
+        p = make_provisioner(labels={"bad key!": "v"})
+        assert any("qualified name" in e or "alphanumeric" in e for e in errs_of(p))
+        p = make_provisioner(labels={"ok": "bad value!"})
+        assert any("alphanumeric" in e for e in errs_of(p))
+        p = make_provisioner(labels={"ok": "x" * 64})
+        assert any("63 characters" in e for e in errs_of(p))
+
+    # -- taints (validateTaints) --------------------------------------------
+
+    def test_taint_key_required(self):
+        p = make_provisioner(taints=[Taint(key="", effect="NoSchedule")])
+        assert any("taint key is required" in e for e in errs_of(p))
+
+    def test_taint_effect_whitelist(self):
+        p = make_provisioner(taints=[Taint(key="k", effect="Sideways")])
+        assert any("invalid taint effect" in e for e in errs_of(p))
+
+    def test_duplicate_key_effect_pair_within_taints(self):
+        p = make_provisioner(taints=[Taint(key="k", value="a", effect="NoSchedule"), Taint(key="k", value="b", effect="NoSchedule")])
+        assert any("duplicate taint" in e for e in errs_of(p))
+
+    def test_duplicate_pair_across_taints_and_startup_taints(self):
+        p = make_provisioner(
+            taints=[Taint(key="k", effect="NoSchedule")],
+            startup_taints=[Taint(key="k", effect="NoSchedule")],
+        )
+        assert any("duplicate taint" in e for e in errs_of(p))
+
+    def test_distinct_effects_allowed(self):
+        p = make_provisioner(taints=[Taint(key="k", effect="NoSchedule"), Taint(key="k", effect="NoExecute")])
+        assert errs_of(p) == []
+
+    # -- requirements (validateRequirements / ValidateRequirement) ----------
+
+    def r(self, key="node.kubernetes.io/instance-type", op="In", *values):
+        return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+    def test_requirement_provisioner_name_restricted(self):
+        p = make_provisioner(requirements=[self.r(PROVISIONER_NAME_LABEL, "In", "x")])
+        assert any("restricted" in e for e in errs_of(p))
+
+    def test_requirement_unsupported_operator(self):
+        p = make_provisioner(requirements=[self.r(LABEL_TOPOLOGY_ZONE, "Near", "a")])
+        assert any("unsupported operator" in e for e in errs_of(p))
+
+    def test_requirement_restricted_label(self):
+        p = make_provisioner(requirements=[self.r(LABEL_HOSTNAME, "In", "h")])
+        assert any("restricted" in e for e in errs_of(p))
+
+    def test_requirement_normalized_beta_key(self):
+        # beta zone key normalizes to the stable zone key — valid
+        p = make_provisioner(requirements=[self.r("failure-domain.beta.kubernetes.io/zone", "In", "z1")])
+        assert errs_of(p) == []
+
+    def test_requirement_in_needs_values(self):
+        p = make_provisioner(requirements=[self.r(LABEL_TOPOLOGY_ZONE, "In")])
+        assert any("must have a value" in e for e in errs_of(p))
+
+    def test_requirement_exists_must_not_have_values(self):
+        p = make_provisioner(requirements=[self.r(LABEL_TOPOLOGY_ZONE, "Exists", "z")])
+        assert any("must not have values" in e for e in errs_of(p))
+
+    def test_requirement_gt_lt_single_positive_integer(self):
+        for values in ((), ("1", "2"), ("-3",), ("abc",)):
+            p = make_provisioner(requirements=[self.r("custom", "Gt", *values)])
+            assert any("single positive integer" in e for e in errs_of(p)), values
+        p = make_provisioner(requirements=[self.r("custom", "Gt", "4")])
+        assert errs_of(p) == []
+
+    def test_requirement_bad_value_syntax(self):
+        p = make_provisioner(requirements=[self.r("custom", "In", "bad value!")])
+        assert any("invalid value" in e for e in errs_of(p))
+
+    # -- TTLs / provider / weight / limits -----------------------------------
+
+    def test_negative_ttls(self):
+        assert any("ttlSecondsUntilExpired" in e for e in errs_of(make_provisioner(ttl_seconds_until_expired=-1)))
+        assert any("ttlSecondsAfterEmpty" in e for e in errs_of(make_provisioner(ttl_seconds_after_empty=-1)))
+
+    def test_ttl_after_empty_excludes_consolidation(self):
+        p = make_provisioner(ttl_seconds_after_empty=30, consolidation_enabled=True)
+        assert any("mutually exclusive" in e for e in errs_of(p))
+
+    def test_provider_and_provider_ref_exclusive(self):
+        p = make_provisioner(provider={"instanceProfile": "x"})
+        p.spec.provider_ref = "my-template"
+        assert any("mutually exclusive" in e for e in errs_of(p))
+
+    def test_weight_range(self):
+        assert any("weight" in e for e in errs_of(make_provisioner(weight=101)))
+        assert errs_of(make_provisioner(weight=100)) == []
+
+    def test_negative_limits(self):
+        p = make_provisioner(limits={"cpu": 10})
+        p.spec.limits.resources["cpu"] = -1
+        assert any("cannot be negative" in e for e in errs_of(p))
+
+
+class TestAdmissionChain:
+    def test_create_rejects_invalid(self):
+        kube = KubeCluster()
+        webhooks.register(kube)
+        with pytest.raises(webhooks.AdmissionError):
+            kube.create(make_provisioner(taints=[Taint(key="", effect="NoSchedule")]))
+
+    def test_defaulting_fills_weight_and_taint_effect(self):
+        kube = KubeCluster()
+        webhooks.register(kube)
+        p = make_provisioner(taints=[Taint(key="team", value="a", effect="")])
+        p.spec.weight = None
+        kube.create(p)
+        assert p.spec.weight == 0
+        assert p.spec.taints[0].effect == "NoSchedule"
+
+    def test_provider_hooks_run(self):
+        class HookedProvider:
+            def __init__(self):
+                self.defaulted = []
+
+            def default_provisioner(self, prov):
+                self.defaulted.append(prov.name)
+                prov.spec.labels.setdefault("provider-defaulted", "true")
+
+            def validate_provisioner(self, prov):
+                if prov.spec.provider and "bad" in prov.spec.provider:
+                    return ["provider config is bad"]
+                return []
+
+        kube = KubeCluster()
+        provider = HookedProvider()
+        webhooks.register(kube, provider)
+        p = make_provisioner()
+        kube.create(p)
+        assert provider.defaulted == [p.name]
+        assert p.spec.labels["provider-defaulted"] == "true"
+        with pytest.raises(webhooks.AdmissionError, match="provider config is bad"):
+            kube.create(make_provisioner(name="second", provider={"bad": True}))
+
+
+class TestLiveConfig:
+    def test_parse_duration(self):
+        assert parse_duration("10s") == 10.0
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("1.5m") == 90.0
+        assert parse_duration("2") == 2.0
+        with pytest.raises(ValueError):
+            parse_duration("nope")
+
+    def test_configmap_drives_config(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        cm = ConfigMap(
+            metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"),
+            data={"batchMaxDuration": "5s", "batchIdleDuration": "200ms", "logLevel": "debug"},
+        )
+        kube.create(cm)
+        assert config.batch_max_duration == 5.0
+        assert config.batch_idle_duration == 0.2
+        assert config.log_level == "debug"
+
+    def test_missing_keys_fall_back_to_launch_config(self):
+        # CLI/env-derived launch values stay authoritative for keys the
+        # ConfigMap leaves unset (three-tier config: flags < ConfigMap)
+        kube = KubeCluster()
+        config = Config(batch_max_duration=99.0)
+        watch_config(kube, config)
+        kube.create(ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchIdleDuration": "2s"}))
+        assert config.batch_max_duration == 99.0  # launch value kept
+        assert config.batch_idle_duration == 2.0
+
+    def test_nonpositive_and_inverted_durations_rejected(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        cm = ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchMaxDuration": "-5s"})
+        kube.create(cm)
+        assert config.batch_max_duration == 10.0  # negative rejected
+        cm.data = {"batchIdleDuration": "30s", "batchMaxDuration": "5s"}
+        kube.update(cm)
+        assert config.batch_idle_duration == 1.0  # idle > max rejected as a pair
+        assert config.batch_max_duration == 10.0
+
+    def test_taint_value_label_syntax(self):
+        p = make_provisioner(taints=[Taint(key="dedicated", value="team/gpu", effect="NoSchedule")])
+        assert any("invalid value" in e for e in errs_of(p))
+
+    def test_hash_dedupe_suppresses_redundant_notifications(self):
+        kube = KubeCluster()
+        config = Config()
+        changes = []
+        config.on_change(lambda c: changes.append(c.batch_max_duration))
+        watch_config(kube, config)
+        cm = ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchMaxDuration": "5s"})
+        kube.create(cm)
+        assert changes == [5.0]
+        kube.update(cm)  # identical content: suppressed by the content hash
+        assert changes == [5.0]
+        cm.data["batchMaxDuration"] = "7s"
+        kube.update(cm)
+        assert changes == [5.0, 7.0]
+
+    def test_invalid_value_keeps_previous(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        cm = ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchMaxDuration": "5s"})
+        kube.create(cm)
+        cm.data["batchMaxDuration"] = "garbage"
+        cm.data["batchIdleDuration"] = "300ms"
+        kube.update(cm)
+        assert config.batch_max_duration == 5.0  # bad value ignored
+        assert config.batch_idle_duration == 0.3
+
+    def test_other_configmaps_ignored(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        kube.create(ConfigMap(metadata=ObjectMeta(name="unrelated", namespace="x"), data={"batchMaxDuration": "1s"}))
+        assert config.batch_max_duration == 10.0
+
+    def test_deletion_restores_defaults(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        cm = ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchMaxDuration": "5s"})
+        kube.create(cm)
+        assert config.batch_max_duration == 5.0
+        kube.delete(cm)
+        assert config.batch_max_duration == 10.0  # launch-time value restored
