@@ -103,7 +103,6 @@ import re as _re
 
 _NAME_RE = _re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
 _DNS1123_SUBDOMAIN_RE = _re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
-_DNS1123_LABEL_RE = _re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
 
 def qualified_name_errors(key: str) -> List[str]:

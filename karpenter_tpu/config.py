@@ -68,6 +68,7 @@ class Config:
 # -- live ConfigMap watch (pkg/config/config.go:84-170) ----------------------
 
 CONFIGMAP_NAME = "karpenter-global-settings"
+CONFIGMAP_NAMESPACE = "karpenter"  # the system namespace (config.go:85-88)
 
 DEFAULT_CONFIGMAP_DATA = {
     "batchMaxDuration": "10s",
@@ -87,7 +88,7 @@ def parse_duration(value: str) -> float:
     return float(text)
 
 
-def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME) -> None:
+def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: str = CONFIGMAP_NAMESPACE) -> None:
     """Subscribe the Config to the settings ConfigMap.
 
     Mirrors the reference watcher (config.go:84-170): a content hash
@@ -111,7 +112,9 @@ def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME) -> None:
 
     def on_event(event) -> None:
         cm = event.obj
-        if cm.metadata.name != name:
+        # both name AND namespace must match: a same-named ConfigMap in an
+        # unrelated namespace must not drive (or reset) controller settings
+        if cm.metadata.name != name or cm.metadata.namespace != namespace:
             return
         if getattr(event, "type", None) == "DELETED":
             data = dict(base)
@@ -142,7 +145,13 @@ def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME) -> None:
             log.warning("batchIdleDuration %.3fs > batchMaxDuration %.3fs; keeping previous durations", idle, max_)
             updates.pop("batch_idle_duration", None)
             updates.pop("batch_max_duration", None)
-        updates["log_level"] = str(data["logLevel"])
+        from .logsetup import is_valid_level
+
+        level = str(data["logLevel"])
+        if is_valid_level(level):
+            updates["log_level"] = level
+        else:
+            log.warning("invalid logLevel %r; keeping previous", level)
         config.update(**updates)
 
     kube.watch("ConfigMap", on_event)

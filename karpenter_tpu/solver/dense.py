@@ -965,10 +965,14 @@ class DenseSolver:
 
     _SPILL_BIN_PODS = 64  # donor bins larger than this stay dense
     _SPILL_TOTAL_PODS = 256  # pass budget: beyond this, host-loop time would bite
+    _SPILL_DENSE_BINS = 192  # above this many bins, only whole-bin plain spill runs
 
-    def _select_spill_donors(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> Dict[int, int]:
+    def _select_spill_donors(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> Dict[int, tuple]:
         """Nominate donor bins for cross-bucket packing; returns
-        {donor bin -> receiver bin}.
+        {donor bin -> (receiver bin, full)} where full=True means the whole
+        donor bin re-adds directly onto the receiver in _apply_commit and
+        full=False (partial, small scale only) routes the donor's pods
+        through the exact host loop.
 
         The per-bucket dense pack cannot share one node between two
         constraint groups, so each bucket's remainder bin may open a node
@@ -1008,51 +1012,90 @@ class DenseSolver:
         caps_eff = sol["caps_eff"]
         spare = caps_eff[cheapest_t] + res.tolerance(caps_eff[cheapest_t]) - usage  # [num_bins, R]
 
+        bucket_of = [buckets[int(b)] for b in bin_bucket]
         plain = np.asarray(
             [
-                problem.groups[buckets[int(b)].group_index].kind == GroupKind.PLAIN
-                and buckets[int(b)].zone is None
-                and buckets[int(b)].capacity_type is None
-                for b in bin_bucket
+                problem.groups[bk.group_index].kind == GroupKind.PLAIN
+                and bk.zone is None
+                and bk.capacity_type is None
+                for bk in bucket_of
             ]
         )
+        dedicated = np.asarray([bk.dedicated for bk in bucket_of])
         # remainder = last bin of each bucket's pack (patterns emit in order,
         # the partial pattern last)
         last_of_bucket: Dict[int, int] = {}
         for bid in range(num_bins):
             last_of_bucket[int(bin_bucket[bid])] = bid
 
+        # Donor candidates: (a) small remainder bins of PLAIN buckets, and
+        # (b) at small scale, EVERY dedicated bin (anti-affinity / hostname-
+        # spread pack one pod per fresh host, so each unshared bin is a
+        # whole node of cost — the dominant dense-vs-FFD gap; the host loop
+        # shares them onto other buckets' nodes, and the exact re-add in
+        # _apply_commit expresses the same sharing). single_bin components
+        # stay whole. The scale gate: per-donor exact re-adds and the
+        # per-candidate receiver scans are O(num_bins) each, and above a few
+        # hundred bins the remainder effect is <1% of cost while the pass
+        # would dominate wall-clock — there, only whole-bin plain spill runs.
+        small = num_bins <= self._SPILL_DENSE_BINS
         candidates = [
             bid
             for bid in last_of_bucket.values()
             if plain[bid] and mask_all[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
         ]
+        if small:
+            candidates.extend(bid for bid in range(num_bins) if dedicated[bid] and mask_all[bid].any())
         candidates.sort(key=lambda bid: len(bin_rows[bid]))
 
-        donors: Dict[int, int] = {}  # donor bin -> nominated receiver bin
-        pinned: set = set()  # bins claimed as receivers: stay committed, one donor each
+        receiver_ok = np.asarray(
+            [mask_all[r].any() and not dedicated[r] for r in range(num_bins)]
+        )
+        group_of = np.asarray([bk.group_index for bk in bucket_of])
+        donors: Dict[int, tuple] = {}  # donor bin -> (receiver bin, full?)
+        claimed: set = set()  # receivers stay committed: never donors later
+        spare = spare.copy()  # claimed spare is decremented per receiver
         budget = self._SPILL_TOTAL_PODS
         for bid in candidates:
             rows = bin_rows[bid]
-            if len(rows) > budget or bid in pinned:
+            if len(rows) > budget or bid in claimed:
                 continue
-            g = buckets[int(bin_bucket[bid])].group_index
+            g = bucket_of[bid].group_index
             reqs_d = problem.requests[rows]
-            receiver = -1
-            for r in range(num_bins):
-                if r == bid or r in donors or r in pinned:
+            need = reqs_d.sum(axis=0)
+            # vectorized receiver scan: compat with the receiver's cheapest
+            # type, not a donor itself, different group for dedicated donors
+            # (same-group bins would be vetoed by the zero-count rule anyway)
+            ok = receiver_ok & problem.compat[g, cheapest_t]
+            ok[bid] = False
+            if dedicated[bid]:
+                ok &= group_of != g
+            # prefer a receiver that swallows the WHOLE donor bin (direct
+            # re-add in _apply_commit — no host-loop involvement); otherwise
+            # any receiver that fits at least one donor pod marks a partial
+            # spill: the donor's pods take the exact host loop, which fills
+            # the committed receiver first and opens a fresh node for the
+            # rest (the original spill design)
+            full_choice = np.nonzero(ok & np.all(need[None, :] <= spare, axis=1))[0]
+            if full_choice.size:
+                receiver, full = int(full_choice[0]), True
+            elif small:
+                # partial spill routes the donor through the host loop, an
+                # O(pods x open-nodes) cost only worth paying at small scale
+                partial = ok & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
+                part_choice = np.nonzero(partial)[0]
+                if part_choice.size == 0:
                     continue
-                if not mask_all[r].any():  # bin falls back itself; phantom spare
-                    continue
-                if not problem.compat[g, cheapest_t[r]]:
-                    continue
-                if bool(np.all(reqs_d <= spare[r][None, :], axis=1).any()):
-                    receiver = r
-                    break
-            if receiver >= 0:
-                donors[bid] = receiver
-                pinned.add(receiver)
-                budget -= len(rows)
+                receiver, full = int(part_choice[0]), False
+            else:
+                continue
+            donors[bid] = (receiver, full)
+            claimed.add(receiver)
+            receiver_ok[bid] = False  # a donor can no longer receive
+            # conservatively: a full receiver's spare shrinks by the donor;
+            # a partial receiver is consumed (unknown subset lands on it)
+            spare[receiver] = spare[receiver] - need if full else np.zeros_like(need)
+            budget -= len(rows)
         return donors
 
     # -- steps 4+5: verify & commit ------------------------------------------
@@ -1093,11 +1136,10 @@ class DenseSolver:
         usage = sol["usage"]
         bin_rows = sol["bin_rows"]
         mask_all = sol["mask_all"]
-        # Spill selection assumes every receiver commits; under active
-        # provisioner limits the limits filter can knock a receiver out
-        # mid-loop (phantom receiver), so the pass stays off — limits
-        # batches keep the plain per-bucket commit.
-        spill = {} if scheduler.remaining_resources else self._select_spill_donors(problem, buckets, sol)
+        # Under provisioner limits a receiver can still be knocked out by the
+        # limits filter mid-loop; its donors then land in fallback_rows (the
+        # record_of_bid guard below), so the pass is safe to run always.
+        spill = self._select_spill_donors(problem, buckets, sol)
 
         # identical dedicated bins share options lists; cache by content
         options_cache: Dict[bytes, list] = {}
@@ -1150,8 +1192,12 @@ class DenseSolver:
         record_of_bid: Dict[int, int] = {}  # receiver bin -> index into records
         spill_pods: List[tuple] = []  # (row, receiver bid)
         for bid in range(num_bins):
-            if bid in spill:  # cross-bucket spill: re-add onto the receiver
-                spill_pods.extend((int(r), spill[bid]) for r in bin_rows[bid])
+            if bid in spill:  # cross-bucket spill
+                receiver, full = spill[bid]
+                if full:  # whole bin re-adds directly onto the receiver
+                    spill_pods.extend((int(r), receiver) for r in bin_rows[bid])
+                else:  # partial: the exact host loop re-packs these pods
+                    fallback_rows.extend(int(r) for r in bin_rows[bid])
                 continue
             bucket_key = int(bin_bucket[bid])
             bucket = buckets[bucket_key]

@@ -273,6 +273,20 @@ class TestLiveConfig:
         kube.create(ConfigMap(metadata=ObjectMeta(name="unrelated", namespace="x"), data={"batchMaxDuration": "1s"}))
         assert config.batch_max_duration == 10.0
 
+    def test_same_name_foreign_namespace_ignored(self):
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        kube.create(ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="attacker"), data={"batchMaxDuration": "1s"}))
+        assert config.batch_max_duration == 10.0
+
+    def test_invalid_log_level_keeps_previous(self):
+        kube = KubeCluster()
+        config = Config(log_level="debug")
+        watch_config(kube, config)
+        kube.create(ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"logLevel": "trace"}))
+        assert config.log_level == "debug"  # invalid value kept previous
+
     def test_deletion_restores_defaults(self):
         kube = KubeCluster()
         config = Config()
