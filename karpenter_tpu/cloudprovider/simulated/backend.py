@@ -38,6 +38,13 @@ class Subnet:
 
 
 @dataclass
+class SecurityGroup:
+    group_id: str
+    name: str
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class LaunchTemplate:
     template_id: str
     name: str
@@ -109,7 +116,14 @@ class CloudBackend:
         self.clock = clock or Clock()
         self._lock = threading.Lock()
         self.catalog = catalog if catalog is not None else default_catalog()
-        self.subnets = [Subnet(subnet_id=f"subnet-{z}", zone=z, tags={"discovery": "cluster"}) for z in zones]
+        self.subnets = [
+            Subnet(subnet_id=f"subnet-{z}", zone=z, available_ip_count=1000 + 100 * i, tags={"discovery": "cluster"})
+            for i, z in enumerate(zones)
+        ]
+        self.security_groups = [
+            SecurityGroup(group_id="sg-default", name="default", tags={"discovery": "cluster"}),
+            SecurityGroup(group_id="sg-nodes", name="nodes", tags={"discovery": "cluster", "role": "node"}),
+        ]
         self.launch_templates: Dict[str, LaunchTemplate] = {}
         self._template_counter = itertools.count(1)
         self._instance_counter = itertools.count(1)
@@ -148,6 +162,12 @@ class CloudBackend:
         if tag_selector:
             subnets = [s for s in subnets if all(s.tags.get(k) == v for k, v in tag_selector.items())]
         return subnets
+
+    def describe_security_groups(self, tag_selector: Optional[Dict[str, str]] = None) -> List["SecurityGroup"]:
+        groups = list(self.security_groups)
+        if tag_selector:
+            groups = [g for g in groups if all(g.tags.get(k) == v for k, v in tag_selector.items())]
+        return groups
 
     def get_on_demand_price(self, type_name: str) -> Optional[float]:
         return self.od_prices.get(type_name)

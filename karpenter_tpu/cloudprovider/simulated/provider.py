@@ -21,7 +21,8 @@ from ..types import CloudProvider, InstanceType, NodeRequest
 from .backend import CloudBackend, FleetInstanceSpec, FleetRequest, InsufficientCapacityError
 from .catalog import InstanceTypeCatalog, PricingProvider, SimulatedInstanceType, UnavailableOfferingsCache
 from .fleet import CreateFleetBatcher
-from .launchtemplate import LaunchTemplateProvider
+from .launchtemplate import FAMILIES, KubeletArgs, LaunchTemplateProvider
+from .network import SecurityGroupProvider, SubnetProvider
 
 # EC2 CreateFleet accepts at most ~20 type overrides; same discipline here
 # (aws/cloudprovider.go:62-63)
@@ -31,17 +32,70 @@ MAX_INSTANCE_TYPES = 20
 @dataclass
 class NodeClass:
     """Out-of-CRD provider configuration (the AWSNodeTemplate analog):
-    image family, subnet/security-group discovery selectors, tags.
-    Cluster-scoped, like Provisioner (namespace='')."""
+    image family, subnet/security-group discovery selectors, explicit image
+    and userdata for the custom family, tags. Cluster-scoped, like
+    Provisioner (namespace='')."""
 
     metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(namespace=""))
     image_family: str = "standard"
+    image_id: str = ""  # required for (and only valid with) the custom family
+    user_data: str = ""  # only valid with the custom family (passed through)
     subnet_selector: Dict[str, str] = field(default_factory=dict)
-    security_group_ids: List[str] = field(default_factory=lambda: ["sg-default"])
+    security_group_selector: Dict[str, str] = field(default_factory=dict)
+    security_group_ids: List[str] = field(default_factory=list)
     tags: Dict[str, str] = field(default_factory=dict)
     include_previous_generation: bool = False
 
     kind = "NodeClass"
+
+    @classmethod
+    def from_provider_config(cls, cfg: dict) -> "NodeClass":
+        """Deserialize inline spec.provider config (the v1alpha1 AWS
+        serialization analog); unknown keys are rejected by validation."""
+        return cls(
+            image_family=cfg.get("image_family", "standard"),
+            image_id=cfg.get("image_id", ""),
+            user_data=cfg.get("user_data", ""),
+            subnet_selector=dict(cfg.get("subnet_selector", {})),
+            security_group_selector=dict(cfg.get("security_group_selector", {})),
+            security_group_ids=list(cfg.get("security_group_ids", [])),
+            tags=dict(cfg.get("tags", {})),
+            include_previous_generation=bool(cfg.get("include_previous_generation", False)),
+        )
+
+
+_PROVIDER_CONFIG_KEYS = {
+    "image_family",
+    "image_id",
+    "user_data",
+    "subnet_selector",
+    "security_group_selector",
+    "security_group_ids",
+    "tags",
+    "include_previous_generation",
+}
+
+
+def validate_node_class(node_class: NodeClass) -> List[str]:
+    """The provider-config validation analog (aws/apis/v1alpha1
+    validation, 255 LoC): family enum, custom-family contract, selector
+    exclusivity."""
+    errs: List[str] = []
+    if node_class.image_family not in FAMILIES:
+        errs.append(
+            f"invalid image family {node_class.image_family!r}; supported: {sorted(FAMILIES)}"
+        )
+    if node_class.image_family == "custom":
+        if not node_class.image_id:
+            errs.append("custom image family requires image_id")
+    else:
+        if node_class.image_id:
+            errs.append("image_id is only valid with the custom image family")
+        if node_class.user_data:
+            errs.append("user_data is only valid with the custom image family")
+    if node_class.security_group_ids and node_class.security_group_selector:
+        errs.append("security_group_ids and security_group_selector are mutually exclusive")
+    return errs
 
 
 class SimulatedCloudProvider(CloudProvider):
@@ -59,8 +113,47 @@ class SimulatedCloudProvider(CloudProvider):
         self.unavailable = UnavailableOfferingsCache(self.clock)
         self.catalog = InstanceTypeCatalog(self.backend, self.pricing, self.unavailable, self.clock)
         self.launch_templates = LaunchTemplateProvider(self.backend, cluster_name)
+        self.subnets = SubnetProvider(self.backend, self.clock)
+        self.security_groups = SecurityGroupProvider(self.backend, self.clock)
         self.fleet_batcher = CreateFleetBatcher(self.backend, window=0.0)
         self._node_counter = 0
+
+    # -- admission hooks (the DefaultHook/ValidateHook seam the webhook
+    # chain invokes, reference aws/cloudprovider.go:119-120) ---------------
+
+    def default_provisioner(self, provisioner: Provisioner) -> None:
+        """Add the provider's default requirements when the user left the
+        axis open: on-demand capacity and amd64 (the AWS defaulting
+        behavior for karpenter.sh/capacity-type and kubernetes.io/arch)."""
+        from ...api.objects import OP_IN, NodeSelectorRequirement
+
+        keys = {lbl.normalize_label(r.key) for r in provisioner.spec.requirements}
+        if lbl.LABEL_CAPACITY_TYPE not in keys:
+            provisioner.spec.requirements.append(
+                NodeSelectorRequirement(key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=[lbl.CAPACITY_TYPE_ON_DEMAND])
+            )
+        if lbl.LABEL_ARCH not in keys:
+            provisioner.spec.requirements.append(
+                NodeSelectorRequirement(key=lbl.LABEL_ARCH, operator=OP_IN, values=[lbl.ARCHITECTURE_AMD64])
+            )
+
+    def validate_provisioner(self, provisioner: Provisioner) -> List[str]:
+        """Validate the inline provider config (ValidateHook analog)."""
+        cfg = provisioner.spec.provider
+        if not cfg:
+            return []
+        errs = [f"unknown provider config key {k!r}" for k in cfg if k not in _PROVIDER_CONFIG_KEYS]
+        errs.extend(validate_node_class(NodeClass.from_provider_config(cfg)))
+        return errs
+
+    def validate_object(self, obj) -> List[str]:
+        """Admission for provider-owned CRs: NodeClass writes get the same
+        validation as inline provider config (the AWSNodeTemplate webhook
+        analog) — a custom-family NodeClass without image_id must be
+        rejected at the API boundary, not crash a provisioning round."""
+        if isinstance(obj, NodeClass):
+            return validate_node_class(obj)
+        return []
 
     def name(self) -> str:
         return "simulated"
@@ -75,14 +168,7 @@ class SimulatedCloudProvider(CloudProvider):
             if node_class is not None:
                 return node_class
         if provisioner.spec.provider:
-            cfg = provisioner.spec.provider
-            return NodeClass(
-                image_family=cfg.get("image_family", "standard"),
-                subnet_selector=cfg.get("subnet_selector", {}),
-                security_group_ids=cfg.get("security_group_ids", ["sg-default"]),
-                tags=cfg.get("tags", {}),
-                include_previous_generation=cfg.get("include_previous_generation", False),
-            )
+            return NodeClass.from_provider_config(provisioner.spec.provider)
         return NodeClass()
 
     # -- instance types ----------------------------------------------------------
@@ -104,6 +190,21 @@ class SimulatedCloudProvider(CloudProvider):
         options = sorted(node_request.instance_type_options, key=lambda it: it.price())[:MAX_INSTANCE_TYPES]
         provisioner = self.kube.get("Provisioner", template.provisioner_name, namespace="") if self.kube else None
         node_class = self._node_class(provisioner)
+        security_group_ids = self.security_groups.resolve(
+            node_class.security_group_selector or None, node_class.security_group_ids
+        )
+        # zone -> subnet availability, hoisted out of the offering loop
+        # (depends only on zone x selector)
+        zone_has_subnet: Dict[str, bool] = {}
+        kubelet = None
+        if template.kubelet_configuration is not None:
+            kc = template.kubelet_configuration
+            kubelet = KubeletArgs(
+                cluster_dns=list(kc.cluster_dns),
+                max_pods=kc.max_pods,
+                system_reserved=dict(kc.system_reserved),
+                kube_reserved=dict(kc.kube_reserved),
+            )
 
         specs: List[FleetInstanceSpec] = []
         capacity_types = set()
@@ -111,14 +212,25 @@ class SimulatedCloudProvider(CloudProvider):
             launch_template = self.launch_templates.resolve(
                 node_class.image_family,
                 next(iter(it.requirements().get(lbl.LABEL_ARCH).values), lbl.ARCHITECTURE_AMD64),
-                node_class.security_group_ids,
+                security_group_ids,
                 template.labels,
                 list(template.taints) + list(template.startup_taints),
+                kubelet=kubelet,
+                image_id=node_class.image_id or None,
+                custom_user_data=node_class.user_data or None,
             )
             for offering in it.offerings():
                 if not requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone):
                     continue
                 if not requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type):
+                    continue
+                # the zone must have a discoverable subnet; launch targets
+                # the one with the most available IPs (instance.go:239-279)
+                has = zone_has_subnet.get(offering.zone)
+                if has is None:
+                    has = self.subnets.best_for_zone(offering.zone, node_class.subnet_selector or None) is not None
+                    zone_has_subnet[offering.zone] = has
+                if not has:
                     continue
                 capacity_types.add(offering.capacity_type)
                 specs.append(

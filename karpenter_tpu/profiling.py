@@ -1,0 +1,92 @@
+"""Profiling: host CPU profiles + JAX/XLA device traces.
+
+The pprof analog (reference controllers.go:112-114,183-202 exposes Go pprof
+behind --enable-profiling; the benchmark harness writes CPU/heap profiles,
+scheduling_benchmark_test.go:79-90). Here:
+
+- :func:`host_profile` — cProfile a block (a provisioning round, a solve)
+  and dump a .prof file readable by ``pstats``/``snakeviz``.
+- :func:`device_trace` — a JAX profiler trace (TensorBoard-compatible) of
+  everything dispatched inside the block: the XLA-trace counterpart for the
+  dense solver's device path.
+- env seam ``KARPENTER_TPU_PROFILE_DIR``: when set (and profiling enabled
+  via Options), Runtime.provision_once wraps every round with both.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import os
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .logsetup import get_logger
+
+log = get_logger("profiling")
+
+ENV_DIR = "KARPENTER_TPU_PROFILE_DIR"
+
+
+@contextmanager
+def host_profile(out_path: os.PathLike) -> Iterator[cProfile.Profile]:
+    """cProfile the enclosed block; stats land at out_path (.prof)."""
+    profiler = cProfile.Profile()
+    profiler.enable()
+    try:
+        yield profiler
+    finally:
+        profiler.disable()
+        out = Path(out_path)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        profiler.dump_stats(str(out))
+        log.info("host profile written to %s", out)
+
+
+@contextmanager
+def device_trace(out_dir: os.PathLike) -> Iterator[None]:
+    """JAX profiler trace of every device dispatch in the block.
+
+    Degrades to a no-op (with one warning) if the profiler cannot start —
+    tracing must never take the control plane down.
+    """
+    import jax
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    started = False
+    try:
+        jax.profiler.start_trace(str(out))
+        started = True
+    except Exception as exc:  # noqa: BLE001
+        log.warning("device trace unavailable: %s", exc)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log.info("device trace written to %s", out)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("device trace failed to stop: %s", exc)
+
+
+def profile_dir() -> Optional[Path]:
+    """The env-configured profile output directory, if any."""
+    value = os.environ.get(ENV_DIR)
+    return Path(value) if value else None
+
+
+@contextmanager
+def maybe_profile_round(enabled: bool, tag: str = "round") -> Iterator[None]:
+    """Wrap one provisioning round with host+device profiling when enabled
+    and KARPENTER_TPU_PROFILE_DIR is set; no-op otherwise."""
+    directory = profile_dir() if enabled else None
+    if directory is None:
+        yield
+        return
+    stamp = f"{tag}-{time.strftime('%H%M%S')}-{os.getpid()}"
+    with host_profile(directory / f"{stamp}.prof"):
+        with device_trace(directory / f"{stamp}-device"):
+            yield

@@ -173,5 +173,8 @@ class Runtime:
         self.node_metrics.scrape()
 
     def provision_once(self):
-        with self.solve_duration.time():
-            return self.provisioner.trigger_and_wait()
+        from .profiling import maybe_profile_round
+
+        with maybe_profile_round(self.options.enable_profiling, "provision"):
+            with self.solve_duration.time():
+                return self.provisioner.trigger_and_wait()

@@ -1053,6 +1053,7 @@ class DenseSolver:
         )
         group_of = np.asarray([bk.group_index for bk in bucket_of])
         donors: Dict[int, tuple] = {}  # donor bin -> (receiver bin, full?)
+        donor_groups_of: Dict[int, set] = {}  # receiver -> groups nominated onto it
         claimed: set = set()  # receivers stay committed: never donors later
         spare = spare.copy()  # claimed spare is decremented per receiver
         budget = self._SPILL_TOTAL_PODS
@@ -1070,6 +1071,11 @@ class DenseSolver:
             ok[bid] = False
             if dedicated[bid]:
                 ok &= group_of != g
+                # a receiver already holding a donor of this group would veto
+                # the second pod at apply (zero-count per host) — exclude it
+                for r, groups in donor_groups_of.items():
+                    if g in groups:
+                        ok[r] = False
             # prefer a receiver that swallows the WHOLE donor bin (direct
             # re-add in _apply_commit — no host-loop involvement); otherwise
             # any receiver that fits at least one donor pod marks a partial
@@ -1091,6 +1097,7 @@ class DenseSolver:
                 continue
             donors[bid] = (receiver, full)
             claimed.add(receiver)
+            donor_groups_of.setdefault(receiver, set()).add(g)
             receiver_ok[bid] = False  # a donor can no longer receive
             # conservatively: a full receiver's spare shrinks by the donor;
             # a partial receiver is consumed (unknown subset lands on it)

@@ -40,20 +40,30 @@ def validate_or_raise(provisioner: Provisioner, cloud_provider=None) -> None:
 
 
 def register(kube: KubeCluster, cloud_provider=None) -> None:
-    """Install the admission chain on Provisioner writes: defaulting first,
-    then validation (core rule set + provider hooks), rejection raises."""
+    """Install the admission chain: Provisioner writes get defaulting then
+    validation (core rule set + provider hooks); every other kind is offered
+    to the provider's validate_object hook (how provider-owned CRs like the
+    simulated NodeClass — the AWSNodeTemplate analog — get admission, same
+    seam as the reference's AWSNodeTemplate webhook)."""
     original_create, original_update = kube.create, kube.update
 
-    def admitted_create(obj):
+    def _admit(obj):
         if isinstance(obj, Provisioner):
             default_provisioner(obj, cloud_provider)
             validate_or_raise(obj, cloud_provider)
+            return
+        hook = getattr(cloud_provider, "validate_object", None)
+        if hook is not None:
+            errs = hook(obj) or ()
+            if errs:
+                raise AdmissionError("; ".join(errs))
+
+    def admitted_create(obj):
+        _admit(obj)
         return original_create(obj)
 
     def admitted_update(obj):
-        if isinstance(obj, Provisioner):
-            default_provisioner(obj, cloud_provider)
-            validate_or_raise(obj, cloud_provider)
+        _admit(obj)
         return original_update(obj)
 
     kube.create = admitted_create  # type: ignore[method-assign]

@@ -312,3 +312,92 @@ class TestDenseVsOracle:
         assert solver.stats.pods_committed == 50
         assert solver.stats.pods_to_host == 0
         assert solver.stats.nodes_created >= 0
+
+
+class TestMaxSkewGreaterThanOne:
+    """maxSkew > 1 on the dense path (VERDICT weak #7): the water-fill
+    balances to min-count — stricter than necessary but always valid — and
+    the committed layout must satisfy the skew bound and agree with the host
+    oracle on the scheduled-pod set."""
+
+    def _spread_pods(self, n, max_skew):
+        from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE
+        from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+
+        label = {"app": "skewed"}
+        return [
+            make_pod(
+                labels=label,
+                requests={"cpu": 0.5, "memory": "256Mi"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=max_skew,
+                        topology_key=LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels=label),
+                    )
+                ],
+            )
+            for _ in range(n)
+        ]
+
+    def _zone_counts(self, results):
+        from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE
+
+        counts = {}
+        for node in results.new_nodes:
+            zone = next(iter(node.requirements.get(LABEL_TOPOLOGY_ZONE).values))
+            counts[zone] = counts.get(zone, 0) + len(node.pods)
+        return counts
+
+    @pytest.mark.parametrize("max_skew", [2, 3, 5])
+    def test_skew_bound_holds_and_matches_host(self, max_skew):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.scheduler import build_scheduler
+
+        pods = self._spread_pods(20, max_skew)
+        provider = FakeCloudProvider(instance_types(10))
+        solver = DenseSolver(min_batch=1)
+        dense = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver).solve(pods)
+        host = build_scheduler([make_provisioner()], provider, pods).solve(pods)
+
+        assert sum(len(n.pods) for n in dense.new_nodes) == 20
+        assert solver.stats.pods_committed == 20
+        counts = self._zone_counts(dense)
+        assert max(counts.values()) - min(counts.values()) <= max_skew, counts
+        assert sum(len(n.pods) for n in host.new_nodes) == 20
+
+    def test_uneven_existing_counts_respected(self):
+        """Warm zones: with maxSkew=2 and zone-a already leading by 2, dense
+        placements must not push the skew past the bound."""
+        from karpenter_tpu.api.labels import (
+            LABEL_CAPACITY_TYPE,
+            LABEL_INSTANCE_TYPE,
+            LABEL_TOPOLOGY_ZONE,
+            PROVISIONER_NAME_LABEL,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from karpenter_tpu.scheduler import build_scheduler
+        from tests.helpers import make_node
+
+        kube = KubeCluster()
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-5",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        node = make_node(name="warm-a", labels=labels, allocatable={"cpu": 8, "memory": "16Gi", "pods": 50})
+        kube.create(node)
+        for i in range(2):  # two running cohort pods in zone-1
+            kube.create(
+                make_pod(labels={"app": "skewed"}, requests={"cpu": 0.5}, node_name="warm-a", phase="Running", unschedulable=False)
+            )
+        pods = self._spread_pods(10, 2)
+        provider = FakeCloudProvider(instance_types(10))
+        solver = DenseSolver(min_batch=1)
+        results = build_scheduler([make_provisioner()], provider, pods, kube=kube, dense_solver=solver).solve(pods)
+        assert sum(len(n.pods) for n in results.new_nodes) == 10
+        counts = self._zone_counts(results)
+        counts["test-zone-1"] = counts.get("test-zone-1", 0) + 2  # existing pods count
+        assert max(counts.values()) - min(counts.values()) <= 2, counts

@@ -146,3 +146,59 @@ class TestOptions:
     def test_invalid_rejected(self):
         with pytest.raises(SystemExit):
             parse(["--batch-idle-duration", "0"])
+
+
+class TestProfilingSeam:
+    """The pprof analog: host cProfile + device trace around a round
+    (profiling.py), enabled by --enable-profiling + KARPENTER_TPU_PROFILE_DIR."""
+
+    def test_host_profile_writes_stats(self, tmp_path):
+        import pstats
+
+        from karpenter_tpu.profiling import host_profile
+
+        out = tmp_path / "solve.prof"
+        with host_profile(out):
+            sum(i * i for i in range(1000))
+        stats = pstats.Stats(str(out))
+        assert stats.total_calls > 0
+
+    def test_maybe_profile_round_noop_without_env(self, monkeypatch):
+        from karpenter_tpu.profiling import ENV_DIR, maybe_profile_round
+
+        monkeypatch.delenv(ENV_DIR, raising=False)
+        with maybe_profile_round(True):
+            pass  # no files, no errors
+
+    def test_maybe_profile_round_writes_profiles(self, tmp_path, monkeypatch):
+        from karpenter_tpu.profiling import ENV_DIR, maybe_profile_round
+
+        monkeypatch.setenv(ENV_DIR, str(tmp_path))
+        with maybe_profile_round(True, "test"):
+            sum(range(100))
+        profs = list(tmp_path.glob("test-*.prof"))
+        assert profs, "host profile missing"
+
+    def test_provision_once_profiles_when_enabled(self, tmp_path, monkeypatch):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from karpenter_tpu.profiling import ENV_DIR
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+        from tests.helpers import make_pod, make_provisioner
+
+        monkeypatch.setenv(ENV_DIR, str(tmp_path))
+        kube = KubeCluster()
+        rt = Runtime(
+            kube=kube,
+            cloud_provider=FakeCloudProvider(instance_types(4)),
+            options=Options(enable_profiling=True),
+        )
+        try:
+            kube.create(make_provisioner())
+            kube.create(make_pod(requests={"cpu": 0.5}))
+            rt.provision_once()
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+        assert list(tmp_path.glob("provision-*.prof")), "round profile missing"

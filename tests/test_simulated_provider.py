@@ -202,3 +202,162 @@ class TestNodeClass:
         a = CloudBackend(clock=clock)
         b = CloudBackend(clock=clock)
         assert a.spot_prices == b.spot_prices
+
+
+class TestImageFamilies:
+    """Per-family bootstrap payloads (the amifamily/bootstrap analog:
+    AL2-shell / Bottlerocket-TOML / GPU / Custom pass-through)."""
+
+    def _resolve(self, provider, family, **kwargs):
+        from karpenter_tpu.api.objects import Taint
+
+        return provider.launch_templates.resolve(
+            family, "amd64", ["sg-default"], {"team": "a"}, [Taint(key="d", value="x", effect="NoSchedule")], **kwargs
+        )
+
+    def test_standard_family_shell_bootstrap_with_kubelet_flags(self, provider, backend):
+        from karpenter_tpu.cloudprovider.simulated.launchtemplate import KubeletArgs
+
+        t = self._resolve(provider, "standard", kubelet=KubeletArgs(max_pods=58, cluster_dns=["10.0.0.10"]))
+        assert t.user_data.startswith("#!/bin/sh")
+        assert "--max-pods=58" in t.user_data
+        assert "--cluster-dns=10.0.0.10" in t.user_data
+        assert "team=a" in t.user_data and "d=x:NoSchedule" in t.user_data
+
+    def test_minimal_family_declarative_settings(self, provider):
+        t = self._resolve(provider, "minimal")
+        assert t.user_data.startswith("[settings.kubernetes]")
+        assert '"team" = "a"' in t.user_data
+        assert '"d" = "x:NoSchedule"' in t.user_data
+        assert "#!/bin/sh" not in t.user_data
+
+    def test_gpu_family_enables_device_plugin(self, provider):
+        t = self._resolve(provider, "gpu")
+        assert "enable-device-plugin" in t.user_data
+
+    def test_custom_family_passes_userdata_through(self, provider):
+        t = self._resolve(provider, "custom", image_id="img-mine", custom_user_data="my-exact-payload")
+        assert t.image_id == "img-mine"
+        assert t.user_data == "my-exact-payload"
+
+    def test_custom_family_requires_image(self, provider):
+        with pytest.raises(ValueError, match="requires an explicit imageId"):
+            self._resolve(provider, "custom")
+
+    def test_image_discovery_versioned_per_arch(self):
+        from karpenter_tpu.cloudprovider.simulated.launchtemplate import get_image_family
+
+        fam = get_image_family("standard")
+        assert fam.image_id("amd64") != fam.image_id("arm64")
+        assert fam.image_id("amd64", "1.29") != fam.image_id("amd64", "1.30")
+        assert fam.image_id("amd64") == fam.image_id("amd64")  # deterministic
+
+
+class TestNetworkProviders:
+    def test_security_group_discovery_by_selector(self, provider, backend):
+        ids = provider.security_groups.resolve({"role": "node"})
+        assert ids == ["sg-nodes"]
+
+    def test_explicit_security_group_ids_win(self, provider):
+        assert provider.security_groups.resolve({"role": "node"}, ["sg-x"]) == ["sg-x"]
+
+    def test_no_selector_no_ids_defaults(self, provider):
+        assert provider.security_groups.resolve(None, []) == ["sg-default"]
+
+    def test_node_class_cr_admission(self, provider):
+        from karpenter_tpu import webhooks
+        from karpenter_tpu.cloudprovider.simulated.provider import NodeClass
+
+        kube = provider.kube
+        webhooks.register(kube, provider)
+        with pytest.raises(webhooks.AdmissionError, match="requires image_id"):
+            kube.create(NodeClass(image_family="custom"))
+        kube.create(NodeClass(image_family="minimal"))  # valid CR admitted
+
+    def test_security_group_cache_ttl(self, provider, backend, clock):
+        provider.security_groups.resolve({"role": "node"})
+        backend.security_groups[1].tags["role"] = "other"
+        assert provider.security_groups.resolve({"role": "node"}) == ["sg-nodes"]  # cached
+        clock.step(61)
+        with pytest.raises(RuntimeError, match="no security groups matched"):
+            provider.security_groups.resolve({"role": "node"})  # refreshed: fail loud
+
+    def test_best_subnet_most_available_ips(self, provider, backend):
+        from karpenter_tpu.cloudprovider.simulated.backend import Subnet
+
+        backend.subnets.append(Subnet(subnet_id="subnet-big", zone="zone-a", available_ip_count=9999, tags={"discovery": "cluster"}))
+        provider.subnets.invalidate()
+        assert provider.subnets.best_for_zone("zone-a").subnet_id == "subnet-big"
+
+
+class TestNodeClassValidation:
+    def test_valid_default(self):
+        from karpenter_tpu.cloudprovider.simulated.provider import NodeClass, validate_node_class
+
+        assert validate_node_class(NodeClass()) == []
+
+    def test_bad_family(self):
+        from karpenter_tpu.cloudprovider.simulated.provider import NodeClass, validate_node_class
+
+        assert any("invalid image family" in e for e in validate_node_class(NodeClass(image_family="alpine")))
+
+    def test_custom_contract(self):
+        from karpenter_tpu.cloudprovider.simulated.provider import NodeClass, validate_node_class
+
+        assert any("requires image_id" in e for e in validate_node_class(NodeClass(image_family="custom")))
+        assert any("only valid with the custom" in e for e in validate_node_class(NodeClass(image_id="img-x")))
+        assert any("only valid with the custom" in e for e in validate_node_class(NodeClass(user_data="x")))
+
+    def test_selector_id_exclusivity(self):
+        from karpenter_tpu.cloudprovider.simulated.provider import NodeClass, validate_node_class
+
+        nc = NodeClass(security_group_ids=["sg-1"], security_group_selector={"role": "node"})
+        assert any("mutually exclusive" in e for e in validate_node_class(nc))
+
+
+class TestProviderAdmissionHooks:
+    def test_defaulting_adds_capacity_type_and_arch(self, provider):
+        from karpenter_tpu import webhooks
+
+        kube = provider.kube
+        webhooks.register(kube, provider)
+        p = make_provisioner()
+        kube.create(p)
+        keys = {r.key: r.values for r in p.spec.requirements}
+        assert keys[lbl.LABEL_CAPACITY_TYPE] == [lbl.CAPACITY_TYPE_ON_DEMAND]
+        assert keys[lbl.LABEL_ARCH] == [lbl.ARCHITECTURE_AMD64]
+
+    def test_user_requirements_not_overridden(self, provider):
+        from karpenter_tpu import webhooks
+        from karpenter_tpu.api.objects import OP_IN, NodeSelectorRequirement
+
+        kube = provider.kube
+        webhooks.register(kube, provider)
+        p = make_provisioner(requirements=[NodeSelectorRequirement(key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=["spot"])])
+        kube.create(p)
+        values = [r.values for r in p.spec.requirements if r.key == lbl.LABEL_CAPACITY_TYPE]
+        assert values == [["spot"]]
+
+    def test_invalid_provider_config_rejected(self, provider):
+        from karpenter_tpu import webhooks
+
+        kube = provider.kube
+        webhooks.register(kube, provider)
+        with pytest.raises(webhooks.AdmissionError, match="unknown provider config key"):
+            kube.create(make_provisioner(provider={"amiFamily": "AL2"}))
+        with pytest.raises(webhooks.AdmissionError, match="invalid image family"):
+            kube.create(make_provisioner(name="p2", provider={"image_family": "alpine"}))
+
+
+class TestKubeletConfigFlow:
+    def test_kubelet_args_reach_userdata(self, provider, backend):
+        from karpenter_tpu.api.provisioner import KubeletConfiguration
+
+        prov = make_provisioner()
+        prov.spec.kubelet_configuration = KubeletConfiguration(max_pods=42, cluster_dns=["10.1.0.10"])
+        provider.kube.create(prov)
+        types = provider.get_instance_types(prov)
+        template = NodeTemplate.from_provisioner(prov)
+        provider.create(NodeRequest(template=template, instance_type_options=types[:1]))
+        payloads = [t.user_data for t in backend.launch_templates.values()]
+        assert any("--max-pods=42" in p and "--cluster-dns=10.1.0.10" in p for p in payloads)
