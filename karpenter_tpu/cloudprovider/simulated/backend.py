@@ -59,6 +59,7 @@ class FleetInstanceSpec:
     zone: str
     capacity_type: str
     launch_template_id: str = ""
+    subnet_id: str = ""  # the zone's most-available-IPs subnet
 
 
 @dataclass
@@ -73,6 +74,7 @@ class FleetInstance:
     instance_type: str
     zone: str
     capacity_type: str
+    subnet_id: str = ""
 
 
 class InsufficientCapacityError(RuntimeError):
@@ -228,6 +230,7 @@ class CloudBackend:
             instance = FleetInstance(
                 instance_id=f"i-{next(self._instance_counter):08d}",
                 instance_type=spec.instance_type,
+                subnet_id=spec.subnet_id,
                 zone=spec.zone,
                 capacity_type=spec.capacity_type,
             )

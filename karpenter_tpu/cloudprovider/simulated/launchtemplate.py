@@ -105,6 +105,12 @@ class MinimalFamily(ImageFamily):
             lines.append(f"max-pods = {kubelet.max_pods}")
         if kubelet.cluster_dns:
             lines.append(f'cluster-dns-ip = "{kubelet.cluster_dns[0]}"')
+        if kubelet.system_reserved:
+            lines.append("[settings.kubernetes.system-reserved]")
+            lines.extend(f'"{k}" = "{v}"' for k, v in sorted(kubelet.system_reserved.items()))
+        if kubelet.kube_reserved:
+            lines.append("[settings.kubernetes.kube-reserved]")
+            lines.extend(f'"{k}" = "{v}"' for k, v in sorted(kubelet.kube_reserved.items()))
         lines.append("[settings.kubernetes.node-labels]")
         lines.extend(f'"{k}" = "{v}"' for k, v in sorted(labels.items()))
         if taints:

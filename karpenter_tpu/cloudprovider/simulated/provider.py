@@ -48,10 +48,30 @@ class NodeClass:
 
     kind = "NodeClass"
 
+    _FIELD_TYPES = {
+        "image_family": str,
+        "image_id": str,
+        "user_data": str,
+        "subnet_selector": dict,
+        "security_group_selector": dict,
+        "security_group_ids": list,
+        "tags": dict,
+        "include_previous_generation": bool,
+    }
+
+    @classmethod
+    def config_type_errors(cls, cfg: dict) -> List[str]:
+        return [
+            f"provider config key {k!r} must be {t.__name__}, got {type(cfg[k]).__name__}"
+            for k, t in cls._FIELD_TYPES.items()
+            if k in cfg and not isinstance(cfg[k], t)
+        ]
+
     @classmethod
     def from_provider_config(cls, cfg: dict) -> "NodeClass":
         """Deserialize inline spec.provider config (the v1alpha1 AWS
-        serialization analog); unknown keys are rejected by validation."""
+        serialization analog); unknown keys and field types are rejected by
+        validation (config_type_errors runs first in the admission hook)."""
         return cls(
             image_family=cfg.get("image_family", "standard"),
             image_id=cfg.get("image_id", ""),
@@ -143,7 +163,9 @@ class SimulatedCloudProvider(CloudProvider):
         if not cfg:
             return []
         errs = [f"unknown provider config key {k!r}" for k in cfg if k not in _PROVIDER_CONFIG_KEYS]
-        errs.extend(validate_node_class(NodeClass.from_provider_config(cfg)))
+        errs.extend(NodeClass.config_type_errors(cfg))
+        if not errs:  # types are sound: the deserialized form can be checked
+            errs.extend(validate_node_class(NodeClass.from_provider_config(cfg)))
         return errs
 
     def validate_object(self, obj) -> List[str]:
@@ -193,9 +215,9 @@ class SimulatedCloudProvider(CloudProvider):
         security_group_ids = self.security_groups.resolve(
             node_class.security_group_selector or None, node_class.security_group_ids
         )
-        # zone -> subnet availability, hoisted out of the offering loop
-        # (depends only on zone x selector)
-        zone_has_subnet: Dict[str, bool] = {}
+        # zone -> chosen subnet (most available IPs), hoisted out of the
+        # offering loop (depends only on zone x selector)
+        zone_subnet: Dict[str, Optional[str]] = {}
         kubelet = None
         if template.kubelet_configuration is not None:
             kc = template.kubelet_configuration
@@ -226,11 +248,11 @@ class SimulatedCloudProvider(CloudProvider):
                     continue
                 # the zone must have a discoverable subnet; launch targets
                 # the one with the most available IPs (instance.go:239-279)
-                has = zone_has_subnet.get(offering.zone)
-                if has is None:
-                    has = self.subnets.best_for_zone(offering.zone, node_class.subnet_selector or None) is not None
-                    zone_has_subnet[offering.zone] = has
-                if not has:
+                if offering.zone not in zone_subnet:
+                    best = self.subnets.best_for_zone(offering.zone, node_class.subnet_selector or None)
+                    zone_subnet[offering.zone] = best.subnet_id if best is not None else None
+                subnet_id = zone_subnet[offering.zone]
+                if subnet_id is None:
                     continue
                 capacity_types.add(offering.capacity_type)
                 specs.append(
@@ -239,6 +261,7 @@ class SimulatedCloudProvider(CloudProvider):
                         zone=offering.zone,
                         capacity_type=offering.capacity_type,
                         launch_template_id=launch_template.template_id,
+                        subnet_id=subnet_id,
                     )
                 )
         if not specs:

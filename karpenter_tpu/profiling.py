@@ -51,15 +51,17 @@ def device_trace(out_dir: os.PathLike) -> Iterator[None]:
     Degrades to a no-op (with one warning) if the profiler cannot start —
     tracing must never take the control plane down.
     """
-    import jax
-
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     started = False
+    jax = None
     try:
+        import jax
+
         jax.profiler.start_trace(str(out))
         started = True
-    except Exception as exc:  # noqa: BLE001
+    except Exception as exc:  # noqa: BLE001 - incl. import errors: tracing
+        # must never take the control plane down
         log.warning("device trace unavailable: %s", exc)
     try:
         yield
@@ -86,7 +88,7 @@ def maybe_profile_round(enabled: bool, tag: str = "round") -> Iterator[None]:
     if directory is None:
         yield
         return
-    stamp = f"{tag}-{time.strftime('%H%M%S')}-{os.getpid()}"
+    stamp = f"{tag}-{time.strftime('%Y%m%d-%H%M%S')}-{time.monotonic_ns() % 10**9:09d}-{os.getpid()}"
     with host_profile(directory / f"{stamp}.prof"):
         with device_trace(directory / f"{stamp}-device"):
             yield

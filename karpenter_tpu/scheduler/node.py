@@ -46,6 +46,7 @@ class VirtualNode:
         self.pods: List[Pod] = []
         self.requests: Dict[str, float] = dict(daemon_resources or {})
         self.host_port_usage = HostPortUsage()
+        self._max_free = None
 
     @classmethod
     def open_prepared(
@@ -89,6 +90,7 @@ class VirtualNode:
         node.pods = []
         node.requests = dict(daemon_resources or {})
         node.host_port_usage = HostPortUsage()
+        node._max_free = None
         return node
 
     @property
@@ -98,6 +100,31 @@ class VirtualNode:
     @property
     def provisioner_name(self) -> str:
         return self.template.provisioner_name
+
+    def could_fit(self, pod_requests: Dict[str, float]) -> bool:
+        """Conservative O(R) capacity prescreen for the scheduler's
+        open-node scan: False means every surviving instance type would fail
+        the resources check inside add(), so the expensive exact protocol
+        (requirement algebra + exception) can be skipped. True guarantees
+        nothing — add() remains the authority. The headroom vector is the
+        elementwise max over surviving options and is invalidated by every
+        successful add (options shrink, requests grow)."""
+        free = self._max_free
+        if free is None:
+            free = {}
+            for it in self.instance_type_options:
+                caps = it.resources()
+                over = it.overhead()
+                for name, value in caps.items():
+                    avail = value - over.get(name, 0.0)
+                    if avail > free.get(name, 0.0):
+                        free[name] = avail
+            self._max_free = free
+        for name, value in pod_requests.items():
+            headroom = free.get(name, 0.0) - self.requests.get(name, 0.0)
+            if value > headroom + max(1e-9, 1e-6 * abs(headroom)):
+                return False
+        return True
 
     def add(self, pod: Pod) -> None:
         """Try to place the pod; raises IncompatibleError without mutating on
@@ -135,6 +162,7 @@ class VirtualNode:
         self.pods.append(pod)
         self.instance_type_options = instance_types
         self.requests = requests
+        self._max_free = None  # options shrank / requests grew: recompute lazily
         self.template.requirements = node_requirements
         self.topology.record(pod, node_requirements)
         self.host_port_usage.add(pod)

@@ -176,9 +176,16 @@ class Scheduler:
             except IncompatibleError:
                 continue
 
-        # 2. planned virtual nodes, emptiest first (scheduler.go:198-205)
+        # 2. planned virtual nodes, emptiest first (scheduler.go:198-205).
+        # The O(R) capacity prescreen skips nodes no surviving type could
+        # fit — on dense batches the scan crosses hundreds of committed
+        # bins per host-path pod and the exact protocol per node is ~50us
+        # of requirement algebra + exception machinery.
         self.nodes.sort(key=lambda n: len(n.pods))
+        pod_requests = res.pod_requests(pod)
         for node in self.nodes:
+            if not node.could_fit(pod_requests):
+                continue
             try:
                 node.add(pod)
                 return None
