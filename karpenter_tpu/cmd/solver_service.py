@@ -1,0 +1,31 @@
+"""Solver-service entry point: run the gRPC sidecar that owns the TPU.
+
+    python -m karpenter_tpu.cmd.solver_service --address 127.0.0.1:7473
+
+The control plane connects with --solver-service-address (utils/options.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+
+from ..logsetup import configure
+from ..service.server import serve
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
+    parser.add_argument("--address", default="127.0.0.1:7473")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    configure(args.log_level)
+    server, port, _ = serve(args.address)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop(grace=2.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,21 @@ from .volumetopology import VolumeTopology
 log = get_logger("provisioning")
 
 
+class _SnapshotProvider:
+    """Serve already-fetched instance-type universes; delegate the rest."""
+
+    def __init__(self, universes: Dict[str, list], inner):
+        self._universes = universes
+        self._inner = inner
+
+    def get_instance_types(self, provisioner):
+        cached = self._universes.get(provisioner.name)
+        return list(cached) if cached is not None else self._inner.get_instance_types(provisioner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class ProvisionerController:
     def __init__(
         self,
@@ -44,6 +59,7 @@ class ProvisionerController:
         config: Optional[Config] = None,
         recorder: Optional[Recorder] = None,
         dense_solver=None,
+        remote_solver=None,
         wait_for_cluster_sync: bool = True,
         clock=None,
     ):
@@ -55,6 +71,9 @@ class ProvisionerController:
         self.config = config or Config()
         self.recorder = recorder or Recorder()
         self.dense_solver = dense_solver
+        # the gRPC solver sidecar (service/client.py); local scheduling is
+        # always the fallback — the sidecar is an accelerator, not a SPOF
+        self.remote_solver = remote_solver
         self.wait_for_cluster_sync = wait_for_cluster_sync
         self.clock = clock or kube.clock or Clock()
         self.batcher = Batcher(self.config, self.clock)
@@ -144,9 +163,34 @@ class ProvisionerController:
 
     def schedule(self, pods: Sequence[Pod], state_nodes: Sequence[object], opts: Optional[SchedulerOptions] = None) -> SchedulingResults:
         provisioners = [p for p in self.kube.list_provisioners()]
+        cloud_provider = self.cloud_provider
+        if self.remote_solver is not None:
+            from ...service.client import RemoteSchedulingError
+
+            instance_types = {p.name: cloud_provider.get_instance_types(p) for p in provisioners}
+            try:
+                results = self.remote_solver.solve(
+                    provisioners,
+                    instance_types,
+                    pods,
+                    daemonset_pods=self.daemonset_pods(),
+                    state_nodes=state_nodes,
+                    kube=self.kube,
+                    simulation_mode=bool(opts and opts.simulation_mode),
+                    exclude_nodes=list(opts.exclude_nodes) if opts else [],
+                )
+                if not (opts and opts.simulation_mode):
+                    for pod, err in results.unschedulable.items():
+                        self.recorder.pod_failed_to_schedule(pod, err)
+                return results
+            except RemoteSchedulingError as exc:
+                log.warning("solver service failed (%s); falling back to the local scheduler", exc)
+                # reuse the universes already fetched: the fallback must not
+                # pay a second get_instance_types sweep per provisioner
+                cloud_provider = _SnapshotProvider(instance_types, cloud_provider)
         scheduler = build_scheduler(
             provisioners,
-            self.cloud_provider,
+            cloud_provider,
             pods,
             kube=self.kube,
             cluster=self.cluster,

@@ -82,9 +82,15 @@ class Runtime:
             from .solver import DenseSolver
 
             self.dense_solver = DenseSolver(min_batch=self.options.dense_min_batch)
+        remote_solver = None
+        if self.options.solver_service_address:
+            from .service.client import SolverClient
+
+            remote_solver = SolverClient(self.options.solver_service_address, timeout=self.options.solver_service_timeout)
         self.provisioner = ProvisionerController(
             self.kube, self.cluster, self.cloud_provider, config=self.config,
-            recorder=self.recorder, dense_solver=self.dense_solver, clock=self.kube.clock,
+            recorder=self.recorder, dense_solver=self.dense_solver,
+            remote_solver=remote_solver, clock=self.kube.clock,
         )
         self.reconciler = ProvisioningReconciler(self.kube, self.provisioner)
         self.node_controller = NodeController(self.kube, self.cluster, self.cloud_provider, clock=self.kube.clock)

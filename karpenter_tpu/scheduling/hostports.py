@@ -66,3 +66,13 @@ class HostPortUsage:
         out = HostPortUsage()
         out._reserved = {uid: list(entries) for uid, entries in self._reserved.items()}
         return out
+
+    def to_wire(self) -> Dict[str, List[tuple]]:
+        """Detached plain-data form for the solver-service wire (service/)."""
+        return {uid: [(e.ip, e.port, e.protocol) for e in entries] for uid, entries in self._reserved.items()}
+
+    @classmethod
+    def from_wire(cls, data: Dict[str, List[tuple]]) -> "HostPortUsage":
+        out = cls()
+        out._reserved = {uid: [HostPortEntry(*entry) for entry in entries] for uid, entries in data.items()}
+        return out

@@ -95,6 +95,21 @@ class VolumeLimits:
         out._pod_volumes = {u: {d: set(v) for d, v in pv.items()} for u, pv in self._pod_volumes.items()}
         return out
 
+    def to_wire(self) -> tuple:
+        """Detached plain-data form for the solver-service wire (service/)."""
+        return (
+            {d: sorted(v) for d, v in self._volumes.items()},
+            {u: {d: sorted(v) for d, v in pv.items()} for u, pv in self._pod_volumes.items()},
+        )
+
+    @classmethod
+    def from_wire(cls, data: tuple, kube_client=None) -> "VolumeLimits":
+        out = cls(kube_client)
+        volumes, pod_volumes = data
+        out._volumes = {d: set(v) for d, v in volumes.items()}
+        out._pod_volumes = {u: {d: set(v) for d, v in pv.items()} for u, pv in pod_volumes.items()}
+        return out
+
 
 def limits_from_csi_node(csi_node: Optional[CSINode]) -> VolumeCount:
     limits = VolumeCount()

@@ -1,0 +1,4 @@
+from .client import SolverClient, RemoteSchedulingError
+from .server import SolverServer, serve
+
+__all__ = ["SolverClient", "SolverServer", "RemoteSchedulingError", "serve"]

@@ -26,6 +26,8 @@ class Options:
     dense_min_batch: int = 32
     cluster_name: str = ""
     log_level: str = "info"
+    solver_service_address: str = ""  # host:port of the gRPC solver sidecar (empty = in-process)
+    solver_service_timeout: float = 30.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -71,6 +73,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--dense-min-batch", type=int, default=_env("DENSE_MIN_BATCH", defaults.dense_min_batch))
     parser.add_argument("--cluster-name", default=_env("CLUSTER_NAME", defaults.cluster_name))
     parser.add_argument("--log-level", default=_env("LOG_LEVEL", defaults.log_level))
+    parser.add_argument("--solver-service-address", default=_env("SOLVER_SERVICE_ADDRESS", defaults.solver_service_address))
+    parser.add_argument("--solver-service-timeout", type=float, default=_env("SOLVER_SERVICE_TIMEOUT", defaults.solver_service_timeout))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))
     errs = options.validate()

@@ -1,0 +1,246 @@
+"""Solver service: the gRPC sidecar behind the packer boundary (SURVEY §7.3).
+
+Covers: remote-vs-local parity on constrained workloads, warm-cluster state
+fidelity (existing fills, topology counts from bound cluster pods), volume
+object shipping, end-to-end provisioning through a Runtime configured with
+--solver-service-address, transport-failure fallback to the local
+scheduler, and server-error propagation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from karpenter_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE,
+    LABEL_TOPOLOGY_ZONE,
+    PROVISIONER_NAME_LABEL,
+)
+from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.service import SolverClient, RemoteSchedulingError
+from karpenter_tpu.service.server import serve
+from karpenter_tpu.solver import DenseSolver
+
+from tests.helpers import make_pod, make_pods, make_provisioner, make_state_node
+
+
+@pytest.fixture(scope="module")
+def service():
+    server, port, handler = serve("127.0.0.1:0")
+    client = SolverClient(f"127.0.0.1:{port}", timeout=30.0)
+    yield client, handler
+    client.close()
+    server.stop(grace=0.5)
+
+
+def mixed_workload(n=60):
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    pods = []
+    for i in range(n):
+        req = {"cpu": [0.25, 0.5, 1.0][rng.integers(3)], "memory": "512Mi"}
+        if i % 5 == 0:
+            lab = {"s": "ab"[rng.integers(2)]}
+            pods.append(make_pod(labels=lab, requests=req, topology_spread_constraints=[
+                TopologySpreadConstraint(max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=lab))]))
+        elif i % 7 == 0:
+            lab = {"a": "xy"[rng.integers(2)]}
+            pods.append(make_pod(labels=lab, requests=req, pod_anti_requirements=[
+                PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=lab))]))
+        else:
+            pods.append(make_pod(requests=req))
+    return pods
+
+
+def cost_of(nodes):
+    return sum(min(it.price() for it in n.instance_type_options) for n in nodes)
+
+
+class TestRemoteParity:
+    def test_remote_matches_local_layout(self, service):
+        client, handler = service
+        pods = mixed_workload()
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(15)).get_instance_types(provisioner)}
+
+        remote = client.solve([provisioner], types, pods)
+        local = build_scheduler(
+            [provisioner], FakeCloudProvider(types[provisioner.name]), pods, dense_solver=DenseSolver(min_batch=1)
+        ).solve(pods)
+
+        assert sum(len(n.pods) for n in remote.new_nodes) == sum(len(n.pods) for n in local.new_nodes) == 60
+        assert abs(cost_of(remote.new_nodes) - cost_of([n for n in local.new_nodes if n.pods])) < 1e-6
+        assert not remote.unschedulable
+        assert handler.solves >= 1
+
+    def test_remote_fills_existing_nodes(self, service):
+        client, _ = service
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-9",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        state = make_state_node(labels=labels, allocatable={"cpu": 16, "memory": "32Gi", "pods": 110})
+        pods = make_pods(10, requests={"cpu": 1, "memory": "1Gi"})
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(15)).get_instance_types(provisioner)}
+        remote = client.solve([provisioner], types, pods, state_nodes=[state])
+        assert not remote.new_nodes, "existing capacity fits everything"
+        assert sum(len(v.pods) for v in remote.existing_nodes) == 10
+        assert remote.existing_nodes[0].node.name == state.node.name
+
+    def test_cluster_pod_topology_counts_cross_the_wire(self, service):
+        """A bound cluster pod populates the affinity domain; the remote
+        solve must pin the cohort to that host, not bootstrap a fresh one."""
+        client, _ = service
+        kube = KubeCluster()
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-9",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        from tests.helpers import make_node
+
+        node = make_node(name="aff-host", labels=labels, allocatable={"cpu": 16, "memory": "32Gi", "pods": 110})
+        kube.create(node)
+        cohort = {"app": "svc"}
+        kube.create(make_pod(labels=cohort, requests={"cpu": 0.5}, node_name="aff-host", phase="Running", unschedulable=False))
+        term = PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=cohort))
+        pods = [make_pod(labels=cohort, requests={"cpu": 0.5}, pod_requirements=[term]) for _ in range(3)]
+        state = make_state_node(node=node, available={"cpu": 15.5, "memory": "31Gi", "pods": 100})
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(15)).get_instance_types(provisioner)}
+        remote = client.solve([provisioner], types, pods, state_nodes=[state], kube=kube)
+        assert not remote.new_nodes, "populated required affinity must join the existing host"
+        assert sum(len(v.pods) for v in remote.existing_nodes) == 3
+
+    def test_server_error_propagates(self, service):
+        client, _ = service
+        provisioner = make_provisioner()
+        with pytest.raises(RemoteSchedulingError, match="remote solve failed"):
+            # unpicklable/bogus instance types make the server-side solve blow up
+            client.solve([provisioner], {provisioner.name: [object()]}, make_pods(2, requests={"cpu": 1}))
+
+
+class TestRuntimeIntegration:
+    def test_provisioning_through_the_sidecar(self):
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        server, port, handler = serve("127.0.0.1:0")
+        kube = KubeCluster()
+        rt = Runtime(
+            kube=kube,
+            cloud_provider=FakeCloudProvider(instance_types(8)),
+            options=Options(solver_service_address=f"127.0.0.1:{port}"),
+        )
+        try:
+            kube.create(make_provisioner())
+            for _ in range(5):
+                kube.create(make_pod(requests={"cpu": 0.5}))
+            results = rt.provision_once()
+            assert sum(len(n.pods) for n in results.new_nodes) == 5
+            assert kube.list_nodes(), "nodes launched from the remote plan"
+            assert handler.solves >= 1
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+            server.stop(grace=0.5)
+
+    def test_unreachable_sidecar_falls_back_to_local(self):
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        kube = KubeCluster()
+        rt = Runtime(
+            kube=kube,
+            cloud_provider=FakeCloudProvider(instance_types(8)),
+            options=Options(solver_service_address="127.0.0.1:1"),  # nothing listens
+        )
+        try:
+            kube.create(make_provisioner())
+            kube.create(make_pod(requests={"cpu": 0.5}))
+            rt.provisioner.remote_solver.timeout = 0.5  # don't wait out the default
+            results = rt.provision_once()
+            assert sum(len(n.pods) for n in results.new_nodes) == 1
+            assert kube.list_nodes(), "local fallback must still provision"
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+
+
+class TestTightenedRequirementsCrossTheWire:
+    def test_zone_pinned_pod_launches_in_its_zone(self, service):
+        """The launch plan must carry the scheduler's tightened requirements
+        (zone pins from nodeSelector/spread decisions), not the bare
+        provisioner template."""
+        client, _ = service
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(10)).get_instance_types(provisioner)}
+        pods = [
+            make_pod(requests={"cpu": 0.5}, node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+            for _ in range(3)
+        ]
+        results = client.solve([provisioner], types, pods)
+        assert results.new_nodes
+        for node in results.new_nodes:
+            zone_req = node.template.requirements.get(LABEL_TOPOLOGY_ZONE)
+            assert list(zone_req.values) == ["test-zone-2"], "zone pin lost across the wire"
+
+    def test_inverse_anti_affinity_of_bound_pods_enforced(self, service):
+        """A bound cluster pod with required anti-affinity must block the
+        remote plan from co-placing a matching pod (the _ClusterShim path)."""
+        from tests.helpers import make_node
+
+        client, _ = service
+        kube = KubeCluster()
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-9",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        labels[LABEL_HOSTNAME] = "anti-host"  # inverse domains read node labels
+        node = make_node(name="anti-host", labels=labels, allocatable={"cpu": 16, "memory": "32Gi", "pods": 110})
+        kube.create(node)
+        blocker_sel = LabelSelector(match_labels={"app": "web"})
+        blocker = make_pod(
+            labels={"app": "web"},
+            requests={"cpu": 0.5},
+            pod_anti_requirements=[PodAffinityTerm(topology_key=LABEL_HOSTNAME, label_selector=blocker_sel)],
+            node_name="anti-host",
+            phase="Running",
+            unschedulable=False,
+        )
+        kube.create(blocker)
+        state = make_state_node(node=node, available={"cpu": 15.5, "memory": "31Gi", "pods": 100})
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(10)).get_instance_types(provisioner)}
+        pods = [make_pod(labels={"app": "web"}, requests={"cpu": 0.5})]
+        results = client.solve([provisioner], types, pods, state_nodes=[state], kube=kube)
+        # the matching pod must NOT land on anti-host (the blocker's required
+        # anti-affinity excludes it); a fresh node is the only legal outcome
+        assert sum(len(v.pods) for v in results.existing_nodes) == 0
+        assert sum(len(n.pods) for n in results.new_nodes) == 1
+
+    def test_consolidation_simulation_goes_remote(self, service):
+        client, handler = service
+        provisioner = make_provisioner()
+        types = {provisioner.name: FakeCloudProvider(instance_types(10)).get_instance_types(provisioner)}
+        before = handler.solves
+        results = client.solve(
+            [provisioner], types, make_pods(4, requests={"cpu": 0.5}),
+            simulation_mode=True, exclude_nodes=["gone-node"],
+        )
+        assert handler.solves == before + 1
+        assert sum(len(n.pods) for n in results.new_nodes) == 4
