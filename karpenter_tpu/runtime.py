@@ -139,6 +139,8 @@ class Runtime:
     def stop(self) -> None:
         self._stop.set()
         self.provisioner.stop()
+        if self.provisioner.remote_solver is not None:
+            self.provisioner.remote_solver.close()
         for thread in self._threads:
             thread.join(timeout=5)
         self.elector.release()

@@ -270,8 +270,7 @@ class Topology:
         everywhere) — the cleanup path for discarded probe nodes."""
         self.domains.get(topology_key, set()).discard(domain)
         for group in self._groups_by_key.get(topology_key, ()):
-            if group.domains.get(domain) == 0:
-                del group.domains[domain]
+            group.unregister(domain)
 
     def _matching_topologies(self, pod: Pod, requirements: Requirements) -> List[TopologyGroup]:
         matching = [g for g in self.topologies.values() if g.is_owned_by(pod.uid)]

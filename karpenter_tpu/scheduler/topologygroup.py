@@ -56,6 +56,12 @@ class TopologyGroup:
         # sorted for determinism: the domain universe arrives as a set, and
         # selection order must not depend on hash seeds
         self.domains: Dict[str, int] = {domain: 0 for domain in sorted(domains or ())}
+        # zero-count domains, kept in sync by record/register (and
+        # Topology.unregister): anti-affinity next-domain selection reads
+        # this set directly instead of scanning every domain per pod — with
+        # hundreds of registered hostnames that scan dominated warm-cluster
+        # fills
+        self._zero_domains: Set[str] = set(self.domains)
         self.owners: Set[str] = set()  # pod UIDs governed by this group
         # rotates among equal-min-count domains so a pod whose chosen domain
         # proves infeasible (e.g. no offering for that zone x capacity-type
@@ -101,10 +107,20 @@ class TopologyGroup:
     def record(self, *domains: str, count: int = 1) -> None:
         for domain in domains:
             self.domains[domain] = self.domains.get(domain, 0) + count
+            self._zero_domains.discard(domain)
 
     def register(self, *domains: str) -> None:
         for domain in domains:
-            self.domains.setdefault(domain, 0)
+            if self.domains.setdefault(domain, 0) == 0:
+                self._zero_domains.add(domain)
+
+    def unregister(self, domain: str) -> None:
+        """Drop a zero-count domain (probe-node cleanup); both the counts
+        dict and the zero set are maintained here so the invariant lives in
+        one class."""
+        if self.domains.get(domain) == 0:
+            del self.domains[domain]
+            self._zero_domains.discard(domain)
 
     # -- next-domain selection ----------------------------------------------
 
@@ -169,8 +185,8 @@ class TopologyGroup:
         return options
 
     def _next_domain_anti_affinity(self, pod_domains: Requirement) -> Requirement:
-        options = Requirement(self.key, OP_DOES_NOT_EXIST)
-        for domain, count in self.domains.items():
-            if pod_domains.has(domain) and count == 0:
-                options.insert(domain)
-        return options
+        # unconstrained pods (the common case: no explicit requirement on
+        # the key) admit every zero-count domain — skip the per-domain scan
+        if pod_domains.complement and not pod_domains.values and pod_domains.greater_than is None and pod_domains.less_than is None:
+            return Requirement(self.key, OP_IN, *self._zero_domains)
+        return Requirement(self.key, OP_IN, *(d for d in self._zero_domains if pod_domains.has(d)))

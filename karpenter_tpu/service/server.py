@@ -61,6 +61,18 @@ class _ClusterShim:
                 return
 
 
+class _Provider:
+    """Serve the request's shipped instance-type universes as a
+    CloudProvider (the server-side twin of the control plane's
+    _SnapshotProvider fallback shim)."""
+
+    def __init__(self, universes):
+        self._universes = universes
+
+    def get_instance_types(self, provisioner):
+        return list(self._universes.get(provisioner.name, ()))
+
+
 class SolverServer:
     """Request handler; transport-agnostic (serve() wires it into gRPC)."""
 
@@ -91,14 +103,6 @@ class SolverServer:
             kube.create(obj)
 
         state_nodes = [_StateNodeView(w, kube) for w in request.state_nodes]
-
-        class _Provider:
-            def __init__(self, universes):
-                self._universes = universes
-
-            def get_instance_types(self, provisioner):
-                return list(self._universes.get(provisioner.name, ()))
-
         opts = SchedulerOptions(simulation_mode=request.simulation_mode, exclude_nodes=list(request.exclude_nodes))
         with self._lock:
             self.solves += 1
@@ -159,6 +163,8 @@ def serve(address: str = "127.0.0.1:0", dense_solver: Optional[DenseSolver] = No
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((generic,))
     port = server.add_insecure_port(address)
+    if port == 0:
+        raise RuntimeError(f"solver service could not bind {address!r}")
     server.start()
     log.info("solver service listening on port %d", port)
     return server, port, handler
