@@ -18,8 +18,14 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
     parser.add_argument("--address", default="127.0.0.1:7473")
     parser.add_argument("--log-level", default="info")
+    parser.add_argument("--coordinator", default=None, help="multi-host fabric coordinator (host:port); also KARPENTER_TPU_COORDINATOR")
     args = parser.parse_args(argv)
     configure(args.log_level)
+    # join the multi-host device fabric BEFORE any jax use: afterwards
+    # jax.devices() spans every host and the solver mesh is global
+    from ..parallel.multihost import initialize
+
+    initialize(coordinator_address=args.coordinator)
     server, port, _ = serve(args.address)
     try:
         threading.Event().wait()

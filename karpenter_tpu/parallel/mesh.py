@@ -68,12 +68,15 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer
     return Mesh(grid, axis_names=("pods", "types"))
 
 
-def default_mesh(n_devices: int, prefer_cpu: bool = False) -> Mesh:
+def default_mesh(n_devices: int, prefer_cpu: bool = False, types_parallel: Optional[int] = None) -> Mesh:
     """The production mesh shape for n devices: 2-way types-parallel when the
     count allows (argmin-combine traffic over the types axis is tiny), the
-    rest pods-parallel. Both the solver auto-detect and the driver dryrun use
-    this, so the dryrun always validates the shape production runs."""
-    types_parallel = 2 if n_devices % 2 == 0 and n_devices >= 4 else 1
+    rest pods-parallel — or an explicit types_parallel from the host-aware
+    factorization (parallel/multihost.py host_mesh_axes). Both the solver
+    auto-detect and the driver dryrun use this, so the dryrun always
+    validates the shape production runs."""
+    if types_parallel is None:
+        types_parallel = 2 if n_devices % 2 == 0 and n_devices >= 4 else 1
     return solver_mesh(n_devices, types_parallel=types_parallel, prefer_cpu=prefer_cpu)
 
 

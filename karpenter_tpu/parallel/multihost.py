@@ -1,0 +1,125 @@
+"""Multi-host distributed initialization for the solver service.
+
+The distributed-communication backend of SURVEY.md §5: the reference's
+"fabric" is kube watches + cloud APIs; the TPU build adds a real device
+fabric — XLA collectives over ICI within a host and DCN across hosts — and
+this module is the seam that brings additional hosts into one solver.
+
+Deployment model (mirrors standard JAX multi-host):
+
+- every host runs the solver service (cmd/solver_service.py) with the same
+  coordinator address; process 0 hosts the coordination service;
+- :func:`initialize` wires ``jax.distributed`` from explicit arguments or
+  the standard env (``KARPENTER_TPU_COORDINATOR``, ``..._NUM_PROCESSES``,
+  ``..._PROCESS_ID``), after which ``jax.devices()`` spans every host and
+  ``solver_mesh`` / ``make_sharded_*`` transparently build global meshes;
+- :func:`host_mesh_axes` picks the (pods × types) factorization that keeps
+  the types axis — whose reductions (argmin combines, any-feasible) are the
+  chatty ones — INSIDE each host's ICI domain, so only the cheap pods-axis
+  concatenations ride DCN. This is the scaling-book recipe: put the
+  low-volume collective on the slow fabric.
+
+Single-process fallback: with no coordinator configured, initialize() is a
+no-op and everything runs on the local devices — the same code path the
+8-virtual-device CPU tests and the driver dryrun exercise.
+
+LIMITATION (current): serving a solve over a cross-host mesh requires every
+process to enter the same jitted program (SPMD); the sidecar does not yet
+broadcast requests to peer processes, so DenseSolver's auto-detected mesh
+deliberately spans ADDRESSABLE devices only (solver/dense.py _active_mesh).
+The fabric initialization and the host-aware factorization here are the
+seam the peer execution loop plugs into.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..logsetup import get_logger
+
+log = get_logger("parallel")
+
+ENV_COORDINATOR = "KARPENTER_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "KARPENTER_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "KARPENTER_TPU_PROCESS_ID"
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host solver fabric; returns True when distributed mode
+    is active.
+
+    Arguments default to the KARPENTER_TPU_* env; with no coordinator
+    configured anywhere this is a single-process no-op (False). Safe to call
+    more than once.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR) or None
+    if not coordinator_address:
+        return False
+    # leave unset values as None so jax.distributed auto-detects the
+    # process topology on TPU pods (forcing 1/0 would make every host claim
+    # process 0 of a one-process 'fabric')
+    env_np = os.environ.get(ENV_NUM_PROCESSES)
+    env_pid = os.environ.get(ENV_PROCESS_ID)
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined solver fabric: coordinator=%s process %s/%s, %d global devices",
+        coordinator_address,
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True
+
+
+def host_mesh_axes(n_global: int, n_local: int) -> Tuple[int, int]:
+    """(pods, types) axis sizes that keep types-axis collectives on ICI.
+
+    The types axis carries the argmin-combine traffic, so it must not span
+    hosts: its size divides the per-host device count. Pods-axis shards
+    (independent bucket rows, concatenated once per solve) span hosts over
+    DCN. Examples: 2 hosts × 4 chips (8 global) → (pods=2, types=4);
+    4 hosts × 8 chips (32 global) → (pods=8, types=4).
+    """
+    if n_local <= 0 or n_global <= 0 or n_global % max(n_local, 1):
+        return (max(n_global, 1), 1)
+    types = 1
+    # largest power-of-two types axis that fits inside one host, capped at 4
+    # (types reductions saturate quickly; pods parallelism is the scaler)
+    while types * 2 <= n_local and types * 2 <= 4:
+        types *= 2
+    return (n_global // types, types)
+
+
+def distributed_solver_mesh():
+    """A global (pods × types) mesh spanning every process's devices, with
+    the types axis confined to per-host ICI (host_mesh_axes)."""
+    import jax
+
+    from .mesh import solver_mesh
+
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    pods_dim, types_dim = host_mesh_axes(n_global, n_local)
+    return solver_mesh(n_devices=pods_dim * types_dim, types_parallel=types_dim)

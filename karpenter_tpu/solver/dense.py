@@ -164,10 +164,19 @@ class DenseSolver:
             import jax
 
             from ..parallel.mesh import default_mesh
+            from ..parallel.multihost import host_mesh_axes
 
-            n = int(setting) if setting else len(jax.devices())
+            # ADDRESSABLE devices only: a jitted program over non-local
+            # devices requires every process to enter it (SPMD) — the
+            # cross-host execution loop is the solver service's future work,
+            # and auto-detect must never build a mesh this process cannot
+            # drive alone. host_mesh_axes keeps the chatty types axis small.
+            n_local = len(jax.local_devices())
+            n = int(setting) if setting else n_local
+            n = min(n, n_local) if not setting else n
             if n > 1:
-                self._mesh = default_mesh(n)
+                _, types_parallel = host_mesh_axes(n, n)
+                self._mesh = default_mesh(n, types_parallel=types_parallel)
         except Exception as exc:  # mesh is an optimization; never break solving
             log.warning("solver mesh unavailable, staying single-device: %s", exc)
             self._mesh = None

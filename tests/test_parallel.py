@@ -156,3 +156,50 @@ def test_graft_dryrun_multichip():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     g.dryrun_multichip(8)
+
+
+class TestMultihost:
+    """Multi-host fabric seam (parallel/multihost.py): env-driven
+    jax.distributed wiring with a single-process no-op fallback, and the
+    ICI/DCN-aware (pods x types) axis factorization."""
+
+    def test_initialize_noop_without_coordinator(self, monkeypatch):
+        from karpenter_tpu.parallel import multihost
+
+        monkeypatch.delenv(multihost.ENV_COORDINATOR, raising=False)
+        monkeypatch.setattr(multihost, "_initialized", False)
+        assert multihost.initialize() is False
+
+    def test_host_mesh_axes_keep_types_on_ici(self):
+        from karpenter_tpu.parallel.multihost import host_mesh_axes
+
+        # 2 hosts x 4 chips: types axis (chatty argmin combines) stays <= 4
+        # and divides the per-host device count; pods axis spans the rest
+        for n_global, n_local in ((8, 4), (32, 8), (4, 4), (16, 4)):
+            pods, types = host_mesh_axes(n_global, n_local)
+            assert pods * types == n_global
+            assert n_local % types == 0, "types axis must not span hosts"
+            assert types <= 4
+
+    def test_host_mesh_axes_degenerate(self):
+        from karpenter_tpu.parallel.multihost import host_mesh_axes
+
+        assert host_mesh_axes(1, 1) == (1, 1)
+        assert host_mesh_axes(6, 4) == (6, 1)  # non-dividing: pods-only
+
+    def test_distributed_solver_mesh_single_process(self):
+        # single process: global == local devices; the mesh still builds and
+        # the sharded production solve runs on it
+        from karpenter_tpu.parallel.multihost import distributed_solver_mesh
+        from karpenter_tpu.solver import DenseSolver
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.scheduler import build_scheduler
+        from tests.helpers import make_pods, make_provisioner
+
+        mesh = distributed_solver_mesh()
+        assert set(mesh.shape.keys()) == {"pods", "types"}
+        solver = DenseSolver(min_batch=1, mesh=mesh)
+        pods = make_pods(40, requests={"cpu": 0.5, "memory": "512Mi"})
+        results = build_scheduler([make_provisioner()], FakeCloudProvider(instance_types(12)), pods, dense_solver=solver).solve(pods)
+        assert sum(len(n.pods) for n in results.new_nodes) == 40
+        assert solver.stats.sharded_batches >= 1
