@@ -25,17 +25,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer_cpu: bool = False) -> Mesh:
+def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer_cpu: bool = False, devices=None) -> Mesh:
     """Build a (pods x types) mesh over the first n devices.
 
     types_parallel devices shard the type axis; the rest shard pods.
 
-    prefer_cpu checks the host CPU backend FIRST — the virtual-multi-device
-    dryrun path, where the default backend may be a single tunneled TPU chip
-    that is slow (or broken) to initialize and must not be touched when the
-    forced CPU device count already satisfies the request.
+    `devices` pins an explicit device list (e.g. jax.local_devices() — the
+    only safe choice for a single-process caller once jax.distributed makes
+    jax.devices() span other hosts). prefer_cpu checks the host CPU backend
+    FIRST — the virtual-multi-device dryrun path, where the default backend
+    may be a single tunneled TPU chip that is slow (or broken) to initialize
+    and must not be touched when the forced CPU device count already
+    satisfies the request.
     """
-    devices = None
+    if devices is not None and prefer_cpu:
+        raise ValueError("pass either devices or prefer_cpu, not both")
     if prefer_cpu and n_devices:
         try:
             cpu_devices = jax.devices("cpu")
@@ -45,6 +49,7 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer
             devices = None
     if devices is None:
         devices = jax.devices()
+    devices = list(devices)
     n = n_devices or len(devices)
     if len(devices) < n:
         # The default backend (e.g. a single tunneled TPU chip) may have fewer
@@ -68,7 +73,7 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer
     return Mesh(grid, axis_names=("pods", "types"))
 
 
-def default_mesh(n_devices: int, prefer_cpu: bool = False, types_parallel: Optional[int] = None) -> Mesh:
+def default_mesh(n_devices: int, prefer_cpu: bool = False, types_parallel: Optional[int] = None, devices=None) -> Mesh:
     """The production mesh shape for n devices: 2-way types-parallel when the
     count allows (argmin-combine traffic over the types axis is tiny), the
     rest pods-parallel — or an explicit types_parallel from the host-aware
@@ -77,7 +82,7 @@ def default_mesh(n_devices: int, prefer_cpu: bool = False, types_parallel: Optio
     validates the shape production runs."""
     if types_parallel is None:
         types_parallel = 2 if n_devices % 2 == 0 and n_devices >= 4 else 1
-    return solver_mesh(n_devices, types_parallel=types_parallel, prefer_cpu=prefer_cpu)
+    return solver_mesh(n_devices, types_parallel=types_parallel, prefer_cpu=prefer_cpu, devices=devices)
 
 
 def pod_sharding(mesh: Mesh) -> NamedSharding:

@@ -105,9 +105,11 @@ def host_mesh_axes(n_global: int, n_local: int) -> Tuple[int, int]:
     if n_local <= 0 or n_global <= 0 or n_global % max(n_local, 1):
         return (max(n_global, 1), 1)
     types = 1
-    # largest power-of-two types axis that fits inside one host, capped at 4
-    # (types reductions saturate quickly; pods parallelism is the scaler)
-    while types * 2 <= n_local and types * 2 <= 4:
+    # largest power-of-two types axis that DIVIDES the per-host device count
+    # (a non-dividing axis would either fail mesh construction or span
+    # hosts), capped at 4: types reductions saturate quickly; pods
+    # parallelism is the scaler
+    while types * 2 <= 4 and n_local % (types * 2) == 0:
         types *= 2
     return (n_global // types, types)
 

@@ -170,13 +170,14 @@ class DenseSolver:
             # devices requires every process to enter it (SPMD) — the
             # cross-host execution loop is the solver service's future work,
             # and auto-detect must never build a mesh this process cannot
-            # drive alone. host_mesh_axes keeps the chatty types axis small.
-            n_local = len(jax.local_devices())
-            n = int(setting) if setting else n_local
-            n = min(n, n_local) if not setting else n
+            # drive alone (jax.devices() spans other hosts once
+            # jax.distributed is up). host_mesh_axes keeps the chatty types
+            # axis small.
+            local = jax.local_devices()
+            n = min(int(setting), len(local)) if setting else len(local)
             if n > 1:
                 _, types_parallel = host_mesh_axes(n, n)
-                self._mesh = default_mesh(n, types_parallel=types_parallel)
+                self._mesh = default_mesh(n, types_parallel=types_parallel, devices=local)
         except Exception as exc:  # mesh is an optimization; never break solving
             log.warning("solver mesh unavailable, staying single-device: %s", exc)
             self._mesh = None

@@ -203,3 +203,36 @@ class TestMultihost:
         results = build_scheduler([make_provisioner()], FakeCloudProvider(instance_types(12)), pods, dense_solver=solver).solve(pods)
         assert sum(len(n.pods) for n in results.new_nodes) == 40
         assert solver.stats.sharded_batches >= 1
+
+    def test_host_mesh_axes_types_divide_local(self):
+        from karpenter_tpu.parallel.multihost import host_mesh_axes
+
+        # non-power-of-two host sizes must still factor cleanly
+        assert host_mesh_axes(6, 6) == (3, 2)
+        assert host_mesh_axes(12, 6) == (6, 2)
+        for n_global, n_local in ((6, 6), (12, 6), (8, 4), (32, 8), (4, 4)):
+            pods, types = host_mesh_axes(n_global, n_local)
+            assert pods * types == n_global and n_local % types == 0
+
+    def test_auto_mesh_uses_only_addressable_devices(self, monkeypatch):
+        # once jax.distributed is up, jax.devices() spans other hosts; the
+        # auto mesh must be built from jax.local_devices() exclusively
+        import jax
+
+        from karpenter_tpu.solver import DenseSolver
+
+        local = jax.local_devices()
+        captured = {}
+        import karpenter_tpu.parallel.mesh as mesh_mod
+
+        orig = mesh_mod.solver_mesh
+
+        def spy(n_devices=None, types_parallel=1, prefer_cpu=False, devices=None):
+            captured["devices"] = devices
+            return orig(n_devices, types_parallel=types_parallel, prefer_cpu=prefer_cpu, devices=devices)
+
+        monkeypatch.setattr(mesh_mod, "solver_mesh", spy)
+        solver = DenseSolver(min_batch=1)
+        solver._active_mesh()
+        if len(local) > 1:  # single-device hosts build no mesh at all
+            assert captured["devices"] == local
