@@ -40,6 +40,7 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer
     """
     if devices is not None and prefer_cpu:
         raise ValueError("pass either devices or prefer_cpu, not both")
+    pinned = devices is not None  # caller-pinned, not the prefer_cpu pick
     if prefer_cpu and n_devices:
         try:
             cpu_devices = jax.devices("cpu")
@@ -51,6 +52,10 @@ def solver_mesh(n_devices: Optional[int] = None, types_parallel: int = 1, prefer
         devices = jax.devices()
     devices = list(devices)
     n = n_devices or len(devices)
+    if pinned and len(devices) < n:
+        # an explicitly pinned list must never be silently swapped for the
+        # CPU fallback — that would mask a config error
+        raise ValueError(f"need {n} devices but the pinned list has {len(devices)}")
     if len(devices) < n:
         # The default backend (e.g. a single tunneled TPU chip) may have fewer
         # devices than requested while the host CPU backend carries the forced

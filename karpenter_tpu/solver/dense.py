@@ -173,8 +173,15 @@ class DenseSolver:
             # drive alone (jax.devices() spans other hosts once
             # jax.distributed is up). host_mesh_axes keeps the chatty types
             # axis small.
-            local = jax.local_devices()
-            n = min(int(setting), len(local)) if setting else len(local)
+            if setting:
+                # explicit count (the virtual-device dryrun): unclamped, and
+                # devices unpinned so solver_mesh's CPU-backend fallback can
+                # satisfy a forced host-device count
+                n = int(setting)
+                local = None
+            else:
+                local = jax.local_devices()
+                n = len(local)
             if n > 1:
                 _, types_parallel = host_mesh_axes(n, n)
                 self._mesh = default_mesh(n, types_parallel=types_parallel, devices=local)

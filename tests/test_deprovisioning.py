@@ -330,3 +330,113 @@ class TestReplacementReadiness:
         assert done.type == ActionType.REPLACE
         env.termination_controller.reconcile_all()
         assert old_nodes[0].name not in [n.name for n in env.kube.list_nodes()]
+
+
+class TestConsolidationDepth:
+    """Scenario depth from the reference consolidation suite (1,084 LoC):
+    lifetime-weighted candidate ordering, topology-respecting simulations,
+    nominated-node exclusion, multi-replacement refusal, do-not-evict."""
+
+    def test_disruption_cost_ranks_deletion_cost(self):
+        """A node whose pods carry high pod-deletion-cost must score a
+        strictly higher disruption cost than one with low-cost pods, so the
+        controller's ascending-cost scan considers the cheap node first."""
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        cheap = owned_pod(requests={"cpu": 4}, annotations={"controller.kubernetes.io/pod-deletion-cost": "-5"})
+        costly = owned_pod(requests={"cpu": 4}, annotations={"controller.kubernetes.io/pod-deletion-cost": "9"})
+        env.launch_node_with_pods(cheap)
+        env.launch_node_with_pods(costly)
+        candidates = env.consolidation.candidate_nodes()
+        cost_of = {}
+        for c in candidates:
+            names = {p.name for p in env.kube.pods_on_node(c.name)}
+            if cheap.name in names:
+                cost_of["cheap"] = env.consolidation._disruption_cost(c)
+            if costly.name in names:
+                cost_of["costly"] = env.consolidation._disruption_cost(c)
+        assert set(cost_of) == {"cheap", "costly"}, cost_of
+        assert cost_of["cheap"] < cost_of["costly"]
+
+    def test_nominated_node_not_a_candidate(self):
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        env.launch_node_with_pods(owned_pod(requests={"cpu": 0.5}))
+        node = env.kube.list_nodes()[0]
+        env.cluster.nominate_node_for_pod(node.name)  # fresh nomination
+        assert env.consolidation.candidate_nodes() == []
+
+    def test_uninitialized_node_not_a_candidate(self):
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        for pod in [owned_pod(requests={"cpu": 0.5})]:
+            env.kube.create(pod)
+        env.provision()
+        env.bind_nominated()  # no node_controller pass: stays uninitialized
+        env.clock.step(env.cluster.nomination_ttl + 1)
+        assert env.consolidation.candidate_nodes() == []
+
+    def test_multiple_replacements_refused(self):
+        """Replace only fires when the node's pods repack onto EXACTLY one
+        new node (controller.go:453-498)."""
+        # one big node holding pods that cannot share a single smaller node
+        # because of hostname anti-affinity between them
+        from karpenter_tpu.api.objects import PodAffinityTerm
+
+        lab = {"anti": "q"}
+        term = PodAffinityTerm(topology_key=lbl.LABEL_HOSTNAME, label_selector=LabelSelector(match_labels=lab))
+        env = DeprovEnv(
+            provisioners=[make_provisioner(consolidation_enabled=True)],
+            instance_types_list=instance_types(12),
+        )
+        pods = [owned_pod(labels=lab, requests={"cpu": 3}, pod_anti_requirements=[term]) for _ in range(2)]
+        env.launch_node_with_pods(*pods)
+        action = env.consolidation.process_cluster()
+        # the two anti pods need two hosts: delete is impossible (no other
+        # capacity) and replace would need multiple nodes -> no action
+        assert action.type == ActionType.NO_ACTION
+
+    def test_do_not_evict_pod_blocks_consolidation(self):
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        blocked = owned_pod(requests={"cpu": 0.2}, annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"})
+        env.launch_node_with_pods(blocked)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+
+    def test_topology_spread_respected_in_simulation(self):
+        """Consolidating a node must not propose a layout that violates the
+        surviving pods' zonal spread (the simulation runs the full scheduler
+        with the candidate excluded)."""
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        lab = {"app": "spread-consol"}
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=lbl.LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels=lab)
+        )
+        env = DeprovEnv(
+            provisioners=[make_provisioner(consolidation_enabled=True)],
+            instance_types_list=instance_types(8),
+        )
+        pods = [
+            owned_pod(labels=lab, requests={"cpu": 0.5}, topology_spread_constraints=[constraint])
+            for _ in range(6)
+        ]
+        env.launch_node_with_pods(*pods)
+        action = env.consolidation.process_cluster()
+        # whatever the action, a proposed replacement must carry a concrete
+        # zone consistent with the constraint machinery
+        if action.replacement is not None:
+            assert action.replacement.requirements.get(lbl.LABEL_TOPOLOGY_ZONE).values
+
+    def test_daemonset_only_node_is_empty(self):
+        """Nodes holding only daemonset pods count as empty for the
+        delete-all-empty fast path (is_node_empty semantics)."""
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        env.launch_node_with_pods(owned_pod(requests={"cpu": 0.5}))
+        node = env.kube.list_nodes()[0]
+        # replace the workload pod binding with a daemonset-owned pod
+        for pod in env.kube.pods_on_node(node.name):
+            env.kube.delete(pod)
+        ds_pod = make_pod(requests={"cpu": 0.1}, node_name=node.name, phase="Running", unschedulable=False)
+        ds_pod.metadata.owner_references.append(OwnerReference(kind="DaemonSet", name="ds"))
+        env.kube.create(ds_pod)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY
+        assert [n.metadata.name for n in action.nodes] == [node.metadata.name]
