@@ -35,7 +35,7 @@ import numpy as np
 HEADLINE_PODS = 10_000
 HEADLINE_TYPES = 500
 BASELINE_PODS_PER_SEC = 100.0
-HEADLINE_TRIALS = 5  # median over 5: the tunnel's dispatch latency is jittery
+HEADLINE_TRIALS = 9  # median over 9: the tunnel's dispatch RT swings 90-180ms minute to minute
 SIDE_TRIALS = 3  # non-headline configs
 SWEEP_PODS = (1, 50, 100, 500, 1000, 2000, 5000)  # scheduling_benchmark_test.go:51
 SWEEP_TYPES = 400
@@ -183,14 +183,23 @@ def run_once(pods, provider, provisioners, solver, state_nodes=()):
         len(v.pods) for v in results.existing_nodes
     )
     cost = sum(n.instance_type_options[0].price() for n in results.new_nodes)
-    return elapsed, scheduled, len(results.new_nodes), cost, solver.stats
+    # per-run packing stats (scheduling_benchmark_test.go:151-168)
+    per_node = [len(n.pods) for n in results.new_nodes if n.pods]
+    if per_node:
+        stats_line = (
+            f"pods/node min={min(per_node)} max={max(per_node)} "
+            f"mean={np.mean(per_node):.1f} stddev={np.std(per_node):.1f}"
+        )
+    else:
+        stats_line = "pods/node n/a (all on existing)"
+    return elapsed, scheduled, len(results.new_nodes), cost, solver.stats, stats_line
 
 
 def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS):
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
     times = []
     for _ in range(trials):
-        elapsed, scheduled, nodes, cost, stats = run_once(
+        elapsed, scheduled, nodes, cost, stats, packing = run_once(
             pods, provider, provisioners, solver, state_nodes
         )
         times.append(elapsed)
@@ -198,7 +207,7 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
             f"  [{name}] trial {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f}"
             f" fill {stats.fill_seconds*1000:.0f} device {stats.device_seconds*1000:.0f}"
             f" commit {stats.commit_seconds*1000:.0f}) scheduled={scheduled}"
-            f" nodes={nodes} dense={stats.pods_committed} cost={cost:.1f}"
+            f" nodes={nodes} dense={stats.pods_committed} cost={cost:.1f} {packing}"
         )
         if scheduled < len(pods) * 0.99:
             log(f"  [{name}] WARNING: only {scheduled}/{len(pods)} pods scheduled")
@@ -254,29 +263,16 @@ def main() -> None:
     from karpenter_tpu.solver import DenseSolver
     from tests.helpers import make_provisioner
 
+    import gc
+
     configs: dict = {}
 
     # one long-lived solver per catalog, as the provisioning controller holds
     # in practice (retains the uploaded device catalog between solves)
 
-    # --- 1. FFD parity: 1k homogeneous pods / 50 types ---
-    log("config ffd_parity_1k_x_50")
-    from tests.helpers import make_pod
-
-    provider = FakeCloudProvider(instance_types(50))
-    pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(1000)]
-    ms, _ = run_config("ffd_1k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1))
-    configs["ffd_parity_1k_x_50"] = round(ms, 1)
-
-    # --- 2. 5k pods with selectors + taints / 500 types ---
-    log("config selectors_taints_5k_x_500")
-    provider = FakeCloudProvider(instance_types(500))
-    pods = build_selectors_taints_workload(5000)
-    tainted = make_provisioner(taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")])
-    ms, _ = run_config("sel_taints_5k", pods, provider, [tainted], DenseSolver(min_batch=1))
-    configs["selectors_taints_5k_x_500"] = round(ms, 1)
-
-    # --- 3. HEADLINE: 10k pods, anti-affinity + zonal spread / 500 types ---
+    # --- HEADLINE first, while the process is lean: accumulated object
+    # graphs from the other configs otherwise stretch GC pauses into the
+    # gated trials ---
     log("config anti_spread_10k_x_500 (headline)")
     provider = FakeCloudProvider(instance_types(HEADLINE_TYPES))
     pods = build_workload(HEADLINE_PODS)
@@ -285,8 +281,31 @@ def main() -> None:
         trials=HEADLINE_TRIALS,
     )
     configs["anti_spread_10k_x_500"] = round(headline_ms, 1)
+    del pods
+    gc.collect()
 
-    # --- 4. whole-cluster repack: 2k pods / 300 existing nodes ---
+    # --- FFD parity: 1k homogeneous pods / 50 types ---
+    log("config ffd_parity_1k_x_50")
+    from tests.helpers import make_pod
+
+    provider = FakeCloudProvider(instance_types(50))
+    pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(1000)]
+    ms, _ = run_config("ffd_1k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1))
+    configs["ffd_parity_1k_x_50"] = round(ms, 1)
+    del pods
+    gc.collect()
+
+    # --- 2. 5k pods with selectors + taints / 500 types ---
+    log("config selectors_taints_5k_x_500")
+    provider = FakeCloudProvider(instance_types(500))
+    pods = build_selectors_taints_workload(5000)
+    tainted = make_provisioner(taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")])
+    ms, _ = run_config("sel_taints_5k", pods, provider, [tainted], DenseSolver(min_batch=1))
+    configs["selectors_taints_5k_x_500"] = round(ms, 1)
+    del pods
+    gc.collect()
+
+    # --- whole-cluster repack: 2k pods / 300 existing nodes ---
     log("config repack_2k_x_300")
     provider = FakeCloudProvider(instance_types(100))
     pods = build_workload(2000, seed=3)
@@ -296,6 +315,8 @@ def main() -> None:
         state_nodes=state_nodes,
     )
     configs["repack_2k_x_300"] = round(ms, 1)
+    del pods, state_nodes
+    gc.collect()
 
     # --- 5. spot/OD mixed pricing, weighted multi-provisioner / 500 types ---
     log("config spot_od_multiprov_x_500")
@@ -305,6 +326,8 @@ def main() -> None:
     od = make_provisioner(name="on-demand", weight=1)
     ms, _ = run_config("spot_od_5k", pods, provider, [spot, od], DenseSolver(min_batch=1))
     configs["spot_od_multiprov_x_500"] = round(ms, 1)
+    del pods
+    gc.collect()
 
     # --- reference pod-count sweep: 400 types x {1..5000} pods ---
     log("sweep 400 types x {1,50,100,500,1000,2000,5000} pods")
@@ -315,7 +338,7 @@ def main() -> None:
     for count in SWEEP_PODS:
         pods = build_workload(count, seed=13)
         run_once(pods, provider, provisioners, sweep_solver)  # warmup this shape
-        elapsed, scheduled, nodes, _, _ = run_once(pods, provider, provisioners, sweep_solver)
+        elapsed, scheduled, nodes, _, _, _ = run_once(pods, provider, provisioners, sweep_solver)
         pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
         sweep[str(count)] = round(pods_per_sec, 0)
         log(
