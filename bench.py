@@ -318,7 +318,7 @@ def main() -> None:
     del pods, state_nodes
     gc.collect()
 
-    # --- 5. spot/OD mixed pricing, weighted multi-provisioner / 500 types ---
+    # --- spot/OD mixed pricing, weighted multi-provisioner / 500 types ---
     log("config spot_od_multiprov_x_500")
     provider = FakeCloudProvider(build_spot_od_types(500))
     pods = build_workload(5000, seed=5)
