@@ -56,10 +56,42 @@ class ConsolidationAction:
 
 @dataclass
 class ConsolidationMetrics:
+    """Per-controller tallies, mirrored into the Prometheus registry
+    (the reference's consolidation/metrics.go:35-72 families)."""
+
     evaluations: int = 0
     nodes_terminated: int = 0
     nodes_created: int = 0
     actions: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        from ...metrics import REGISTRY
+
+        self._eval_duration = REGISTRY.histogram(
+            "karpenter_consolidation_evaluation_duration_seconds",
+            "Duration of consolidation evaluation passes",
+        )
+        self._nodes_created = REGISTRY.counter(
+            "karpenter_consolidation_nodes_created", "Replacement nodes launched by consolidation"
+        )
+        self._nodes_terminated = REGISTRY.counter(
+            "karpenter_consolidation_nodes_terminated", "Nodes terminated by consolidation"
+        )
+        self._actions_performed = REGISTRY.counter(
+            "karpenter_consolidation_actions_performed", "Consolidation actions performed", ("action",)
+        )
+
+    def record_created(self, n: int = 1) -> None:
+        self.nodes_created += n
+        self._nodes_created.inc(n)
+
+    def record_terminated(self, n: int = 1) -> None:
+        self.nodes_terminated += n
+        self._nodes_terminated.inc(n)
+
+    def record_action(self, action: str) -> None:
+        self.actions.append(action)
+        self._actions_performed.inc(action=action)
 
 
 class ConsolidationController:
@@ -110,6 +142,10 @@ class ConsolidationController:
 
     def process_cluster(self) -> ConsolidationAction:
         self.metrics.evaluations += 1
+        with self.metrics._eval_duration.time():
+            return self._process_cluster()
+
+    def _process_cluster(self) -> ConsolidationAction:
         # finish a replacement that was waiting on readiness
         pending = self._pending_replace
         if pending is not None:
@@ -254,7 +290,7 @@ class ConsolidationController:
             self.kube.create(node)
             action.replacement_name = node.name
             log.info("consolidation replace: launching %s to replace %s (%s)", node.name, ", ".join(n.name for n in action.nodes), action.reason)
-            self.metrics.nodes_created += 1
+            self.metrics.record_created()
             # nominate so emptiness/other consolidation passes don't reap the
             # replacement before the old node's pods migrate to it
             self.cluster.nominate_node_for_pod(node.name)
@@ -273,5 +309,5 @@ class ConsolidationController:
             log.info("consolidation %s: terminating %s (%s)", action.type.value, node.name, action.reason)
             self.recorder.terminating_node(node, f"consolidation: {action.reason}")
             self.kube.delete(node)
-            self.metrics.nodes_terminated += 1
-        self.metrics.actions.append(action.type.value)
+            self.metrics.record_terminated()
+        self.metrics.record_action(action.type.value)

@@ -35,6 +35,12 @@ class TerminationController:
         self.clock = clock or kube.clock or Clock()
         self.eviction_queue = EvictionQueue(kube, self.recorder)
         self.termination_durations: List[float] = []  # metrics summary source
+        from ...metrics import REGISTRY
+
+        # the reference's termination_time_seconds summary (controller.go:52-60)
+        self._termination_summary = REGISTRY.summary(
+            "karpenter_nodes_termination_time_seconds", "Seconds from deletion timestamp until finalizer removal"
+        )
 
     def reconcile_all(self) -> None:
         for node in list(self.kube.list_nodes()):
@@ -52,7 +58,9 @@ class TerminationController:
         self.kube.finalize(node)
         log.info("terminated node %s: drained, instance deleted, finalizer removed", node.name)
         if node.metadata.deletion_timestamp is not None:
-            self.termination_durations.append(self.clock.now() - node.metadata.deletion_timestamp)
+            duration = self.clock.now() - node.metadata.deletion_timestamp
+            self.termination_durations.append(duration)
+            self._termination_summary.observe(duration)
         self.recorder.terminating_node(node, "deleted node and cloud instance")
 
     def cordon(self, node: Node) -> None:

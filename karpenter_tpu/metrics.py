@@ -192,15 +192,24 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def families(self):
+        """(name, kind, label_names, help) for every registered family —
+        the one place the class-to-kind mapping lives (export_text and the
+        docgen both consume it)."""
+        kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"}
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        return [(m.name, kinds.get(type(m), "untyped"), tuple(m.label_names), m.help) for m in metrics]
+
     def export_text(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []
+        kinds = dict((name, kind) for name, kind, _, _ in self.families())
         with self._lock:
             metrics = list(self._metrics.values())
         for metric in metrics:
             lines.append(f"# HELP {metric.name} {metric.help}")
-            kind = {Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"}.get(type(metric), "untyped")
-            lines.append(f"# TYPE {metric.name} {kind}")
+            lines.append(f"# TYPE {metric.name} {kinds[metric.name]}")
             for labels, value, suffix in metric.collect():  # type: ignore[attr-defined]
                 label_str = ",".join(f'{k}="{v}"' for k, v in labels.items() if v != "")
                 label_part = f"{{{label_str}}}" if label_str else ""

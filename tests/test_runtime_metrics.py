@@ -202,3 +202,54 @@ class TestProfilingSeam:
             rt.stop()
             LeaderElector._leader = None
         assert list(tmp_path.glob("provision-*.prof")), "round profile missing"
+
+
+class TestDeprovisioningMetricFamilies:
+    """Consolidation + termination Prometheus families (the reference's
+    consolidation/metrics.go:35-72 and termination/controller.go:52-60)."""
+
+    def test_consolidation_families_exported(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.metrics import REGISTRY
+        from tests.test_deprovisioning import DeprovEnv, owned_pod
+        from tests.helpers import make_provisioner
+
+        env = DeprovEnv(provisioners=[make_provisioner(consolidation_enabled=True)], instance_types_list=instance_types(10))
+        env.launch_node_with_pods(owned_pod(requests={"cpu": 0.5}))
+        node = env.kube.list_nodes()[0]
+        for pod in env.kube.pods_on_node(node.name):
+            env.kube.delete(pod)
+        terminated = REGISTRY.get("karpenter_consolidation_nodes_terminated")
+        actions = REGISTRY.get("karpenter_consolidation_actions_performed")
+        before_t = terminated.value() if terminated else 0
+        before_a = actions.value(action="delete-empty") if actions else 0
+        env.consolidation.process_cluster()  # empty node deleted
+        terminated = REGISTRY.get("karpenter_consolidation_nodes_terminated")
+        actions = REGISTRY.get("karpenter_consolidation_actions_performed")
+        assert terminated.value() == before_t + 1
+        assert actions.value(action="delete-empty") == before_a + 1
+        assert "karpenter_consolidation_evaluation_duration_seconds" in REGISTRY.export_text()
+
+    def test_termination_summary_exported(self):
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.metrics import REGISTRY
+        from karpenter_tpu.controllers.termination import TerminationController
+        from tests.env import Environment
+        from tests.helpers import make_pod, make_provisioner
+
+        env = Environment(instance_types=instance_types(6))
+        env.kube.create(make_provisioner())
+        env.kube.create(make_pod(requests={"cpu": 0.5}))
+        env.provision()
+        termination = TerminationController(env.kube, env.provider, env.recorder, clock=env.clock)
+        import re
+
+        def count_of(text):
+            m = re.search(r"karpenter_nodes_termination_time_seconds_count (\d+)", text)
+            return int(m.group(1)) if m else 0
+
+        before = count_of(REGISTRY.export_text())
+        node = env.kube.list_nodes()[0]
+        env.kube.delete(node)
+        termination.reconcile_all()
+        assert count_of(REGISTRY.export_text()) == before + 1, "no termination sample observed"
