@@ -157,6 +157,11 @@ class ProvisionerController:
                 continue
             if self.volume_topology.needs_injection(pod):
                 pod = copy.deepcopy(pod)
+                # the copy inherits the per-pod memo caches with an unchanged
+                # resource_version; inject() mutates affinity, so a stale
+                # cache would silently drop the volume-zone requirement
+                pod.__dict__.pop("_reqs_cache", None)
+                pod.__dict__.pop("_encode_cache", None)
                 self.volume_topology.inject(pod)
             pods.append(pod)
         return pods

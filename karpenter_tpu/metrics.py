@@ -163,6 +163,9 @@ class _Timer:
         return False
 
 
+_KINDS = {}  # Metric class -> prometheus kind; populated below the classes
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -194,22 +197,19 @@ class Registry:
 
     def families(self):
         """(name, kind, label_names, help) for every registered family —
-        the one place the class-to-kind mapping lives (export_text and the
-        docgen both consume it)."""
-        kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"}
+        the docgen surface; _KINDS is the one class-to-kind mapping."""
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
-        return [(m.name, kinds.get(type(m), "untyped"), tuple(m.label_names), m.help) for m in metrics]
+        return [(m.name, _KINDS.get(type(m), "untyped"), tuple(m.label_names), m.help) for m in metrics]
 
     def export_text(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []
-        kinds = dict((name, kind) for name, kind, _, _ in self.families())
         with self._lock:
             metrics = list(self._metrics.values())
         for metric in metrics:
             lines.append(f"# HELP {metric.name} {metric.help}")
-            lines.append(f"# TYPE {metric.name} {kinds[metric.name]}")
+            lines.append(f"# TYPE {metric.name} {_KINDS.get(type(metric), 'untyped')}")
             for labels, value, suffix in metric.collect():  # type: ignore[attr-defined]
                 label_str = ",".join(f'{k}="{v}"' for k, v in labels.items() if v != "")
                 label_part = f"{{{label_str}}}" if label_str else ""
@@ -218,4 +218,6 @@ class Registry:
 
 
 # the default process-wide registry (controller-runtime analog)
+_KINDS.update({Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"})
+
 REGISTRY = Registry()

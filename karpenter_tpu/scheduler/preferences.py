@@ -38,6 +38,9 @@ class Preferences:
         # deepcopy would carry the pre-relaxation signature onto the relaxed
         # copy, so drop it (ir/encode.py re-encodes on the next solve)
         candidate.__dict__.pop("_encode_cache", None)
+        candidate.__dict__.pop("_reqs_cache", None)  # same staleness hazard
+        # (Requirements.from_pod memoizes per resource_version, which the
+        # copy shares — without the pop, the dropped term would still bind)
         relaxations = [
             self._remove_required_node_affinity_term,
             self._remove_preferred_pod_affinity_term,

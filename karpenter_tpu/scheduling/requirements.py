@@ -44,18 +44,31 @@ class Requirements:
         """Pod scheduling requirements: nodeSelector, the heaviest preferred
         node-affinity term, and the *first* required node-affinity term (OR
         semantics are handled by preference relaxation, see
-        core/scheduler/preferences.py). Mirrors requirements.go:61-78."""
+        core/scheduler/preferences.py). Mirrors requirements.go:61-78.
+
+        Memoized per (pod, resource_version): the host loop calls this for
+        every candidate node it scans, and the result is treated as
+        IMMUTABLE by every consumer (compatible/intersects/add never mutate
+        their operands; relaxation copies drop the memo — preferences.py).
+        """
+        version = pod.metadata.resource_version
+        cached = getattr(pod, "_reqs_cache", None)
+        if cached is not None and cached[0] == version:
+            return cached[1]
         requirements = cls.from_labels(pod.spec.node_selector)
         affinity = pod.spec.affinity
-        if affinity is None or affinity.node_affinity is None:
-            return requirements
-        preferred = affinity.node_affinity.preferred
-        if preferred:
-            heaviest = max(preferred, key=lambda term: term.weight)
-            requirements.add(*cls.from_node_selector_requirements(heaviest.preference.match_expressions).values())
-        required = affinity.node_affinity.required
-        if required:
-            requirements.add(*cls.from_node_selector_requirements(required[0].match_expressions).values())
+        if affinity is not None and affinity.node_affinity is not None:
+            preferred = affinity.node_affinity.preferred
+            if preferred:
+                heaviest = max(preferred, key=lambda term: term.weight)
+                requirements.add(*cls.from_node_selector_requirements(heaviest.preference.match_expressions).values())
+            required = affinity.node_affinity.required
+            if required:
+                requirements.add(*cls.from_node_selector_requirements(required[0].match_expressions).values())
+        try:
+            pod._reqs_cache = (version, requirements)
+        except AttributeError:
+            pass  # slotted/frozen pod objects skip the memo
         return requirements
 
     # -- collection protocol ------------------------------------------------

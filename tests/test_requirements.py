@@ -254,3 +254,47 @@ class TestProvisionerValidation:
 
         p = make_provisioner(ttl_seconds_after_empty=30, consolidation_enabled=True)
         assert validate_provisioner(p)
+
+
+class TestFromPodMemo:
+    """from_pod memoization: same object per (pod, resource_version), and
+    relaxation copies must NOT inherit the memo (the dropped term would
+    still bind)."""
+
+    def test_memo_returns_same_object(self):
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from tests.helpers import make_pod
+
+        pod = make_pod(node_selector={"topology.kubernetes.io/zone": "test-zone-1"})
+        assert Requirements.from_pod(pod) is Requirements.from_pod(pod)
+
+    def test_resource_version_invalidates(self):
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from tests.helpers import make_pod
+
+        pod = make_pod(node_selector={"topology.kubernetes.io/zone": "test-zone-1"})
+        first = Requirements.from_pod(pod)
+        pod.spec.node_selector["topology.kubernetes.io/zone"] = "test-zone-2"
+        pod.metadata.resource_version += 1
+        second = Requirements.from_pod(pod)
+        assert second is not first
+        assert second.get("topology.kubernetes.io/zone").has("test-zone-2")
+
+    def test_relaxed_copy_drops_the_memo(self):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement, NodeSelectorTerm, OP_IN
+        from karpenter_tpu.scheduler.preferences import Preferences
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from tests.helpers import make_pod
+
+        terms = [
+            NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(key="custom", operator=OP_IN, values=["a"])]),
+            NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(key="custom", operator=OP_IN, values=["b"])]),
+        ]
+        pod = make_pod(required_node_terms=terms)
+        before = Requirements.from_pod(pod)
+        assert before.get("custom").has("a")
+        relaxed = Preferences().relax(pod)
+        assert relaxed is not None
+        after = Requirements.from_pod(relaxed)
+        # the first OR-term was dropped: the relaxed pod must bind to 'b'
+        assert after.get("custom").has("b") and not after.get("custom").has("a")
