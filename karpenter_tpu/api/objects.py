@@ -274,6 +274,23 @@ class Pod:
 
     kind = "Pod"
 
+    # per-pod memo attributes (Requirements.from_pod, the dense encoder)
+    # keyed on resource_version. deepcopy MUST NOT carry them: copies exist
+    # to be mutated (relaxation, volume-topology injection) and a stale memo
+    # on a mutated copy silently reverts the mutation for every consumer.
+    _COPY_EXCLUDED = ("_reqs_cache", "_encode_cache")
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+
+        clone = self.__class__.__new__(self.__class__)
+        memo[id(self)] = clone
+        for key, value in self.__dict__.items():
+            if key in self._COPY_EXCLUDED:
+                continue
+            setattr(clone, key, _copy.deepcopy(value, memo))
+        return clone
+
     @property
     def name(self) -> str:
         return self.metadata.name

@@ -156,12 +156,9 @@ class ProvisionerController:
                 self.recorder.pod_failed_to_schedule(pod, err)
                 continue
             if self.volume_topology.needs_injection(pod):
+                # Pod.__deepcopy__ drops the per-pod memo caches, so the
+                # injected affinity is re-derived by every consumer
                 pod = copy.deepcopy(pod)
-                # the copy inherits the per-pod memo caches with an unchanged
-                # resource_version; inject() mutates affinity, so a stale
-                # cache would silently drop the volume-zone requirement
-                pod.__dict__.pop("_reqs_cache", None)
-                pod.__dict__.pop("_encode_cache", None)
                 self.volume_topology.inject(pod)
             pods.append(pod)
         return pods

@@ -163,7 +163,7 @@ class _Timer:
         return False
 
 
-_KINDS = {}  # Metric class -> prometheus kind; populated below the classes
+_KINDS = {Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"}
 
 
 class Registry:
@@ -218,6 +218,4 @@ class Registry:
 
 
 # the default process-wide registry (controller-runtime analog)
-_KINDS.update({Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"})
-
 REGISTRY = Registry()

@@ -33,14 +33,9 @@ class Preferences:
         nothing is left to relax."""
         import copy
 
+        # Pod.__deepcopy__ drops the per-pod memo caches, so the relaxed
+        # copy re-derives its signature and requirements (api/objects.py)
         candidate = copy.deepcopy(pod)
-        # the dense encoder caches (signature, requests) on the pod object;
-        # deepcopy would carry the pre-relaxation signature onto the relaxed
-        # copy, so drop it (ir/encode.py re-encodes on the next solve)
-        candidate.__dict__.pop("_encode_cache", None)
-        candidate.__dict__.pop("_reqs_cache", None)  # same staleness hazard
-        # (Requirements.from_pod memoizes per resource_version, which the
-        # copy shares — without the pop, the dropped term would still bind)
         relaxations = [
             self._remove_required_node_affinity_term,
             self._remove_preferred_pod_affinity_term,
