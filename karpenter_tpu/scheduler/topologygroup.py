@@ -62,6 +62,10 @@ class TopologyGroup:
         # hundreds of registered hostnames that scan dominated warm-cluster
         # fills
         self._zero_domains: Set[str] = set(self.domains)
+        # selects(pod) is deterministic per pod (labels are immutable during
+        # a solve) but the matching scans call it twice per add per group —
+        # memoize by uid (groups live for one solve; the cache dies with it)
+        self._selects_cache: Dict[str, bool] = {}
         self.owners: Set[str] = set()  # pod UIDs governed by this group
         # rotates among equal-min-count domains so a pod whose chosen domain
         # proves infeasible (e.g. no offering for that zone x capacity-type
@@ -97,8 +101,12 @@ class TopologyGroup:
         return uid in self.owners
 
     def selects(self, pod: Pod) -> bool:
-        selector = self.selector or LabelSelector()
-        return pod.namespace in self.namespaces and selector.matches(pod.metadata.labels)
+        cached = self._selects_cache.get(pod.uid)
+        if cached is None:
+            selector = self.selector or LabelSelector()
+            cached = pod.namespace in self.namespaces and selector.matches(pod.metadata.labels)
+            self._selects_cache[pod.uid] = cached
+        return cached
 
     def counts(self, pod: Pod, requirements: Requirements) -> bool:
         """Would this pod, scheduled onto a node with `requirements`, count?"""
