@@ -11,8 +11,11 @@ Pipeline (one call per batch, attached to Scheduler via `dense_solver=`):
                  the bounded-space FFD packing scan over the sorted pod
                  stream; both jitted, shapes padded to tile buckets.
   4. verify    — vectorized numpy feasibility audit of the proposed layout
-                 (per-bin capacity, compat, offerings, skew); any bucket that
-                 fails is evicted wholesale to the host loop.
+                 (per-bin capacity, compat, offerings); skew is NOT audited —
+                 it is correct by construction from the water-filling domain
+                 assignment of step 2, and the exact view/add protocols own
+                 it wherever placements touch live state. Any bin that fails
+                 the audit is evicted wholesale to the host loop.
   5. commit    — construct VirtualNodes directly (no per-pod search) and
                  record topology domains, so host-path pods that follow see
                  consistent counts.
