@@ -194,3 +194,52 @@ class TestClusterState:
         before = env.cluster.consolidation_epoch()
         env.kube.create(make_pod(node_name="n1", unschedulable=False))
         assert env.cluster.consolidation_epoch() > before
+
+
+class TestNoPreBinding:
+    """The No Pre-Binding contract (reference suite_test.go:4036): the
+    provisioner NEVER writes spec.nodeName — pods are only nominated (events
+    + nomination TTL) and the cluster's own scheduler binds once the node
+    joins. Pre-binding races the kubelet and double-schedules."""
+
+    def test_provisioning_never_binds_pods(self):
+        env = Environment()
+        env.kube.create(make_provisioner())
+        pods = [make_pod(requests={"cpu": 0.5}) for _ in range(6)]
+        for pod in pods:
+            env.kube.create(pod)
+        env.provision()
+        assert env.kube.list_nodes(), "nodes launched"
+        for pod in env.kube.list_pods():
+            assert pod.spec.node_name == "", f"pod {pod.name} was pre-bound"
+        # every pod got a nomination event instead
+        nominated = {e.object_name for e in env.recorder.of("NominatePod")}
+        assert nominated == {p.name for p in pods}
+
+    def test_existing_node_placements_not_bound_either(self):
+        from karpenter_tpu.api.labels import (
+            LABEL_CAPACITY_TYPE,
+            LABEL_INSTANCE_TYPE,
+            LABEL_TOPOLOGY_ZONE,
+            PROVISIONER_NAME_LABEL,
+        )
+        from tests.helpers import make_node
+
+        env = Environment()
+        env.kube.create(make_provisioner())
+        labels = {
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "default-instance-type",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_CAPACITY_TYPE: "on-demand",
+        }
+        env.kube.create(make_node(name="warm", labels=labels, allocatable={"cpu": 16, "memory": "32Gi", "pods": 110}))
+        pod = make_pod(requests={"cpu": 0.5})
+        env.kube.create(pod)
+        results = env.provision()
+        # the pod must genuinely land on the warm node (no fresh launch) —
+        # otherwise the existing-node pre-binding contract isn't exercised
+        assert not [n for n in results.new_nodes if n.pods], "pod must fill the warm node"
+        assert [(v.node.name, len(v.pods)) for v in results.existing_nodes if v.pods] == [("warm", 1)]
+        stored = next(p for p in env.kube.list_pods() if p.name == pod.name)
+        assert stored.spec.node_name == "", "existing-node placement must nominate, not bind"
