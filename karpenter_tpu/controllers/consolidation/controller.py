@@ -95,8 +95,11 @@ class ConsolidationMetrics:
 
 
 class ConsolidationController:
-    STABILIZATION_WINDOW = 300.0  # max settle wait (controller.go:573-580)
+    STABILIZATION_WINDOW = 300.0  # unsettled-cluster settle wait (controller.go:573-580)
     POLL_INTERVAL = 10.0
+    # retry.Attempts(30) × Delay(2s)..MaxDelay(10s) ≈ 4.5 minutes total
+    # (controller.go:341-345): a replacement that never initializes is abandoned
+    REPLACE_READY_TIMEOUT = 270.0
 
     def __init__(
         self,
@@ -118,22 +121,42 @@ class ConsolidationController:
         self.metrics = ConsolidationMetrics()
         self._last_epoch = -1
         self._pending_replace: Optional[ConsolidationAction] = None
+        self._pending_deadline = 0.0
 
     # -- gating ---------------------------------------------------------------
 
-    SETTLE_SECONDS = 30.0
+    def stabilization_window(self) -> float:
+        """0 when the cluster is settled, 5 minutes when it is converging
+        (controller.go:573-580). The reference's settled test is "no pending
+        pods and every ReplicaSet/RC/StatefulSet reports ready"; the object
+        model here has no workload controllers, so the capacity-side analog is
+        "no pending pods and every node is Ready and initialized" — both
+        detect in-flight convergence that consolidation should not race."""
+        if self.kube.pending_pods():
+            return self.STABILIZATION_WINDOW
+        for node in self.kube.list_nodes():
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            if not node.ready() or node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) != "true":
+                return self.STABILIZATION_WINDOW
+        return 0.0
 
     def should_run(self) -> bool:
         epoch = self.cluster.consolidation_epoch()
         if epoch == self._last_epoch and self._pending_replace is None:
             return False
-        # stabilization: wait for the cluster to settle after any node churn
-        # (creation OR deletion) before disrupting more capacity, capped at
-        # the stabilization window (controller.go:573-580)
+        if self._pending_replace is not None:
+            # a parked replacement is the tail of an in-flight action, not a
+            # new disruption — the reference completes it inside the same
+            # ProcessCluster call, unconditioned on stabilization
+            return True
+        # stabilization: a settled cluster consolidates again immediately; a
+        # churning one waits out the full window since the last node
+        # creation/deletion (controller.go:96-103,573-580)
         now = self.clock.now()
         last_churn = max(self.cluster.last_node_creation_time(), self.cluster.last_node_deletion_time())
-        settle = min(self.SETTLE_SECONDS, self.STABILIZATION_WINDOW)
-        if last_churn > 0 and now - last_churn < settle:
+        settle = self.stabilization_window()
+        if settle > 0 and last_churn > 0 and now - last_churn < settle:
             return False
         self._last_epoch = epoch
         return True
@@ -146,16 +169,29 @@ class ConsolidationController:
             return self._process_cluster()
 
     def _process_cluster(self) -> ConsolidationAction:
-        # finish a replacement that was waiting on readiness
+        # finish a replacement that was waiting on readiness; the wait is
+        # bounded at ~4.5 minutes (controller.go:341-352) — a replacement that
+        # never initializes is abandoned and the old node uncordoned so a
+        # stuck launch cannot wedge all future consolidation
         pending = self._pending_replace
         if pending is not None:
             replacement = self.kube.get_node(pending.replacement_name) if pending.replacement_name else None
             if replacement is None:
                 self._pending_replace = None  # replacement vanished; re-evaluate
+                self._uncordon(pending.nodes)
             elif replacement.ready():
                 self._pending_replace = None
                 self._terminate(pending)
                 return pending
+            elif self.clock.now() >= self._pending_deadline:
+                self._pending_replace = None
+                self._uncordon(pending.nodes)
+                log.warning(
+                    "consolidation replace: timed out waiting for %s readiness; abandoning replacement of %s",
+                    pending.replacement_name,
+                    ", ".join(n.name for n in pending.nodes),
+                )
+                return ConsolidationAction(ActionType.NO_ACTION, reason="replacement readiness timed out")
             else:
                 self.recorder.waiting_on_readiness(replacement)
                 return ConsolidationAction(ActionType.NO_ACTION, reason="waiting on replacement readiness")
@@ -283,6 +319,9 @@ class ConsolidationController:
         if action.type == ActionType.NO_ACTION:
             return
         if action.type == ActionType.REPLACE:
+            # cordon the outgoing node before launching so new pods cannot
+            # land on it while the replacement converges (controller.go:310-312)
+            self._cordon(action.nodes)
             replacement = action.replacement
             node = self.cloud_provider.create(
                 NodeRequest(template=replacement.template, instance_type_options=replacement.instance_type_options)
@@ -301,8 +340,22 @@ class ConsolidationController:
             if not node.ready():
                 self.recorder.waiting_on_readiness(node)
                 self._pending_replace = action
+                self._pending_deadline = self.clock.now() + self.REPLACE_READY_TIMEOUT
                 return
         self._terminate(action)
+
+    def _cordon(self, nodes: Sequence[Node]) -> None:
+        for node in nodes:
+            if not node.spec.unschedulable:
+                node.spec.unschedulable = True
+                self.kube.update(node)
+
+    def _uncordon(self, nodes: Sequence[Node]) -> None:
+        for node in nodes:
+            # a node already being deleted stays cordoned (controller.go:584-586)
+            if node.spec.unschedulable and node.metadata.deletion_timestamp is None:
+                node.spec.unschedulable = False
+                self.kube.update(node)
 
     def _terminate(self, action: ConsolidationAction) -> None:
         for node in action.nodes:

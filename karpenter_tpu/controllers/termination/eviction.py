@@ -1,16 +1,21 @@
-"""EvictionQueue: async pod eviction with PDB-aware retry.
+"""EvictionQueue: async pod eviction with PDB-aware per-item retry.
 
-Mirrors pkg/controllers/termination/eviction.go:41-117 — evictions are
-queued, attempted through the Eviction API, and re-queued when a
-PodDisruptionBudget rejects them (the 429 path); callers poll for drain
-completion rather than blocking on individual evictions.
+Mirrors pkg/controllers/termination/eviction.go:36-117 — evictions are
+queued, attempted through the Eviction API, and individually re-queued with
+exponential backoff (base 100ms, max 10s — the ItemExponentialFailureRateLimiter
+at eviction.go:37-38,52) when a PodDisruptionBudget rejects them (the 429
+path). A blocked pod never stalls the rest of the queue: each item carries
+its own next-attempt time, so a drain pass skips pods still backing off and
+keeps evicting the others (the reference's workqueue delivers the same
+property by re-adding failures via AddRateLimited while the Start loop keeps
+consuming, eviction.go:71-90).
 """
 
 from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Deque, Optional, Set
+from typing import Deque, Dict, Optional, Set
 
 from ...api.objects import Pod
 from ...events import Recorder
@@ -18,12 +23,20 @@ from ...kube.cluster import KubeCluster
 
 
 class EvictionQueue:
-    def __init__(self, kube: KubeCluster, recorder: Optional[Recorder] = None):
+    BASE_DELAY = 0.1  # evictionQueueBaseDelay (eviction.go:37)
+    MAX_DELAY = 10.0  # evictionQueueMaxDelay (eviction.go:38)
+
+    def __init__(self, kube: KubeCluster, recorder: Optional[Recorder] = None, clock=None):
+        from ...utils.clock import Clock
+
         self.kube = kube
         self.recorder = recorder or Recorder()
+        self.clock = clock or kube.clock or Clock()
         self._lock = threading.Lock()
         self._queue: Deque[Pod] = deque()
         self._queued: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._not_before: Dict[str, float] = {}
 
     def add(self, *pods: Pod) -> None:
         with self._lock:
@@ -32,29 +45,50 @@ class EvictionQueue:
                     self._queued.add(pod.uid)
                     self._queue.append(pod)
 
+    def _forget(self, pod: Pod) -> None:
+        with self._lock:
+            self._queued.discard(pod.uid)
+            self._failures.pop(pod.uid, None)
+            self._not_before.pop(pod.uid, None)
+
+    def _requeue_failed(self, pod: Pod, now: float) -> None:
+        with self._lock:
+            n = self._failures.get(pod.uid, 0) + 1
+            self._failures[pod.uid] = n
+            self._not_before[pod.uid] = now + min(self.MAX_DELAY, self.BASE_DELAY * (2 ** (n - 1)))
+            self._queue.append(pod)
+
     def drain_once(self, budget: int = 1000) -> int:
-        """Attempt up to `budget` queued evictions; PDB-blocked pods re-queue.
-        Returns the number evicted."""
+        """Attempt up to `budget` due evictions; PDB-blocked pods re-queue with
+        per-item exponential backoff and do NOT block later items. Returns the
+        number evicted."""
         evicted = 0
-        for _ in range(budget):
+        attempts = 0
+        now = self.clock.now()
+        with self._lock:
+            passes = len(self._queue)
+        for _ in range(passes):
+            if attempts >= budget:
+                break
             with self._lock:
                 if not self._queue:
                     break
                 pod = self._queue.popleft()
+                if self._not_before.get(pod.uid, 0.0) > now:
+                    # still backing off: rotate to the tail, keep draining others
+                    self._queue.append(pod)
+                    continue
+            attempts += 1
             if self.kube.get("Pod", pod.name, pod.namespace) is None:
-                with self._lock:
-                    self._queued.discard(pod.uid)
+                self._forget(pod)  # 404: already gone counts as evicted (eviction.go:100-102)
                 continue
             if self.kube.evict_pod(pod):
                 self.recorder.evict_pod(pod)
-                with self._lock:
-                    self._queued.discard(pod.uid)
+                self._forget(pod)
                 evicted += 1
             else:
-                # PDB rejected (429): back off by re-queuing at the tail
-                with self._lock:
-                    self._queue.append(pod)
-                break
+                # PDB rejected (429): individual backoff, siblings continue
+                self._requeue_failed(pod, now)
         return evicted
 
     def __len__(self) -> int:

@@ -143,6 +143,7 @@ class TestTermination:
         env.termination_controller.reconcile_all()
         assert len(env.kube.list_nodes()) == 1  # eviction 429'd
         pdb.disruptions_allowed = 1
+        env.clock.step(1)  # let the per-item eviction backoff elapse
         env.termination_controller.reconcile_all()
         assert env.kube.list_nodes() == []
 
@@ -440,3 +441,92 @@ class TestConsolidationDepth:
         action = env.consolidation.process_cluster()
         assert action.type == ActionType.DELETE_EMPTY
         assert [n.metadata.name for n in action.nodes] == [node.metadata.name]
+
+
+class TestConsolidationRobustness:
+    """Round-3 robustness parity: bounded replacement wait
+    (consolidation/controller.go:341-352), settled/unsettled stabilization
+    (:573-580), and per-item eviction backoff (eviction.go:36-117)."""
+
+    def _replace_env_with_not_ready_launches(self):
+        from karpenter_tpu.cloudprovider.types import Offering
+
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        env = DeprovEnv(
+            provisioners=[consolidatable_provisioner()],
+            instance_types_list=[
+                instance_type("big", cpu=16, memory="32Gi", price=10.0, offerings=od),
+                instance_type("small", cpu=2, memory="4Gi", price=1.0, offerings=od),
+            ],
+        )
+        pod = owned_pod(requests={"cpu": "8"})
+        old_nodes = env.launch_node_with_pods(pod)
+        pod.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(pod)
+        original = env.provider.create
+
+        def create_not_ready(request):
+            node = original(request)
+            node.status.conditions = []
+            return node
+
+        env.provider.create = create_not_ready
+        return env, old_nodes
+
+    def test_stuck_replacement_times_out_and_uncordons(self):
+        env, old_nodes = self._replace_env_with_not_ready_launches()
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.REPLACE
+        old = env.kube.get_node(old_nodes[0].name)
+        assert old.spec.unschedulable  # cordoned while replacement converges
+        # replacement never initializes: wait is bounded, not forever
+        env.clock.step(ConsolidationController.REPLACE_READY_TIMEOUT + 1)
+        timed_out = env.consolidation.process_cluster()
+        assert timed_out.type == ActionType.NO_ACTION
+        assert "timed out" in timed_out.reason
+        # old node survives, uncordoned, and consolidation is NOT wedged:
+        # the next pass re-evaluates instead of parking on the dead action
+        old = env.kube.get_node(old_nodes[0].name)
+        assert old is not None and not old.spec.unschedulable
+        assert env.consolidation._pending_replace is None
+        # the next pass re-evaluates and acts (the abandoned launch now counts
+        # as in-flight capacity, so the old node can simply be deleted)
+        again = env.consolidation.process_cluster()
+        assert again.type != ActionType.NO_ACTION
+
+    def test_settled_cluster_consolidates_immediately(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        # settled: no pending pods, every node Ready+initialized -> window 0,
+        # so churn moments ago does not delay the next pass
+        assert env.consolidation.stabilization_window() == 0.0
+        assert env.consolidation.should_run()
+
+    def test_unsettled_cluster_waits_full_window(self):
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        # a pending pod marks the cluster unsettled -> 5 minute window
+        env.kube.create(make_pod(requests={"cpu": "100"}, node_name=None))
+        assert env.consolidation.stabilization_window() == ConsolidationController.STABILIZATION_WINDOW
+        assert not env.consolidation.should_run()
+        env.clock.step(ConsolidationController.STABILIZATION_WINDOW + 1)
+        assert env.consolidation.should_run()
+
+    def test_pdb_blocked_pod_does_not_stall_other_evictions(self):
+        env = DeprovEnv()
+        guarded = owned_pod(labels={"app": "guarded"}, requests={"cpu": "1"})
+        free = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(guarded, free)
+        assert len(nodes) == 1
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="guard", namespace="default"),
+                selector=LabelSelector(match_labels={"app": "guarded"}),
+                disruptions_allowed=0,
+            )
+        )
+        env.kube.delete(nodes[0])
+        env.termination_controller.reconcile_all()
+        # the guarded pod 429s, but the free pod behind it still evicts
+        assert env.kube.get("Pod", free.name, free.namespace) is None
+        assert env.kube.get("Pod", guarded.name, guarded.namespace) is not None
