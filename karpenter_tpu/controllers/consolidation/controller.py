@@ -186,10 +186,13 @@ class ConsolidationController:
             elif self.clock.now() >= self._pending_deadline:
                 self._pending_replace = None
                 self._uncordon(pending.nodes)
+                # reap the never-ready launch: with no liveness reaper in the
+                # node controller it would otherwise leak as phantom in-flight
+                # capacity (and real money) forever
+                self.kube.delete(replacement)
                 log.warning(
-                    "consolidation replace: timed out waiting for %s readiness; abandoning replacement of %s",
+                    "consolidation replace: timed out waiting for %s readiness; abandoning and reaping it",
                     pending.replacement_name,
-                    ", ".join(n.name for n in pending.nodes),
                 )
                 return ConsolidationAction(ActionType.NO_ACTION, reason="replacement readiness timed out")
             else:
@@ -323,10 +326,16 @@ class ConsolidationController:
             # land on it while the replacement converges (controller.go:310-312)
             self._cordon(action.nodes)
             replacement = action.replacement
-            node = self.cloud_provider.create(
-                NodeRequest(template=replacement.template, instance_type_options=replacement.instance_type_options)
-            )
-            self.kube.create(node)
+            try:
+                node = self.cloud_provider.create(
+                    NodeRequest(template=replacement.template, instance_type_options=replacement.instance_type_options)
+                )
+                self.kube.create(node)
+            except Exception:
+                # launch failed: restore schedulability before surfacing the
+                # error (controller.go:321-325 uncordons on launch failure)
+                self._uncordon(action.nodes)
+                raise
             action.replacement_name = node.name
             log.info("consolidation replace: launching %s to replace %s (%s)", node.name, ", ".join(n.name for n in action.nodes), action.reason)
             self.metrics.record_created()
@@ -351,7 +360,12 @@ class ConsolidationController:
                 self.kube.update(node)
 
     def _uncordon(self, nodes: Sequence[Node]) -> None:
-        for node in nodes:
+        for stale in nodes:
+            # re-fetch: the cached copy may be gone or superseded by the time
+            # a parked action unwinds
+            node = self.kube.get_node(stale.name)
+            if node is None:
+                continue
             # a node already being deleted stays cordoned (controller.go:584-586)
             if node.spec.unschedulable and node.metadata.deletion_timestamp is None:
                 node.spec.unschedulable = False

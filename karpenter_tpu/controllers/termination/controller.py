@@ -33,7 +33,7 @@ class TerminationController:
         self.cloud_provider = cloud_provider
         self.recorder = recorder or Recorder()
         self.clock = clock or kube.clock or Clock()
-        self.eviction_queue = EvictionQueue(kube, self.recorder)
+        self.eviction_queue = EvictionQueue(kube, self.recorder, clock=self.clock)
         self.termination_durations: List[float] = []  # metrics summary source
         from ...metrics import REGISTRY
 

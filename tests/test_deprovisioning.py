@@ -489,8 +489,10 @@ class TestConsolidationRobustness:
         old = env.kube.get_node(old_nodes[0].name)
         assert old is not None and not old.spec.unschedulable
         assert env.consolidation._pending_replace is None
-        # the next pass re-evaluates and acts (the abandoned launch now counts
-        # as in-flight capacity, so the old node can simply be deleted)
+        # the never-ready launch is reaped, not leaked as phantom capacity
+        replacement = env.kube.get_node(action.replacement_name)
+        assert replacement is None or replacement.metadata.deletion_timestamp is not None
+        # and consolidation is not wedged: the next pass re-evaluates and acts
         again = env.consolidation.process_cluster()
         assert again.type != ActionType.NO_ACTION
 
