@@ -87,7 +87,12 @@ class CloudProvider(abc.ABC):
 
     @abc.abstractmethod
     def create(self, node_request: NodeRequest) -> Node:
-        """Launch capacity satisfying the request; returns the created Node."""
+        """Launch capacity satisfying the request; returns the created Node.
+
+        MUST be thread-safe: the provisioner fans a batch out over a thread
+        pool (up to Provisioner.LAUNCH_WORKERS concurrent calls), matching
+        the reference's one-goroutine-per-node launch (provisioner.go:176).
+        """
 
     @abc.abstractmethod
     def delete(self, node: Node) -> None: ...

@@ -210,15 +210,54 @@ class ProvisionerController:
 
     # -- launching ---------------------------------------------------------------
 
+    # upper bound on concurrent cloud Create calls; the reference fans out
+    # one goroutine per node (provisioner.go:176 ParallelizeUntil with
+    # workers == len(nodes)) — a cap keeps thread count sane at 10k scale
+    LAUNCH_WORKERS = 50
+
     def launch_nodes(self, results: SchedulingResults) -> List[str]:
-        launched: List[str] = []
         provisioners = {p.name: p for p in self.kube.list_provisioners()}
-        for virtual_node in results.new_nodes:
-            if not virtual_node.pods:
-                continue
-            name = self._launch(virtual_node, provisioners)
-            if name is not None:
-                launched.append(name)
+        to_launch = [vn for vn in results.new_nodes if vn.pods]
+
+        # limits prescreen stays serial with projected usage so a concurrent
+        # batch cannot blow through a provisioner limit mid-flight (the
+        # sequential loop got this accounting for free via cluster state)
+        approved = []
+        projected: Dict[str, Dict[str, float]] = {}
+        usage_snapshot: Dict[str, Dict[str, float]] = {}  # state is frozen until creates start
+        for vn in to_launch:
+            provisioner = provisioners.get(vn.provisioner_name)
+            if provisioner is not None and provisioner.spec.limits is not None:
+                if vn.provisioner_name not in usage_snapshot:
+                    usage_snapshot[vn.provisioner_name] = self._provisioner_usage(vn.provisioner_name)
+                usage = res.merge(usage_snapshot[vn.provisioner_name], projected.get(vn.provisioner_name, {}))
+                reason = provisioner.spec.limits.exceeded_by(usage)
+                if reason is not None:
+                    log.warning("not launching node for provisioner %s: limits exceeded: %s", vn.provisioner_name, reason)
+                    for pod in vn.pods:
+                        self.recorder.pod_failed_to_schedule(pod, f"limits exceeded: {reason}")
+                    continue
+                # the provider may land on ANY surviving option, so project the
+                # per-resource max across options — the same conservative
+                # subtractMax stance the scheduler's limit filtering takes
+                estimate: Dict[str, float] = {}
+                for it in vn.instance_type_options:
+                    for k, v in it.resources().items():
+                        if v > estimate.get(k, 0.0):
+                            estimate[k] = v
+                projected[vn.provisioner_name] = res.merge(projected.get(vn.provisioner_name, {}), estimate)
+            approved.append(vn)
+
+        # fan out the cloud Create calls — one slow or failing launch neither
+        # serializes nor aborts its siblings (provisioner.go:172-190)
+        if len(approved) <= 1:
+            names = [self._launch(vn) for vn in approved]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(len(approved), self.LAUNCH_WORKERS)) as pool:
+                names = list(pool.map(self._launch, approved))
+        launched = [n for n in names if n is not None]
         # nominate pods onto existing nodes they were scheduled against
         for view in results.existing_nodes:
             if view.pods:
@@ -227,16 +266,7 @@ class ProvisionerController:
                     self.recorder.nominate_pod(pod, view.node)
         return launched
 
-    def _launch(self, virtual_node, provisioners: Dict[str, Provisioner]) -> Optional[str]:
-        provisioner = provisioners.get(virtual_node.provisioner_name)
-        if provisioner is not None and provisioner.spec.limits is not None:
-            usage = self._provisioner_usage(virtual_node.provisioner_name)
-            reason = provisioner.spec.limits.exceeded_by(usage)
-            if reason is not None:
-                log.warning("not launching node for provisioner %s: limits exceeded: %s", virtual_node.provisioner_name, reason)
-                for pod in virtual_node.pods:
-                    self.recorder.pod_failed_to_schedule(pod, f"limits exceeded: {reason}")
-                return None
+    def _launch(self, virtual_node) -> Optional[str]:
         try:
             node = self.cloud_provider.create(
                 NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
