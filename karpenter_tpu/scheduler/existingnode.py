@@ -59,7 +59,11 @@ class ExistingNodeView:
         self.requirements.add(Requirement(lbl.LABEL_HOSTNAME, OP_IN, hostname))
         topology.register(lbl.LABEL_HOSTNAME, hostname)
 
-    def add(self, pod: Pod) -> None:
+    def add(self, pod: Pod, ctx=None) -> None:
+        """Exact add protocol; `ctx` (Topology.cohort_context) optionally
+        amortizes group-membership scans across identically-shaped pods —
+        it never changes the outcome, only skips recomputing cohort-constant
+        membership."""
         err = self.taints.tolerates(pod)
         if err is not None:
             raise IncompatibleError(err)
@@ -82,7 +86,7 @@ class ExistingNodeView:
             raise IncompatibleError(err)
         node_requirements.add(*pod_requirements.values())
 
-        topology_requirements = self.topology.add_requirements(pod_requirements, node_requirements, pod)
+        topology_requirements = self.topology.add_requirements(pod_requirements, node_requirements, pod, ctx=ctx)
         err = node_requirements.compatible(topology_requirements)
         if err is not None:
             raise IncompatibleError(err)
@@ -92,6 +96,73 @@ class ExistingNodeView:
         self.pods.append(pod)
         self.requests = requests
         self.requirements = node_requirements
-        self.topology.record(pod, node_requirements)
+        self.topology.record(pod, node_requirements, ctx=ctx)
         self.host_port_usage.add(pod)
         self.volume_usage.add(pod)
+
+    def add_cohort(self, pods, ctx=None) -> int:
+        """Add a run of identically-constrained pods (one dense-fill size
+        class of one bucket) in a single protocol pass; returns the number
+        committed — always a prefix of `pods`.
+
+        The first pod runs the full add() protocol; for the rest, every
+        pod-invariant check is provably identical and skipped:
+
+        - taints / requirement compatibility / topology tightening depend
+          only on the cohort's shared constraint signature (ir/encode.py
+          groups by signature), and re-adding identical requirements is
+          idempotent;
+        - topology tightening is count-stable across the run for every
+          group shape this path accepts: affinity pins are fixed once the
+          domain is populated (by the first pod), and inverse anti-affinity
+          counts only move when an *owner* lands, which cannot happen
+          mid-cohort (anti-affinity carriers route to dedicated buckets).
+          Spread groups owned by the cohort re-evaluate min-count skew per
+          pod (topologygroup.go:157-184), so those fall back to add().
+
+        Per pod, only the genuinely per-pod state advances: host-port and
+        volume validation (identical pods CAN conflict on both), exact
+        resource fit, and bulk topology counts via record_cohort.
+        """
+        from .topologygroup import TopologyType
+
+        if not pods:
+            return 0
+        if ctx is None:
+            ctx = self.topology.cohort_context(pods[0])
+        try:
+            self.add(pods[0], ctx=ctx)
+        except IncompatibleError:
+            return 0
+        if len(pods) == 1:
+            return 1
+        rest = pods[1:]
+        if any(g.type == TopologyType.SPREAD for g in ctx.owned):
+            committed = 1
+            for pod in rest:
+                try:
+                    self.add(pod, ctx=ctx)
+                except IncompatibleError:
+                    break
+                committed += 1
+            return committed
+        requirements = self.requirements  # tightened by the first add
+        matching = ctx.matching_for(requirements)
+        inverse_index = ctx.inverse_index
+        placed = []
+        for pod in rest:
+            if self.host_port_usage.validate(pod) is not None:
+                break
+            if self.volume_usage.validate(pod).exceeds(self.volume_limits):
+                break
+            requests = res.merge(self.requests, res.pod_requests(pod))
+            if not res.fits(requests, self.available):
+                break
+            self.pods.append(pod)
+            self.requests = requests
+            self.host_port_usage.add(pod)
+            self.volume_usage.add(pod)
+            placed.append(pod)
+        if placed:
+            self.topology.record_cohort(placed, requirements, matching=matching, inverse_index=inverse_index)
+        return 1 + len(placed)

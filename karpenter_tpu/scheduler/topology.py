@@ -190,11 +190,38 @@ class Topology:
 
     # -- solve-time interface -------------------------------------------------
 
-    def add_requirements(self, pod_requirements: Requirements, node_requirements: Requirements, pod: Pod) -> Requirements:
+    def cohort_context(self, representative: Pod, inverse_index: Optional[Dict[str, List[TopologyGroup]]] = None) -> "CohortContext":
+        """Precompute group membership for a cohort of identically-shaped
+        pods (one dense-solver constraint-signature group). Ownership and
+        selection depend only on the shared signature (labels, namespace,
+        carried constraints), so one scan serves every pod in the cohort —
+        the warm-cluster fill otherwise pays a full LabelSelector sweep per
+        pod per group. Pass a shared `inverse_index` (inverse_owner_index)
+        to amortize that build across many cohorts."""
+        return CohortContext(
+            owned=[g for g in self.topologies.values() if g.is_owned_by(representative.uid)],
+            selected=[g for g in self.topologies.values() if g.selects(representative)],
+            inverse_selected=[g for g in self.inverse_topologies.values() if g.selects(representative)],
+            inverse_index=inverse_index if inverse_index is not None else self.inverse_owner_index(),
+        )
+
+    def add_requirements(
+        self,
+        pod_requirements: Requirements,
+        node_requirements: Requirements,
+        pod: Pod,
+        ctx: Optional["CohortContext"] = None,
+    ) -> Requirements:
         """Tighten node requirements with the next-domain choice of every
         matching topology group; raises RuntimeError when unsatisfiable."""
         requirements = Requirements(*node_requirements.values())
-        for group in self._matching_topologies(pod, node_requirements):
+        if ctx is not None:
+            # ownership is cohort-constant and inverse groups carry no node
+            # filter, so this equals _matching_topologies for every cohort pod
+            matching = ctx.owned + ctx.inverse_selected
+        else:
+            matching = self._matching_topologies(pod, node_requirements)
+        for group in matching:
             pod_domains = pod_requirements.get(group.key) if pod_requirements.has(group.key) else Requirement(group.key, OP_EXISTS)
             node_domains = node_requirements.get(group.key) if node_requirements.has(group.key) else Requirement(group.key, OP_EXISTS)
             domains = group.get(pod, pod_domains, node_domains)
@@ -203,9 +230,11 @@ class Topology:
             requirements.add(domains)
         return requirements
 
-    def record(self, pod: Pod, requirements: Requirements) -> None:
+    def record(self, pod: Pod, requirements: Requirements, ctx: Optional["CohortContext"] = None) -> None:
         """Commit domain counts after a successful placement."""
-        self.record_cohort([pod], requirements)
+        matching = ctx.matching_for(requirements) if ctx is not None else None
+        inverse_index = ctx.inverse_index if ctx is not None else None
+        self.record_cohort([pod], requirements, matching=matching, inverse_index=inverse_index)
 
     def matching_cohort_groups(self, representative: Pod, requirements: Requirements) -> List[TopologyGroup]:
         """Groups that count a cohort represented by this pod under these
@@ -276,6 +305,24 @@ class Topology:
         matching = [g for g in self.topologies.values() if g.is_owned_by(pod.uid)]
         matching += [g for g in self.inverse_topologies.values() if g.counts(pod, requirements)]
         return matching
+
+
+class CohortContext:
+    """Precomputed topology-group membership for one cohort of
+    identically-shaped pods; see Topology.cohort_context."""
+
+    __slots__ = ("owned", "selected", "inverse_selected", "inverse_index")
+
+    def __init__(self, owned, selected, inverse_selected, inverse_index):
+        self.owned: List[TopologyGroup] = owned
+        self.selected: List[TopologyGroup] = selected
+        self.inverse_selected: List[TopologyGroup] = inverse_selected
+        self.inverse_index: Dict[str, List[TopologyGroup]] = inverse_index
+
+    def matching_for(self, requirements: Requirements) -> List[TopologyGroup]:
+        """matching_cohort_groups over the precomputed selection: only the
+        (spread-only) node filter still depends on the node requirements."""
+        return [g for g in self.selected if g.node_filter.matches_requirements(requirements)]
 
 
 def _ignored_for_topology(p: Pod) -> bool:

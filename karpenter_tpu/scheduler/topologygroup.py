@@ -132,30 +132,43 @@ class TopologyGroup:
 
     # -- next-domain selection ----------------------------------------------
 
+    # when the node pins this key to at most this many concrete values (an
+    # existing node's hostname, a chosen zone), next-domain selection only
+    # needs to answer membership for those values instead of scanning /
+    # materializing the full domain universe — with hundreds of registered
+    # hostnames that scan dominated warm-cluster fills
+    _PINNED_FAST_PATH = 4
+
     def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
         if self.type == TopologyType.SPREAD:
             return self._next_domain_spread(pod, pod_domains, node_domains)
         if self.type == TopologyType.POD_AFFINITY:
             return self._next_domain_affinity(pod, pod_domains, node_domains)
-        return self._next_domain_anti_affinity(pod_domains)
+        return self._next_domain_anti_affinity(pod_domains, node_domains)
 
     def _next_domain_spread(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
         global_min = self._domain_min_count(pod_domains)
         self_selecting = self.selects(pod)
         candidates: list = []
         min_count = MAX_INT32
-        for domain in self.domains:
-            if node_domains.has(domain):
-                count = self.domains[domain]
-                if self_selecting:
-                    count += 1
-                # kube-scheduler skew rule: count - global_min <= maxSkew
-                if count - global_min <= self.max_skew:
-                    if count < min_count:
-                        min_count = count
-                        candidates = [domain]
-                    elif count == min_count:
-                        candidates.append(domain)
+        if not node_domains.complement and 0 < len(node_domains.values) <= self._PINNED_FAST_PATH:
+            # pinned node: evaluate the skew rule for just its value(s) —
+            # identical outcome to the full scan, which filters on
+            # node_domains.has(domain) anyway
+            domain_iter = (d for d in sorted(node_domains.values) if d in self.domains)
+        else:
+            domain_iter = (d for d in self.domains if node_domains.has(d))
+        for domain in domain_iter:
+            count = self.domains[domain]
+            if self_selecting:
+                count += 1
+            # kube-scheduler skew rule: count - global_min <= maxSkew
+            if count - global_min <= self.max_skew:
+                if count < min_count:
+                    min_count = count
+                    candidates = [domain]
+                elif count == min_count:
+                    candidates.append(domain)
         if not candidates:
             return Requirement(self.key, OP_DOES_NOT_EXIST)
         choice = candidates[self._tie_rotation % len(candidates)]
@@ -192,7 +205,25 @@ class TopologyGroup:
                         break
         return options
 
-    def _next_domain_anti_affinity(self, pod_domains: Requirement) -> Requirement:
+    def _next_domain_anti_affinity(self, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if not node_domains.complement and 0 < len(node_domains.values) <= self._PINNED_FAST_PATH:
+            # pinned node: the caller only uses the result to (a) test whether
+            # the node's own domain is admitted and (b) distinguish "this node
+            # is blocked" (non-empty result excluding it → IncompatibleError)
+            # from "no zero-count domain exists anywhere" (empty result →
+            # UnsatisfiableTopologyError). Answer membership for the pinned
+            # values; when none is admitted, return one witness zero-count
+            # domain so the global-satisfiability signal is preserved without
+            # materializing all (possibly hundreds of) zero-count hostnames.
+            admitted = [d for d in sorted(node_domains.values) if d in self._zero_domains and pod_domains.has(d)]
+            if admitted:
+                return Requirement(self.key, OP_IN, *admitted)
+            # min() keeps the witness hash-seed independent (determinism is
+            # load-bearing for differential testing, see line 56)
+            witness = min((d for d in self._zero_domains if pod_domains.has(d)), default=None)
+            if witness is not None:
+                return Requirement(self.key, OP_IN, witness)
+            return Requirement(self.key, OP_IN)
         # unconstrained pods (the common case: no explicit requirement on
         # the key) admit every zero-count domain — skip the per-domain scan
         if pod_domains.complement and not pod_domains.values and pod_domains.greater_than is None and pod_domains.less_than is None:

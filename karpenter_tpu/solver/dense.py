@@ -441,8 +441,7 @@ class DenseSolver:
         # host-loop territory — same rule as bucket_proto for new bins
         if group.requirements.has(lbl.LABEL_HOSTNAME):
             return False
-        node_requirements = Requirements(*view.requirements.values())
-        return node_requirements.compatible(group.requirements) is None
+        return view.requirements.compatible(group.requirements) is None
 
     def _fill_existing(self, scheduler, problem: DenseProblem, buckets: List[_Bucket]):
         """Fill existing-node capacity before opening new bins.
@@ -469,27 +468,41 @@ class DenseSolver:
 
         views = scheduler.existing_nodes
         taken = np.zeros((problem.P,), dtype=bool)
-        frees: List[Optional[np.ndarray]] = []
-        tols: List[Optional[np.ndarray]] = []  # fits() tolerance of each view's available
         zone_of: List[Optional[str]] = []
         ct_of: List[Optional[str]] = []
-        for view in views:
+        # headroom matrix [V, R] (free + fits() tolerance), maintained by the
+        # commit helpers — the single authoritative capacity model for this
+        # fill; every screen below is one vector compare against a row or
+        # slice of it instead of per-view Python arithmetic
+        Rdim = problem.requests.shape[1]
+        head = np.full((len(views), Rdim), -1.0)
+        usable = np.zeros((len(views),), dtype=bool)
+        for vi, view in enumerate(views):
             avail = resource_vector(view.available)
             used = resource_vector(view.requests)
-            if avail is None or used is None:
-                frees.append(None)
-                tols.append(None)
-            else:
-                frees.append(np.maximum(avail - used, 0.0))
-                tols.append(res.tolerance(avail))
+            if avail is not None and used is not None:
+                head[vi] = np.maximum(avail - used, 0.0) + res.tolerance(avail)
+                usable[vi] = True
             zone_of.append(view.node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE))
             ct_of.append(view.node.metadata.labels.get(lbl.LABEL_CAPACITY_TYPE))
 
         compat_cache: Dict[tuple, bool] = {}
         committed = 0
+        # group-membership scans are cohort-constant: one context per solver
+        # group, one inverse-owner index per fill (topology.cohort_context)
+        shared_inverse = scheduler.topology.inverse_owner_index()
+        ctx_cache: Dict[int, object] = {}
+
+        def ctx_of(group_index: int):
+            c = ctx_cache.get(group_index)
+            if c is None:
+                rep = problem.groups[group_index].pods[0]
+                c = scheduler.topology.cohort_context(rep, inverse_index=shared_inverse)
+                ctx_cache[group_index] = c
+            return c
 
         def view_ok(bucket: _Bucket, group, vi: int) -> bool:
-            if frees[vi] is None:
+            if not usable[vi]:
                 return False
             if bucket.zone is not None and zone_of[vi] != bucket.zone:
                 return False
@@ -502,16 +515,28 @@ class DenseSolver:
                 compat_cache[key] = ok
             return ok
 
-        def commit(vi: int, row: int) -> bool:
+        def commit(vi: int, row: int, ctx=None) -> bool:
             nonlocal committed
             try:
-                views[vi].add(problem.pods[row])
+                views[vi].add(problem.pods[row], ctx=ctx)
             except IncompatibleError:
                 return False
             taken[row] = True
             committed += 1
-            frees[vi] = frees[vi] - problem.requests[row]
+            head[vi] -= problem.requests[row]
             return True
+
+        def commit_run(vi: int, rows: List[int], ctx=None) -> int:
+            """Commit a same-group run through the cohort fast path;
+            returns how many landed (a prefix of rows)."""
+            nonlocal committed
+            n = views[vi].add_cohort([problem.pods[r] for r in rows], ctx=ctx)
+            for r in rows[:n]:
+                taken[r] = True
+            committed += n
+            if n:
+                head[vi] -= problem.requests[rows[:n]].sum(axis=0)
+            return n
 
         spread_units: Dict[int, List[_Bucket]] = {}
         for bucket in buckets:
@@ -526,6 +551,7 @@ class DenseSolver:
                 # construction), instead of routing hundreds of pods through
                 # the O(pods x views) host loop.
                 group = problem.groups[bucket.group_index]
+                ctx = ctx_of(bucket.group_index)
                 rows = bucket.pod_rows
                 order = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
                 queue = [rows[i] for i in order]
@@ -536,11 +562,11 @@ class DenseSolver:
                     # adds cannot backtrack a half-placed component)
                     total = problem.requests[rows].sum(axis=0)
                     for vi in viable:
-                        if tols[vi] is None or not np.all(total <= frees[vi] + tols[vi]):
+                        if not np.all(total <= head[vi]):
                             continue
-                        if commit(vi, queue[0]):
+                        if commit(vi, queue[0], ctx):
                             for row in queue[1:]:
-                                if not commit(vi, row):
+                                if not commit(vi, row, ctx):
                                     # rare (ports/volume veto mid-component):
                                     # the host loop owns the remainder — it
                                     # sees the recorded affinity domain and
@@ -555,22 +581,32 @@ class DenseSolver:
                     # capacity-checked pod is group-level for these buckets
                     # (taints/requirements/zero-count on this host), so give
                     # the view up rather than retrying every pod on it.
-                    for vi in viable:
-                        if not queue:
-                            break
-                        for qi, row in enumerate(queue):
-                            if not np.all(problem.requests[row] <= frees[vi] + tols[vi]):
+                    # Fit is one [Q, V] matrix: a commit consumes its view
+                    # for this group, so other rows never go stale.
+                    if viable and queue:
+                        qreq = problem.requests[queue]
+                        fit = (qreq[:, None, :] <= head[viable][None, :, :]).all(axis=2)
+                        used = np.zeros(len(queue), dtype=bool)
+                        for j, vi in enumerate(viable):
+                            hits = np.flatnonzero(fit[:, j] & ~used)
+                            if hits.size == 0:
                                 continue
-                            if commit(vi, row):
-                                queue.pop(qi)
-                            break
+                            qi = int(hits[0])
+                            if commit(vi, queue[qi], ctx):
+                                used[qi] = True
+                            if used.all():
+                                break
                 bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
                 continue
             group = problem.groups[bucket.group_index]
             if group.kind == GroupKind.SPREAD:
                 spread_units.setdefault(bucket.group_index, []).append(bucket)
                 continue
-            # plain / zone-pinned affinity: class-vectorized greedy fill
+            # plain / zone-pinned affinity: class-vectorized greedy fill —
+            # select per view across ALL size classes numerically, then land
+            # the whole selection as ONE cohort so the exact protocol runs
+            # once per (bucket, view) instead of once per size class
+            ctx = ctx_of(bucket.group_index)
             rows = bucket.pod_rows
             unique, counts, inverse = dedupe_sizes(problem.requests[rows])
             U = len(unique)
@@ -579,29 +615,43 @@ class DenseSolver:
                 class_rows[int(u)].append(rows[local])
             cursor = [0] * U
             remaining = counts.astype(np.int64).copy()
-            for vi in range(len(views)):
+            # capacity prescreen: only visit views that fit at least one size
+            # class right now (commits only shrink already-visited rows, so
+            # unvisited rows of this one-shot matrix never go stale)
+            cand_views = np.flatnonzero((unique[:, None, :] <= head[None, :, :]).all(axis=2).any(axis=0))
+            for vi in cand_views:
                 if remaining.sum() == 0:
                     break
                 if not view_ok(bucket, group, vi):
                     continue
-                bail = False
+                free = head[vi].copy()
+                selection: List[int] = []
+                take: List[int] = [0] * U
                 for u in range(U):
-                    if bail or remaining[u] == 0:
+                    if remaining[u] == 0:
                         continue
                     size = unique[u]
                     # every size class has pods >= 1 (pod_requests adds it),
                     # so at least one positive component always exists
                     positive = size > 1e-12
-                    headroom = frees[vi][positive] + tols[vi][positive]
-                    k = int(min(np.floor(headroom / size[positive]).min(), remaining[u]))
-                    placed = 0
-                    while placed < k:
-                        if not commit(vi, class_rows[u][cursor[u]]):
-                            bail = True  # exact check vetoed; stop this view
-                            break
-                        cursor[u] += 1
-                        placed += 1
-                    remaining[u] -= placed
+                    k = int(min(np.floor(free[positive] / size[positive]).min(), remaining[u]))
+                    if k <= 0:
+                        continue
+                    selection.extend(class_rows[u][cursor[u] : cursor[u] + k])
+                    take[u] = k
+                    free = free - size * k
+                if not selection:
+                    continue
+                placed = commit_run(vi, selection, ctx)
+                left = placed
+                for u in range(U):
+                    t = min(take[u], left)
+                    cursor[u] += t
+                    remaining[u] -= t
+                    left -= t
+                # placed < len(selection) means the exact check vetoed this
+                # view mid-run; move on to the next view (same as the old
+                # per-class bail)
             bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
 
         # spread groups: one pod at a time, lowest-count zone first. A commit
@@ -622,23 +672,23 @@ class DenseSolver:
                 order = np.lexsort(tuple(-problem.requests[bucket.pod_rows][:, c] for c in (1, 0)))
                 queue = [bucket.pod_rows[i] for i in order]
                 viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
-                states.append({"bucket": bucket, "queue": queue, "count": count, "views": viable, "blocked": False})
+                states.append({"bucket": bucket, "queue": queue, "count": count, "views": np.asarray(viable, dtype=np.int64), "blocked": False})
             while True:
-                live = [s for s in states if s["queue"] and s["views"] and not s["blocked"]]
+                live = [s for s in states if len(s["queue"]) and len(s["views"]) and not s["blocked"]]
                 if not live:
                     break
                 state = min(live, key=lambda s: s["count"])
                 row = state["queue"][0]
                 req = problem.requests[row]
                 placed = False
-                for vi in state["views"]:
-                    if not np.all(req <= frees[vi] + tols[vi]):
-                        continue
-                    if commit(vi, row):
+                # head is maintained by commit, so this slice is always fresh
+                hits = np.flatnonzero((req <= head[state["views"]]).all(axis=1))
+                if hits.size:
+                    vi = int(state["views"][int(hits[0])])
+                    if commit(vi, row, ctx_of(g)):
                         placed = True
                     else:
                         state["blocked"] = True  # skew veto: domain-wide, retry never helps
-                    break
                 if placed:
                     state["queue"].pop(0)
                     state["count"] += 1
