@@ -338,7 +338,11 @@ def main() -> None:
     for count in SWEEP_PODS:
         pods = build_workload(count, seed=13)
         run_once(pods, provider, provisioners, sweep_solver)  # warmup this shape
-        elapsed, scheduled, nodes, _, _, _ = run_once(pods, provider, provisioners, sweep_solver)
+        trials = []
+        for _ in range(3):
+            t, scheduled, nodes, _, _, _ = run_once(pods, provider, provisioners, sweep_solver)
+            trials.append(t)
+        elapsed = float(np.median(trials))
         pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
         sweep[str(count)] = round(pods_per_sec, 0)
         log(

@@ -11,6 +11,11 @@ from __future__ import annotations
 import itertools
 from typing import Dict, List, Optional, Sequence
 
+try:  # the host loop works without numpy; only the vectorized cache needs it
+    import numpy as np
+except ImportError:  # pragma: no cover
+    np = None
+
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
 from ..cloudprovider.types import InstanceType
@@ -25,6 +30,168 @@ from .topology import Topology
 _hostname_counter = itertools.count(1)
 
 
+class CatalogFilterCache:
+    """Vectorized survivor filtering over one shared instance-type catalog.
+
+    The host loop and the dense commit path both re-run
+    filter_instance_types on every add — O(T) Python predicate calls per
+    pod, the reference's hot loop (node.go:139-161). This cache keeps the
+    outcome bit-identical (it delegates to the same three predicates on
+    every cache miss) while making the steady state cheap:
+
+    - resource fit: [T, R] total/overhead matrices evaluated in the same
+      operand order as res.fits ((requests + overhead) <= total + tol) so
+      float64 verdicts cannot drift from the exact predicate;
+    - requirement compatibility + offering: the verdict depends only on the
+      node requirements restricted to keys any catalog type carries (plus
+      zone/capacity-type), so masks memoize by that signature — cohorts of
+      identically-constrained pods hit the same entry across every bin.
+
+    Scoped per (scheduler, provisioner): instance-type objects are shared
+    by reference across nodes, so id() indexes survivor subsets back into
+    the catalog arrays.
+    """
+
+    def __init__(self, types: Sequence[InstanceType]):
+        self.types = list(types)
+        self.index = {id(it): i for i, it in enumerate(self.types)}
+        res_keys: set = set()
+        rel_keys: set = set()
+        for it in self.types:
+            res_keys |= set(it.resources()) | set(it.overhead())
+            rel_keys |= set(it.requirements().keys())
+        rel_keys.add(lbl.LABEL_TOPOLOGY_ZONE)
+        rel_keys.add(lbl.LABEL_CAPACITY_TYPE)
+        self.rel_keys = tuple(sorted(rel_keys))
+        self.kpos = {k: j for j, k in enumerate(sorted(res_keys))}
+        T, R = len(self.types), len(self.kpos)
+        total = np.zeros((T, R))
+        over = np.zeros((T, R))
+        tol = np.zeros((T, R))
+        static_ok = np.ones((T,), dtype=bool)
+        for i, it in enumerate(self.types):
+            r, o = it.resources(), it.overhead()
+            for k, j in self.kpos.items():
+                total[i, j] = r.get(k, 0.0)
+                over[i, j] = o.get(k, 0.0)
+                tol[i, j] = res.tolerance(total[i, j])
+                # overhead alone must fit even for unrequested resources
+                if over[i, j] > total[i, j] + tol[i, j]:
+                    static_ok[i] = False
+        self._total = total
+        self._over = over
+        self._tol = tol
+        self._cap = total - over  # could_fit() headroom only, never fit verdicts
+        self._static_ok = static_ok
+        self._compat_masks: Dict[tuple, "object"] = {}
+
+    def _requirements_signature(self, requirements: Requirements):
+        sig = []
+        for k in self.rel_keys:
+            if requirements.has(k):
+                r = requirements.get(k)
+                sig.append((k, r.complement, frozenset(r.values), r.greater_than, r.less_than))
+        return tuple(sig)
+
+    def _compat_offering_mask(self, requirements: Requirements):
+        sig = self._requirements_signature(requirements)
+        mask = self._compat_masks.get(sig)
+        if mask is None:
+            mask = np.fromiter(
+                (type_is_compatible(it, requirements) and type_has_offering(it, requirements) for it in self.types),
+                dtype=bool,
+                count=len(self.types),
+            )
+            self._compat_masks[sig] = mask
+        return mask
+
+    def _fit_mask(self, requests: Dict[str, float]):
+        cols, vals, missing = [], [], False
+        for k, v in requests.items():
+            j = self.kpos.get(k)
+            if j is None:
+                # no catalog type carries this resource: only a ~zero
+                # request can fit (fits() vs an absent key)
+                if v > 1e-12:
+                    missing = True
+                    break
+            else:
+                cols.append(j)
+                vals.append(v)
+        if missing:
+            return np.zeros((len(self.types),), dtype=bool)
+        mask = self._static_ok
+        if cols:
+            # same operand order as res.fits: (request + overhead) <= total + tol
+            v = np.asarray(vals)
+            mask = mask & ((v[None, :] + self._over[:, cols]) <= self._total[:, cols] + self._tol[:, cols]).all(axis=1)
+        return mask
+
+    def filter(
+        self,
+        options: Sequence[InstanceType],
+        requirements: Requirements,
+        requests: Dict[str, float],
+    ) -> List[InstanceType]:
+        cmask = self._compat_offering_mask(requirements)
+        fmask = self._fit_mask(requests)
+        index = self.index
+        out: List[InstanceType] = []
+        for it in options:
+            i = index.get(id(it))
+            if i is None:
+                # unknown object (not from this catalog): exact predicates
+                if type_is_compatible(it, requirements) and type_fits(it, requests) and type_has_offering(it, requirements):
+                    out.append(it)
+            elif cmask[i] and fmask[i]:
+                out.append(it)
+        return out
+
+    def max_free(self, options: Sequence[InstanceType]) -> Dict[str, float]:
+        """Elementwise max of (resources - overhead) over `options` — the
+        could_fit() headroom vector, computed from the capacity matrix."""
+        rows = [self.index[id(it)] for it in options if id(it) in self.index]
+        if len(rows) != len(options):
+            return _max_free_python(options)
+        free = self._cap[rows].max(axis=0)
+        return {k: float(free[j]) for k, j in self.kpos.items() if free[j] > 0.0}
+
+
+_FILTER_CACHE_MEMO: Dict[tuple, CatalogFilterCache] = {}
+
+
+def catalog_filter_cache(types: Sequence[InstanceType]) -> Optional[CatalogFilterCache]:
+    """Memoized per catalog-list identity (the same discipline as
+    ir/encode.py's catalog key): providers hand out TTL-cached lists, so
+    repeated solves reuse the matrices and warmed compat masks instead of
+    rebuilding per Scheduler. An id() collision after GC is harmless —
+    instance-type objects unknown to a cache fall back to the exact
+    predicates in filter(). Returns None (callers use the pure-Python path)
+    when numpy is unavailable."""
+    if np is None or not types:
+        return None
+    key = (id(types), len(types))
+    cache = _FILTER_CACHE_MEMO.get(key)
+    if cache is None:
+        if len(_FILTER_CACHE_MEMO) >= 64:
+            _FILTER_CACHE_MEMO.clear()
+        cache = CatalogFilterCache(types)
+        _FILTER_CACHE_MEMO[key] = cache
+    return cache
+
+
+def _max_free_python(options: Sequence[InstanceType]) -> Dict[str, float]:
+    free: Dict[str, float] = {}
+    for it in options:
+        caps = it.resources()
+        over = it.overhead()
+        for name, value in caps.items():
+            avail = value - over.get(name, 0.0)
+            if avail > free.get(name, 0.0):
+                free[name] = avail
+    return free
+
+
 class VirtualNode:
     def __init__(
         self,
@@ -32,6 +199,7 @@ class VirtualNode:
         topology: Topology,
         daemon_resources: Dict[str, float],
         instance_types: Sequence[InstanceType],
+        filter_cache: Optional[CatalogFilterCache] = None,
     ):
         # copy template and pin a placeholder hostname so hostname-keyed
         # topologies see this node as a domain (node.go:46-53); stripped at
@@ -47,6 +215,7 @@ class VirtualNode:
         self.requests: Dict[str, float] = dict(daemon_resources or {})
         self.host_port_usage = HostPortUsage()
         self._max_free = None
+        self._filter_cache = filter_cache
 
     @classmethod
     def open_prepared(
@@ -57,6 +226,7 @@ class VirtualNode:
         daemon_resources: Dict[str, float],
         instance_types: Sequence[InstanceType],
         register: bool = True,
+        filter_cache: Optional[CatalogFilterCache] = None,
     ) -> "VirtualNode":
         """Fast constructor for the dense commit path (solver/dense.py):
         the caller supplies an already-validated Requirements set, so the
@@ -91,6 +261,7 @@ class VirtualNode:
         node.requests = dict(daemon_resources or {})
         node.host_port_usage = HostPortUsage()
         node._max_free = None
+        node._filter_cache = filter_cache
         return node
 
     @property
@@ -111,14 +282,10 @@ class VirtualNode:
         successful add (options shrink, requests grow)."""
         free = self._max_free
         if free is None:
-            free = {}
-            for it in self.instance_type_options:
-                caps = it.resources()
-                over = it.overhead()
-                for name, value in caps.items():
-                    avail = value - over.get(name, 0.0)
-                    if avail > free.get(name, 0.0):
-                        free[name] = avail
+            if self._filter_cache is not None:
+                free = self._filter_cache.max_free(self.instance_type_options)
+            else:
+                free = _max_free_python(self.instance_type_options)
             self._max_free = free
         for name, value in pod_requests.items():
             headroom = free.get(name, 0.0) - self.requests.get(name, 0.0)
@@ -151,7 +318,10 @@ class VirtualNode:
         node_requirements.add(*topology_requirements.values())
 
         requests = res.merge(self.requests, res.pod_requests(pod))
-        instance_types = filter_instance_types(self.instance_type_options, node_requirements, requests)
+        if self._filter_cache is not None:
+            instance_types = self._filter_cache.filter(self.instance_type_options, node_requirements, requests)
+        else:
+            instance_types = filter_instance_types(self.instance_type_options, node_requirements, requests)
         if not instance_types:
             raise IncompatibleError(
                 f"no instance type satisfied resources {res.to_string(res.pod_requests(pod))} "

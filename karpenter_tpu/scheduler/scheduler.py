@@ -27,7 +27,7 @@ from ..cloudprovider.types import InstanceType
 from ..scheduling.nodetemplate import NodeTemplate
 from ..utils import resources as res
 from .existingnode import ExistingNodeView
-from .node import IncompatibleError, VirtualNode
+from .node import IncompatibleError, VirtualNode, catalog_filter_cache
 from .preferences import Preferences
 from .queue import Queue
 from .topology import Topology
@@ -84,6 +84,10 @@ class Scheduler:
         self.instance_types = {
             name: sorted(types, key=lambda it: (it.price(), it.name())) for name, types in instance_types.items()
         }
+        # vectorized survivor-filter state per provisioner catalog, shared by
+        # every VirtualNode this solve opens (host loop and dense commits);
+        # keyed on the provider-owned lists so repeated solves reuse entries
+        self.filter_caches = {name: catalog_filter_cache(types) for name, types in instance_types.items()}
         self.daemon_overhead = daemon_overhead or {}
         self.remaining_resources: Dict[str, Dict[str, float]] = {
             p.name: dict(p.spec.limits.resources) for p in provisioners if p.spec.limits is not None
@@ -207,6 +211,7 @@ class Scheduler:
                 self.topology,
                 self.daemon_overhead.get(template.provisioner_name, {}),
                 instance_types,
+                filter_cache=self.filter_caches.get(template.provisioner_name),
             )
             try:
                 node.add(pod)

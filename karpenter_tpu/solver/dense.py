@@ -1306,7 +1306,13 @@ class DenseSolver:
                 continue
             daemon = scheduler.daemon_overhead.get(template.provisioner_name, {})
             node = VirtualNode.open_prepared(
-                template, proto.copy(), scheduler.topology, daemon, options, register=False
+                template,
+                proto.copy(),
+                scheduler.topology,
+                daemon,
+                options,
+                register=False,
+                filter_cache=scheduler.filter_caches.get(template.provisioner_name),
             )
             reqs = node.template.requirements
 
