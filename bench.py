@@ -41,8 +41,56 @@ SWEEP_PODS = (1, 50, 100, 500, 1000, 2000, 5000)  # scheduling_benchmark_test.go
 SWEEP_TYPES = 400
 
 
+PROFILE_DIR = None  # set by --profile: per-config cProfile + XLA trace artifacts
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def profile_config(name, pods, provider, provisioners, solver, state_nodes=()):
+    """Per-config profile artifacts (the scheduling_benchmark_test.go:76-108
+    CPU/heap-profile grid analog): one profiled solve per config emitting
+      <dir>/<name>/host.pstats    — cProfile dump (snakeviz/pstats-ready)
+      <dir>/<name>/host_top.txt   — top-40 cumulative functions
+      <dir>/<name>/xla_trace/     — jax.profiler trace (TensorBoard-ready),
+                                    skipped if the platform can't trace
+    so later rounds can chase latency-curve regressions with data."""
+    import cProfile
+    import io
+    import os
+    import pstats
+
+    out = os.path.join(PROFILE_DIR, name)
+    os.makedirs(out, exist_ok=True)
+    import jax
+
+    pr = cProfile.Profile()
+    trace_ok = True
+    try:
+        with jax.profiler.trace(os.path.join(out, "xla_trace")):
+            pr.enable()
+            try:
+                run_once(pods, provider, provisioners, solver, state_nodes)
+            finally:
+                pr.disable()  # never leave sys.setprofile installed for later configs
+    except Exception as exc:
+        # only the *tracer* may fail soft (platform can't trace); a solve
+        # failure must surface, not silently corrupt later configs
+        trace_ok = False
+        log(f"  [{name}] xla trace failed ({exc}); host profile only")
+        if not pr.getstats():
+            pr.enable()
+            try:
+                run_once(pods, provider, provisioners, solver, state_nodes)
+            finally:
+                pr.disable()
+    pr.dump_stats(os.path.join(out, "host.pstats"))
+    stream = io.StringIO()
+    pstats.Stats(pr, stream=stream).sort_stats("cumulative").print_stats(40)
+    with open(os.path.join(out, "host_top.txt"), "w") as f:
+        f.write(stream.getvalue())
+    log(f"  [{name}] profile artifacts in {out}" + ("" if trace_ok else " (xla trace unavailable)"))
 
 
 def build_workload(count: int, seed: int = 42):
@@ -211,6 +259,8 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         )
         if scheduled < len(pods) * 0.99:
             log(f"  [{name}] WARNING: only {scheduled}/{len(pods)} pods scheduled")
+    if PROFILE_DIR:
+        profile_config(name, pods, provider, provisioners, solver, state_nodes)
     return float(np.median(times) * 1000), times
 
 
@@ -375,4 +425,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--profile" in sys.argv:
+        i = sys.argv.index("--profile")
+        PROFILE_DIR = (
+            sys.argv[i + 1] if len(sys.argv) > i + 1 and not sys.argv[i + 1].startswith("-") else "bench_profiles"
+        )
     main()

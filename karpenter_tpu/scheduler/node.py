@@ -112,7 +112,7 @@ class CatalogFilterCache:
             if j is None:
                 # no catalog type carries this resource: only a ~zero
                 # request can fit (fits() vs an absent key)
-                if v > 1e-12:
+                if v > res.tolerance(0.0):
                     missing = True
                     break
             else:
@@ -171,13 +171,15 @@ def catalog_filter_cache(types: Sequence[InstanceType]) -> Optional[CatalogFilte
     if np is None or not types:
         return None
     key = (id(types), len(types))
-    cache = _FILTER_CACHE_MEMO.get(key)
-    if cache is None:
+    entry = _FILTER_CACHE_MEMO.get(key)
+    if entry is None:
         if len(_FILTER_CACHE_MEMO) >= 64:
             _FILTER_CACHE_MEMO.clear()
-        cache = CatalogFilterCache(types)
-        _FILTER_CACHE_MEMO[key] = cache
-    return cache
+        # pin the key's list object: if it were GC'd, a recycled id could
+        # alias a different catalog onto a stale entry forever
+        entry = (types, CatalogFilterCache(types))
+        _FILTER_CACHE_MEMO[key] = entry
+    return entry[1]
 
 
 def _max_free_python(options: Sequence[InstanceType]) -> Dict[str, float]:
