@@ -453,6 +453,26 @@ class DaemonSet:
         return self.spec_template
 
 
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 LeaseSpec — the leader-election carrier
+    (the reference elects via resourcelock.LeaseLock, controllers.go:104-106)."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    kind = "Lease"
+
+
 def resource_list(**kwargs) -> Dict[str, float]:
     """Convenience builder: resource_list(cpu='100m', memory='1Gi') -> floats.
 

@@ -1,26 +1,58 @@
 """Controller process entry point (cmd/controller/main.go analog).
 
-Boots the runtime against a cluster backend and a cloud provider. With no
-real cluster attached this runs the in-memory simulation backend, which is
-also what the e2e harness drives; a real deployment substitutes a kube-backed
-client with the same surface.
+Boots the runtime against a cluster backend and a cloud provider. Backend
+selection mirrors client-go's config loading: --apiserver-url (or
+$KUBERNETES_APISERVER_URL, or the in-cluster $KUBERNETES_SERVICE_HOST)
+selects the real-protocol HTTP client with Lease leader election and the
+configured QPS/burst budget; otherwise the in-memory simulation backend
+runs, which is also what the e2e harness drives.
 """
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import time
 
 
+def build_kube_backend(options):
+    """Select the cluster backend (controllers.go:86-103's config step)."""
+    url = options.apiserver_url
+    if not url and os.environ.get("KUBERNETES_SERVICE_HOST"):
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        if ":" in host:  # IPv6 service host
+            host = f"[{host}]"
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if port in ("443", "6443"):
+            # the real in-cluster endpoint is TLS + token auth, which this
+            # client does not speak yet — refuse a plain-HTTP dial that can
+            # only fail, and fall back to the simulation backend loudly
+            print(
+                "karpenter-tpu: in-cluster apiserver detected on TLS port "
+                f"{port}; plain-HTTP client unsupported there — set "
+                "--apiserver-url to an HTTP endpoint or run in-memory",
+                file=sys.stderr,
+            )
+        else:
+            url = f"http://{host}:{port}"
+    if url:
+        from ..kube.client import HttpKubeClient
+        from ..utils.clock import Clock
+
+        return HttpKubeClient(url, qps=options.kube_client_qps, burst=options.kube_client_burst, clock=Clock()), url
+    from ..kube.cluster import KubeCluster
+
+    return KubeCluster(), ""
+
+
 def main(argv=None) -> int:
     from ..cloudprovider.fake import FakeCloudProvider
-    from ..kube.cluster import KubeCluster
     from ..runtime import Runtime
     from ..utils.options import parse
 
     options = parse(argv)
-    kube = KubeCluster()
+    kube, url = build_kube_backend(options)
     provider = FakeCloudProvider()
     runtime = Runtime(kube=kube, cloud_provider=provider, options=options)
     runtime.start()
@@ -32,7 +64,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, handle)
     signal.signal(signal.SIGTERM, handle)
-    print("karpenter-tpu controller running (in-memory backend); Ctrl-C to stop", file=sys.stderr)
+    backend = f"apiserver {url}" if url else "in-memory backend"
+    print(f"karpenter-tpu controller running ({backend}); Ctrl-C to stop", file=sys.stderr)
     try:
         while not stop["flag"]:
             time.sleep(0.5)

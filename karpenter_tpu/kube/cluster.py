@@ -82,6 +82,27 @@ class KubeCluster:
         self._dispatch(obj.kind, WatchEvent(MODIFIED, obj))
         return obj
 
+    def update_no_retry(self, obj) -> object:
+        """Conditional update: the write only lands if obj carries the
+        resourceVersion currently stored — the compare-and-swap primitive
+        leader election requires. (Plain update() keeps last-write-wins.)"""
+        with self._lock:
+            store = self._objects.setdefault(obj.kind, {})
+            key = _key(obj)
+            current = store.get(key)
+            if current is None:
+                raise NotFound(f"{obj.kind} {key} not found")
+            if obj.metadata.resource_version not in (0, current.metadata.resource_version):
+                raise Conflict(
+                    f"{obj.kind} {key}: stale resourceVersion {obj.metadata.resource_version} "
+                    f"(current {current.metadata.resource_version})"
+                )
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            store[key] = obj
+        self._dispatch(obj.kind, WatchEvent(MODIFIED, obj))
+        return obj
+
     def apply(self, obj) -> object:
         """create-or-update convenience (like server-side apply)."""
         with self._lock:

@@ -38,7 +38,10 @@ log = get_logger("runtime")
 
 
 class LeaderElector:
-    """Single-flight leadership: first candidate wins, releases on stop."""
+    """Single-flight in-process leadership (kept for embedded/test callers);
+    Runtime itself elects through the coordination.k8s.io Lease protocol
+    (kube/leaderelection.py), which works identically against the in-memory
+    store and a real apiserver."""
 
     _lock = threading.Lock()
     _leader: Optional[str] = None
@@ -102,7 +105,15 @@ class Runtime:
         self.pod_metrics = PodMetricsController(self.kube)
         self.provisioner_metrics = ProvisionerMetricsController(self.kube)
         self.node_metrics = NodeMetricsScraper(self.cluster)
-        self.elector = LeaderElector(identity=f"runtime-{id(self)}")
+        import socket
+        import uuid
+
+        from .kube.leaderelection import LeaseElector
+
+        # hostname + random suffix, the client-go identity recipe — unique
+        # across processes (id(self) is a heap address and can collide)
+        identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.elector = LeaseElector(self.kube, identity=identity, clock=self.kube.clock)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.solve_duration = REGISTRY.histogram(
@@ -122,8 +133,11 @@ class Runtime:
 
     def start(self) -> None:
         if self.options.leader_elect:
-            while not self.elector.try_acquire():
-                if self._stop.wait(timeout=0.5):
+            # Lease-based election (controllers.go:104-106): block until this
+            # runtime holds karpenter-leader-election, keep renewing after
+            self.elector.start()
+            while not self.elector.wait_for_leadership(timeout=0.5):
+                if self._stop.is_set():
                     return
             log.info("leader election won by %s", self.elector.identity)
         log.info(
@@ -143,7 +157,7 @@ class Runtime:
             self.provisioner.remote_solver.close()
         for thread in self._threads:
             thread.join(timeout=5)
-        self.elector.release()
+        self.elector.stop(release=True)
 
     def _spawn(self, target, name: str) -> None:
         thread = threading.Thread(target=target, name=name, daemon=True)

@@ -28,6 +28,10 @@ class Options:
     log_level: str = "info"
     solver_service_address: str = ""  # host:port of the gRPC solver sidecar (empty = in-process)
     solver_service_timeout: float = 30.0
+    # URL of a Kubernetes apiserver (http://host:port). Empty = the in-memory
+    # simulation backend; set (or KUBERNETES_APISERVER_URL) = the real-protocol
+    # HTTP client (kube/client.py) with the QPS/burst budget above
+    apiserver_url: str = ""
 
     def validate(self) -> List[str]:
         errs = []
@@ -75,6 +79,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--log-level", default=_env("LOG_LEVEL", defaults.log_level))
     parser.add_argument("--solver-service-address", default=_env("SOLVER_SERVICE_ADDRESS", defaults.solver_service_address))
     parser.add_argument("--solver-service-timeout", type=float, default=_env("SOLVER_SERVICE_TIMEOUT", defaults.solver_service_timeout))
+    parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))
     errs = options.validate()

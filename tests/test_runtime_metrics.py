@@ -24,6 +24,9 @@ def make_runtime(**kwargs):
 class TestRuntime:
     def test_full_loop_synchronous(self):
         runtime, clock = make_runtime()
+        # the histogram family is registry-global: assert the delta, not the
+        # absolute count, so other runtime suites can share the process
+        before = runtime.solve_duration.count()
         runtime.kube.create(make_provisioner())
         runtime.kube.create(make_pod(requests={"cpu": "1"}))
         results = runtime.provision_once()
@@ -32,7 +35,7 @@ class TestRuntime:
         assert runtime.healthy()
         assert runtime.ready()
         # scheduling duration histogram observed the round
-        assert runtime.solve_duration.count() == 1
+        assert runtime.solve_duration.count() == before + 1
 
     def test_admission_rejects_invalid_provisioner(self):
         runtime, _ = make_runtime()
