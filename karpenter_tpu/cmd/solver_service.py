@@ -3,6 +3,13 @@
     python -m karpenter_tpu.cmd.solver_service --address 127.0.0.1:7473
 
 The control plane connects with --solver-service-address (utils/options.py).
+
+Multi-host: start the SAME command on every host with a shared
+--coordinator (or KARPENTER_TPU_COORDINATOR). Process 0 hosts the RPC
+endpoint and coordinates; every other process enters the SPMD peer loop
+(parallel/peers.py) and mirrors each sharded solve over the global mesh —
+the reference's distributed backend role (SURVEY §5), with XLA collectives
+over ICI/DCN instead of NCCL/MPI.
 """
 
 from __future__ import annotations
@@ -10,8 +17,10 @@ from __future__ import annotations
 import argparse
 import threading
 
-from ..logsetup import configure
+from ..logsetup import configure, get_logger
 from ..service.server import serve
+
+log = get_logger("solver-service")
 
 
 def main(argv=None) -> None:
@@ -25,12 +34,40 @@ def main(argv=None) -> None:
     # jax.devices() spans every host and the solver mesh is global
     from ..parallel.multihost import initialize
 
-    initialize(coordinator_address=args.coordinator)
-    server, port, _ = serve(args.address)
+    distributed = initialize(coordinator_address=args.coordinator)
+    fabric = None
+    if distributed:
+        from ..parallel.peers import PeerFabric
+
+        fabric = PeerFabric()
+        if not fabric.is_coordinator():
+            # peers never serve RPC: they follow the coordinator's solves
+            # through the broadcast barrier until released
+            log.info("process %d entering the SPMD peer loop", fabric.process_index)
+            fabric.serve()
+            return
+    dense_solver = None
+    if fabric is not None:
+        from ..solver import DenseSolver
+
+        dense_solver = DenseSolver(min_batch=1, peer_fabric=fabric)
+    # SIGTERM (the kubelet's termination signal) must release the peer
+    # barrier exactly like Ctrl-C, and so must any startup failure — a
+    # coordinator that dies silently leaves every peer wedged
+    import signal
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    server = None
     try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        server.stop(grace=2.0)
+        server, port, _ = serve(args.address, dense_solver=dense_solver)
+        stop.wait()
+    finally:
+        if server is not None:
+            server.stop(grace=2.0)
+        if fabric is not None:
+            fabric.shutdown(best_effort=True)
 
 
 if __name__ == "__main__":

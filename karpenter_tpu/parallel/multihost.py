@@ -23,12 +23,14 @@ Single-process fallback: with no coordinator configured, initialize() is a
 no-op and everything runs on the local devices — the same code path the
 8-virtual-device CPU tests and the driver dryrun exercise.
 
-LIMITATION (current): serving a solve over a cross-host mesh requires every
-process to enter the same jitted program (SPMD); the sidecar does not yet
-broadcast requests to peer processes, so DenseSolver's auto-detected mesh
-deliberately spans ADDRESSABLE devices only (solver/dense.py _active_mesh).
-The fabric initialization and the host-aware factorization here are the
-seam the peer execution loop plugs into.
+Cross-host execution: a solve over a multi-process mesh is SPMD — every
+process must enter the same jitted program. parallel/peers.py provides that
+loop: the coordinator broadcasts each solve request, peers mirror the
+sharded call, and cmd/solver_service.py routes every non-zero process into
+PeerFabric.serve(). DenseSolver's AUTO-detected mesh still spans only
+addressable devices (a solver constructed without a fabric must never build
+a mesh it cannot drive alone); constructing it with peer_fabric=PeerFabric()
+opts into the global mesh.
 """
 
 from __future__ import annotations

@@ -1,0 +1,331 @@
+"""SPMD peer execution: every process enters the sharded solve.
+
+Closes the multihost.py seam: a jitted program over a multi-process mesh is
+SPMD — every process must call the same computation with the same global
+shapes, each feeding the shards it addresses. The solver runs on process 0
+(the coordinator, where the control plane lives); peer processes cannot see
+its Python control flow, so the fabric gives them a broadcast protocol to
+follow it:
+
+  1. peers block in a fixed-shape header broadcast
+     (multihost_utils.broadcast_one_to_all — itself a tiny jitted collective
+     over the global mesh, so it doubles as the participation barrier);
+  2. the coordinator publishes [opcode, Bp, R, Tp] when a solve arrives;
+  3. a second broadcast carries one flat float32 payload whose size the
+     header fixed (bucket stats ++ caps ++ prices ++ allowed);
+  4. every process reconstructs the arrays, builds its addressable shards
+     (jax.make_array_from_callback), and enters the SAME sharded jit
+     (parallel/sharded.py make_sharded_bucket_cost) over the global mesh —
+     the argmin combine rides ICI within hosts, DCN across (host_mesh_axes);
+  5. the replicated result lands on every process; the coordinator returns
+     it to the solver, peers loop back to 1.
+
+opcode SHUTDOWN releases the peers. With one process the fabric is inert
+and dispatch degrades to the local sharded call — the same code path the
+virtual-device dryrun exercises.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..logsetup import get_logger
+
+log = get_logger("parallel")
+
+OP_SOLVE = 1
+OP_SHUTDOWN = 2
+
+_HEADER = 8  # [opcode, Bp, R, Tp, seq, has_catalog, reserved x2]
+
+
+class PeerFabric:
+    """The solve-broadcast hub for one global (pods x types) mesh."""
+
+    def __init__(self, mesh=None):
+        import jax
+
+        from .multihost import distributed_solver_mesh
+
+        self.mesh = mesh if mesh is not None else distributed_solver_mesh()
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self._seq = 0
+        # catalog epoch cache: caps/prices change rarely, so they are
+        # broadcast and placed once per catalog, not per solve — every
+        # process updates in lockstep when header[5] announces a new one
+        self._catalog_key: Optional[tuple] = None
+        self._catalog_placed: Optional[tuple] = None
+
+    @property
+    def multiprocess(self) -> bool:
+        return self.process_count > 1
+
+    def is_coordinator(self) -> bool:
+        return self.process_index == 0
+
+    # -- wire helpers ---------------------------------------------------------
+
+    def _broadcast(self, value: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(value))
+
+    @staticmethod
+    def _pack(parts) -> np.ndarray:
+        return np.concatenate([p.astype(np.float32).ravel() for p in parts])
+
+    def _global_place(self, array: np.ndarray, spec):
+        """Form a global array on the multi-process mesh: every process holds
+        the full (broadcast) host value and contributes the shards it
+        addresses."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(array.shape, sharding, lambda idx: array[idx])
+
+    def _place_catalog(self, caps: np.ndarray, prices: np.ndarray) -> None:
+        from jax.sharding import PartitionSpec as P
+
+        self._catalog_placed = (
+            self._global_place(caps.astype(np.float32), P("types", None)),
+            self._global_place(prices.astype(np.float32), P("types")),
+        )
+
+    def _enter_solve(self, stats: np.ndarray, allowed: np.ndarray):
+        """The SPMD step every process takes in lockstep. Returns the
+        replicated jax.Array — still in flight, so the coordinator's host
+        speculation can overlap the cross-host solve."""
+        from jax.sharding import PartitionSpec as P
+
+        from .sharded import make_sharded_bucket_cost
+
+        caps_dev, prices_dev = self._catalog_placed
+        fn = make_sharded_bucket_cost(self.mesh)
+        return fn(
+            self._global_place(stats.astype(np.float32), P(None, "pods", None)),
+            caps_dev,
+            prices_dev,
+            self._global_place(allowed, P("pods", "types")),
+        )
+
+    # -- coordinator side ------------------------------------------------------
+
+    def dispatch(self, bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):
+        """Run one bucket->type solve over the global mesh (coordinator);
+        returns the replicated result still in flight (a jax.Array).
+
+        Single-process fabrics skip the broadcasts and just run the sharded
+        program locally. If a multiprocess broadcast/dispatch fails, the
+        peers are released (best-effort SHUTDOWN) before the error
+        surfaces, so a coordinator falling back to single-host solving
+        never leaves the fleet wedged in the barrier.
+        """
+        Bp, R = bucket_stats.shape[1], bucket_stats.shape[2]
+        Tp = caps.shape[0]
+        key = (caps.tobytes(), prices.tobytes())
+        if not self.multiprocess:
+            if key != self._catalog_key:
+                self._place_catalog(caps, prices)
+                self._catalog_key = key
+            return self._enter_solve(bucket_stats, allowed)
+        try:
+            self._seq += 1
+            has_catalog = int(key != self._catalog_key)
+            header = np.asarray([OP_SOLVE, Bp, R, Tp, self._seq, has_catalog, 0, 0], dtype=np.int32)
+            self._broadcast(header)
+            parts = [bucket_stats, allowed]
+            if has_catalog:
+                parts += [caps, prices]
+            self._broadcast(self._pack(parts))
+            if has_catalog:
+                self._place_catalog(caps, prices)
+                self._catalog_key = key
+            return self._enter_solve(bucket_stats, allowed)
+        except Exception:
+            self.shutdown(best_effort=True)
+            raise
+
+    def shutdown(self, best_effort: bool = False) -> None:
+        """Release the peer loops (coordinator)."""
+        if not (self.multiprocess and self.is_coordinator()):
+            return
+        try:
+            self._broadcast(np.asarray([OP_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], dtype=np.int32))
+        except Exception:
+            if not best_effort:
+                raise
+            log.warning("peer fabric: best-effort shutdown broadcast failed")
+
+    # -- peer side -------------------------------------------------------------
+
+    def serve(self) -> int:
+        """Follow the coordinator: block on the header barrier, mirror its
+        solves, exit on SHUTDOWN. Returns the number of solves served.
+
+        A failure inside the mirrored jit is fatal by design: the
+        coordinator's identical program failed the same way, and a peer that
+        skipped a collective would be out of lockstep for every later solve
+        — crash-and-restart is the consistent recovery.
+        """
+        served = 0
+        zero_header = np.zeros((_HEADER,), dtype=np.int32)
+        while True:
+            header = self._broadcast(zero_header)
+            op = int(header[0])
+            if op == OP_SHUTDOWN:
+                log.info("peer %d released after %d solves", self.process_index, served)
+                return served
+            if op != OP_SOLVE:
+                raise RuntimeError(f"peer {self.process_index}: unknown opcode {op}")
+            Bp, R, Tp = int(header[1]), int(header[2]), int(header[3])
+            has_catalog = bool(header[5])
+            size = 2 * Bp * R + Bp * Tp + (Tp * R + Tp if has_catalog else 0)
+            payload = self._broadcast(np.zeros((size,), dtype=np.float32))
+            offsets = np.cumsum([0, 2 * Bp * R, Bp * Tp, Tp * R, Tp])
+            stats = payload[offsets[0] : offsets[1]].reshape(2, Bp, R)
+            allowed = payload[offsets[1] : offsets[2]].reshape(Bp, Tp) > 0.5
+            if has_catalog:
+                caps = payload[offsets[2] : offsets[3]].reshape(Tp, R)
+                prices = payload[offsets[3] : offsets[4]]
+                self._place_catalog(caps, prices)
+            import jax
+
+            jax.block_until_ready(self._enter_solve(stats, allowed))
+            served += 1
+
+
+def _demo_pods(count: int):
+    """Self-contained pod builder for the multi-process demo (no test deps)."""
+    from ..api.objects import Container, ObjectMeta, Pod, PodSpec, ResourceRequirements
+
+    pods = []
+    for i in range(count):
+        cpu = [0.25, 0.5, 1.0][i % 3]
+        pods.append(
+            Pod(
+                metadata=ObjectMeta(name=f"demo-pod-{i:04d}"),
+                spec=PodSpec(containers=[Container(resources=ResourceRequirements(requests={"cpu": cpu, "memory": 512 * 2**20, "pods": 1}))]),
+            )
+        )
+    return pods
+
+
+def run_demo_process(coordinator: str, num_processes: int, process_id: int, pod_count: int = 96) -> dict:
+    """One process of the multi-host demo solve: process 0 runs a full
+    production scheduler solve through DenseSolver(peer_fabric=...), peers
+    serve the SPMD loop. Returns a result dict (for the dryrun / tests).
+
+    Spawned by __graft_entry__.dryrun_multihost and the multi-process test
+    via `python -m karpenter_tpu.parallel.peers`.
+    """
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator, num_processes=num_processes, process_id=process_id)
+    fabric = PeerFabric()
+    if not fabric.is_coordinator():
+        return {"process": process_id, "served": fabric.serve(), "devices": len(jax.devices())}
+
+    from ..cloudprovider.fake import FakeCloudProvider, instance_types
+    from ..scheduler import build_scheduler
+    from .. import solver as solver_mod
+
+    provider = FakeCloudProvider(instance_types(64))
+    pods = _demo_pods(pod_count)
+    dense = solver_mod.DenseSolver(min_batch=1, peer_fabric=fabric)
+    from ..api.provisioner import Provisioner
+
+    scheduler = build_scheduler([Provisioner()], provider, pods, dense_solver=dense)
+    results = scheduler.solve(pods)
+    fabric.shutdown()
+    scheduled = sum(len(n.pods) for n in results.new_nodes) + sum(len(v.pods) for v in results.existing_nodes)
+    return {
+        "process": 0,
+        "scheduled": scheduled,
+        "requested": pod_count,
+        "dense_committed": dense.stats.pods_committed,
+        "devices": len(jax.devices()),
+        "mesh": {k: int(v) for k, v in fabric.mesh.shape.items()},
+        "unschedulable": len(results.unschedulable),
+    }
+
+
+def run_demo_fleet(n_processes: int = 2, devices_per_process: int = 4, pod_count: int = 96, timeout: float = 300.0):
+    """Spawn the demo fleet as OS processes and return their parsed result
+    dicts (coordinator first). Shared by __graft_entry__.dryrun_multihost and
+    tests/test_multihost_peers.py; children are killed on any failure."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    procs = []
+    outs = []
+    try:
+        for pid in range(n_processes):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "karpenter_tpu.parallel.peers",
+                        "--coordinator", coordinator,
+                        "--num-processes", str(n_processes),
+                        "--process-id", str(pid),
+                        "--pods", str(pod_count),
+                        "--cpu-devices", str(devices_per_process),
+                    ],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=root,
+                )
+            )
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"peer demo process failed (rc={p.returncode}):\n{err[-2000:]}")
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+    import os
+    import re
+    import sys
+
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-peer-demo")
+    parser.add_argument("--coordinator", required=True)
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--pods", type=int, default=96)
+    parser.add_argument(
+        "--cpu-devices",
+        type=int,
+        default=0,
+        help="force N virtual CPU devices (a sitecustomize may pre-register a TPU plugin and clobber the env, so this must be re-asserted in-process before jax imports — same trick as tests/conftest.py)",
+    )
+    args = parser.parse_args()
+    if args.cpu_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={args.cpu_devices}"
+        m = re.search(r"--xla_force_host_platform_device_count=\d+", flags)
+        flags = flags.replace(m.group(0), want) if m else f"{flags} {want}".strip()
+        os.environ["XLA_FLAGS"] = flags
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    out = run_demo_process(args.coordinator, args.num_processes, args.process_id, args.pods)
+    json.dump(out, sys.stdout)
+    print()

@@ -117,10 +117,16 @@ class DenseSolver:
     # (None = not probed yet; flips False permanently on any failure)
     _pallas_ok: Optional[bool] = None
 
-    def __init__(self, min_batch: int = 32, num_slots: int = 8, mesh=None):
+    def __init__(self, min_batch: int = 32, num_slots: int = 8, mesh=None, peer_fabric=None):
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # multi-host SPMD: with a PeerFabric (parallel/peers.py) the sharded
+        # dispatch broadcasts each solve so every process of the global mesh
+        # enters the same jitted program; the fabric's mesh becomes the mesh
+        self.peer_fabric = peer_fabric
+        if peer_fabric is not None and mesh is None:
+            mesh = peer_fabric.mesh
         # warm the native packing core at construction (solver construction
         # is bootstrap) so a lazy g++ build never lands inside a live solve;
         # process-wide cached, no-op after the first solver
@@ -809,7 +815,13 @@ class DenseSolver:
                 caps_p[: problem.T] = caps_eff
                 prices_p = np.zeros((Tp,), np.float32)
                 prices_p[: problem.T] = problem.prices
-                catalog = (place(mesh, caps_p, P("types", None)), place(mesh, prices_p, P("types")))
+                if self.peer_fabric is not None and self.peer_fabric.multiprocess:
+                    # multi-process mesh: the fabric broadcasts the catalog
+                    # with each solve and places shards per process — a local
+                    # device_put cannot address the peer devices
+                    catalog = (caps_p, prices_p)
+                else:
+                    catalog = (place(mesh, caps_p, P("types", None)), place(mesh, prices_p, P("types")))
             else:
                 catalog = (jnp.asarray(caps_eff, dtype=jnp.float32), jnp.asarray(problem.prices, dtype=jnp.float32))
             while len(flavor_cache) >= self._catalogs_per_flavor:
@@ -948,6 +960,10 @@ class DenseSolver:
         stats_p[:, :B] = bucket_stats
         allowed_p = np.zeros((Bp, Tp), dtype=bool)
         allowed_p[:B, : allowed.shape[1]] = allowed
+        if self.peer_fabric is not None and self.peer_fabric.multiprocess:
+            # SPMD broadcast: peers mirror this exact call over the global
+            # mesh (parallel/peers.py); result is already replicated numpy
+            return self.peer_fabric.dispatch(stats_p, np.asarray(caps_dev), np.asarray(prices_dev), allowed_p)
         fn = make_sharded_bucket_cost(mesh)
         return fn(
             place(mesh, stats_p, P(None, "pods", None)),

@@ -1,0 +1,35 @@
+"""Multi-host SPMD peer execution (parallel/peers.py).
+
+The real thing, not a simulation of it: two OS processes join one
+jax.distributed fabric over localhost (4 virtual CPU devices each → an
+8-device global mesh), process 0 runs a full production scheduler solve
+through DenseSolver(peer_fabric=...), and process 1 mirrors every sharded
+dispatch through the broadcast barrier. This is the multi-process analog of
+the driver's dryrun_multichip, and the closure of the LIMITATION that
+parallel/multihost.py carried through round 2.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.parallel.peers import run_demo_fleet
+
+
+def test_two_process_spmd_production_solve():
+    outs = run_demo_fleet(n_processes=2, devices_per_process=4, pod_count=96, timeout=240)
+    coord, peer = outs[0], outs[1]
+
+    # the fabric really was global: both processes saw all 8 devices, and
+    # the mesh factorization covers them with the types axis intra-host
+    assert coord["devices"] == 8 and peer["devices"] == 8
+    mesh = coord["mesh"]
+    assert mesh["pods"] * mesh["types"] == 8
+    assert mesh["types"] <= 4  # host_mesh_axes: chatty axis stays on ICI
+
+    # the production solve went through: every pod scheduled, and the dense
+    # path (the sharded dispatch the peer mirrored) carried real work
+    assert coord["scheduled"] == coord["requested"] == 96
+    assert coord["unschedulable"] == 0
+    assert coord["dense_committed"] > 0
+
+    # the peer entered at least one solve and was released cleanly
+    assert peer["served"] >= 1
