@@ -103,6 +103,11 @@ class Scheduler:
             node = state_node.node
             if node.name in excluded:
                 continue
+            # a node being deleted is not schedulable capacity
+            # (suite_test.go:3589: launch a second node if an in-flight node
+            # is terminating)
+            if node.metadata.deletion_timestamp is not None or getattr(state_node, "marked_for_deletion", False):
+                continue
             name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)
             if name is None or name not in named_templates:
                 continue  # not launched by a provisioner we recognize

@@ -382,8 +382,28 @@ class DenseSolver:
         allowed_idx = [i for i in range(len(domains)) if allowed[i]]
         if not allowed_idx:
             return [_Bucket(group_index=group.index, pod_rows=rows, zone="__infeasible__")]
-        counts = self._existing_counts(topology, group, group.topology_key, domains)[allowed_idx].astype(np.float64)
+        counts_all = self._existing_counts(topology, group, group.topology_key, domains).astype(np.float64)
+        counts = counts_all[allowed_idx]
         n = len(rows)
+        # kube skew cap (topologygroup.go:157-169): no domain may exceed the
+        # global minimum over the POD-eligible universe by more than maxSkew.
+        # `allowed` can be narrower than eligibility (provisioner/type
+        # availability), so an untouched-but-eligible domain outside it still
+        # pins the minimum — without this cap the fill would happily stack a
+        # provisioner-pinned zone past the skew the host loop enforces.
+        cap = np.inf
+        if group.max_skew:  # only SPREAD groups reach _water_fill's zone/ct pins
+            pod_req = None
+            if group.requirements is not None and group.requirements.has(group.topology_key):
+                pod_req = group.requirements.get(group.topology_key)
+            # domains the POD could count toward but placement cannot reach
+            # (provisioner/offering narrowing): their counts are FROZEN, so
+            # they pin the global minimum no matter how the fill proceeds.
+            # When every eligible domain is fillable the water level IS the
+            # rising minimum and needs no cap.
+            frozen = [i for i, d in enumerate(domains) if not allowed[i] and (pod_req is None or pod_req.has(d))]
+            if frozen:
+                cap = counts_all[frozen].min() + group.max_skew
         # fill lowest-count domains first; target[i] - counts[i] pods go to i
         order = np.argsort(counts, kind="stable")
         counts_sorted = counts[order]
@@ -404,6 +424,8 @@ class DenseSolver:
                 targets[:level_idx] += per
                 targets[:extra] += 1
                 remaining -= take
+        if np.isfinite(cap):
+            targets = np.minimum(targets, np.maximum(counts_sorted, cap))
         adds = (targets - counts_sorted).astype(np.int64)
         buckets = []
         cursor = 0
@@ -417,7 +439,9 @@ class DenseSolver:
                 buckets.append(_Bucket(group_index=group.index, zone=domain, pod_rows=chunk))
             else:
                 buckets.append(_Bucket(group_index=group.index, capacity_type=domain, pod_rows=chunk))
-        if cursor < len(rows):  # shouldn't happen; be safe
+        if cursor < len(rows):
+            # skew-capped leftovers: the host loop owns them and will fail
+            # them one by one exactly as the reference does
             buckets.append(_Bucket(group_index=group.index, pod_rows=rows[cursor:], zone="__infeasible__"))
         return buckets
 
