@@ -1,0 +1,49 @@
+"""Admission webhook entry point (cmd/webhook/main.go analog).
+
+    python -m karpenter_tpu.cmd.webhook --port 8443 [--register URL]
+
+Serves the AdmissionReview protocol over HTTPS with self-managed serving
+certs (the knative cert-rotation analog, kube/certs.py). With --register,
+posts its webhook configuration (mutate/validate URLs + CA bundle) to a
+karpenter-tpu apiserver's /register-webhooks convenience endpoint; against
+a real apiserver the same material goes into Mutating/Validating
+WebhookConfiguration objects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    from ..cloudprovider.fake import FakeCloudProvider
+    from ..kube.webhookserver import AdmissionWebhookServer
+    from ..logsetup import configure
+
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-webhook")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    configure(args.log_level)
+
+    server = AdmissionWebhookServer(host=args.host, port=args.port, cloud_provider=FakeCloudProvider())
+    server.start()
+    print(f"karpenter-tpu webhook serving AdmissionReview at {server.url} (CA bundle on stdout below)", file=sys.stderr)
+    print(server.cert.ca_pem.decode(), flush=True)  # parents read this via a block-buffered pipe
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

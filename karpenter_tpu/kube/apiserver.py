@@ -77,6 +77,63 @@ class APIServerState:
         self._journal: List[Tuple[int, str, str, dict]] = []  # (rv, kind, type, wire)
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
         self._clock = clock
+        # admission webhook registrations: the Mutating/Validating
+        # WebhookConfiguration analog — (kinds, mutate_url, validate_url,
+        # ca_pem); writes to matching kinds dispatch over HTTPS with the
+        # registered CA bundle verifying the webhook's serving cert
+        self._webhooks: List[tuple] = []
+
+    def register_webhooks(self, kinds, mutate_url: Optional[str], validate_url: Optional[str], ca_pem: bytes) -> None:
+        import ssl
+
+        # the CA bundle is immutable per registration: build its TLS context
+        # once instead of re-parsing the PEM on every admitted write
+        ctx = ssl.create_default_context(cadata=ca_pem.decode())
+        self._webhooks.append((set(kinds), mutate_url, validate_url, ctx))
+
+    def _call_webhook(self, url: str, ctx, wire: dict, operation: str) -> dict:
+        import urllib.request
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": f"rev-{self._rv}", "object": wire, "operation": operation},
+        }
+        req = urllib.request.Request(url, data=json.dumps(review).encode(), headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+                return json.loads(resp.read())
+        except ApiError:
+            raise
+        except Exception as exc:  # TLS/transport failure -> the real
+            # apiserver's "failed calling webhook" InternalError
+            raise ApiError(500, "InternalError", f"failed calling webhook {url}: {exc}") from exc
+
+    def _admit(self, kind: str, wire: dict, operation: str) -> dict:
+        """Run registered webhooks: defaulting (apply JSONPatch) then
+        validation (webhooks.go:41-96 ordering); a disallow maps to 422."""
+        for kinds, mutate_url, validate_url, ctx in self._webhooks:
+            if kind not in kinds:
+                continue
+            if mutate_url:
+                out = self._call_webhook(mutate_url, ctx, wire, operation).get("response") or {}
+                if not out.get("allowed", False):
+                    raise ApiError(422, "Invalid", (out.get("status") or {}).get("message", "admission denied"))
+                if out.get("patch"):
+                    try:
+                        import base64
+
+                        from .webhookserver import apply_json_patch
+
+                        ops = json.loads(base64.b64decode(out["patch"]))
+                        wire = apply_json_patch(wire, ops)
+                    except Exception as exc:  # malformed/unsupported patch
+                        raise ApiError(500, "InternalError", f"failed applying webhook patch from {mutate_url}: {exc}") from exc
+            if validate_url:
+                out = self._call_webhook(validate_url, ctx, wire, operation).get("response") or {}
+                if not out.get("allowed", False):
+                    raise ApiError(422, "Invalid", (out.get("status") or {}).get("message", "admission denied"))
+        return wire
 
     def _now(self) -> float:
         return self._clock.now() if self._clock is not None else time.time()
@@ -98,6 +155,7 @@ class APIServerState:
     # -- verbs (wire dicts in, wire dicts out; raise (code, reason, msg)) ----
 
     def create(self, kind: str, namespace: str, wire: dict) -> dict:
+        wire = self._admit(kind, wire, "CREATE")
         with self._lock:
             meta = wire.setdefault("metadata", {})
             meta.setdefault("namespace", namespace)
@@ -115,6 +173,7 @@ class APIServerState:
             return wire
 
     def update(self, kind: str, namespace: str, name: str, wire: dict) -> dict:
+        wire = self._admit(kind, wire, "UPDATE")
         with self._lock:
             key = (kind, namespace, name)
             current = self._objects.get(key)

@@ -119,3 +119,95 @@ def test_runtime_start_stop_is_clean_and_repeatable():
         assert not rt.healthy()  # stopped runtimes report unhealthy
         assert all(not t.is_alive() for t in rt._threads)
         LeaderElector._leader = None
+
+
+class TestBattletestTiers:
+    """Deeper battletest analogs: full-lifecycle churn (create AND delete
+    nodes via consolidation/termination pressure), the HTTP backend under
+    the same churn, and a deflake-style repetition with rotating seeds
+    (Makefile:36-48 runs the suite 5x; here every run randomizes writer
+    interleavings from the seed)."""
+
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_lifecycle_churn_with_deprovisioning(self, seed):
+        options = Options(batch_max_duration=0.2, batch_idle_duration=0.05, leader_elect=False, dense_solver_enabled=False)
+        kube = KubeCluster()
+        rt = Runtime(kube=kube, cloud_provider=FakeCloudProvider(instance_types(6)), options=options)
+        try:
+            kube.create(make_provisioner(consolidation_enabled=True))
+            rt.start()
+            rng = random.Random(seed)
+            pods = []
+            for i in range(40):
+                pod = make_pod(name=f"life-{seed}-{i}", requests={"cpu": rng.choice([0.25, 0.5])})
+                kube.create(pod)
+                pods.append(pod)
+                if rng.random() < 0.3:
+                    time.sleep(rng.uniform(0, 0.003))
+            # let provisioning land, then delete most pods so emptiness +
+            # consolidation + termination all get real work mid-churn
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not kube.list_nodes():
+                time.sleep(0.05)
+            assert kube.list_nodes(), "no nodes provisioned under churn"
+            for pod in pods[: len(pods) * 3 // 4]:
+                kube.delete(pod, grace=False)
+                if rng.random() < 0.2:
+                    time.sleep(rng.uniform(0, 0.002))
+            # drive lifecycle synchronously until quiescent: nodes for the
+            # deleted pods are reaped, survivors keep capacity
+            for _ in range(40):
+                rt.reconcile_once()
+                time.sleep(0.05)
+            assert rt.healthy()
+            assert all(t.is_alive() for t in rt._threads)
+        finally:
+            rt.stop()
+
+    def test_churn_over_http_backend(self):
+        """The same writer churn with every verb crossing real sockets."""
+        from karpenter_tpu.kube.apiserver import APIServer
+        from karpenter_tpu.kube.client import HttpKubeClient
+        from karpenter_tpu.utils.clock import Clock
+
+        srv = APIServer().start()
+        kube = HttpKubeClient(srv.url, clock=Clock())
+        options = Options(batch_max_duration=0.3, batch_idle_duration=0.05, leader_elect=True, dense_solver_enabled=False)
+        rt = Runtime(kube=kube, cloud_provider=FakeCloudProvider(instance_types(6)), options=options)
+        driver = HttpKubeClient(srv.url)
+        errors: list = []
+        try:
+            driver.create(make_provisioner())
+            rt.start()
+            assert rt.elector.wait_for_leadership(timeout=15)
+
+            def writer(wid: int):
+                rng = random.Random(wid)
+                try:
+                    for i in range(10):
+                        pod = make_pod(name=f"http-churn-{wid}-{i}", requests={"cpu": rng.choice([0.25, 0.5])})
+                        driver.create(pod)
+                        time.sleep(rng.uniform(0, 0.003))
+                        if rng.random() < 0.2:
+                            driver.delete(pod, grace=False)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if driver.list_nodes() and not driver.pending_pods():
+                    break
+                rt.provision_once()
+                time.sleep(0.1)
+            assert driver.list_nodes(), "no nodes over the HTTP backend"
+            assert rt.healthy()
+        finally:
+            rt.stop()
+            driver.stop()
+            srv.stop()

@@ -1,0 +1,98 @@
+"""The admission webhook deployment shape (cmd/webhook/main.go analog).
+
+Round 2 ran admission in-process only; this tier runs it the way the
+reference deploys it: a separate HTTPS server speaking the AdmissionReview
+protocol with self-managed serving certs, dispatched by the apiserver on
+every matching write with the CA bundle verifying the TLS handshake.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api.objects import NodeSelectorRequirement, OP_IN
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.kube.apiserver import APIServer
+from karpenter_tpu.kube.client import ApiStatusError, HttpKubeClient
+from karpenter_tpu.kube.webhookserver import AdmissionWebhookServer, apply_json_patch, json_patch
+from tests.helpers import make_provisioner
+
+
+@pytest.fixture()
+def stack():
+    webhook = AdmissionWebhookServer(cloud_provider=FakeCloudProvider()).start()
+    api = APIServer().start()
+    api.state.register_webhooks(
+        kinds={"Provisioner"},
+        mutate_url=webhook.url + "/mutate",
+        validate_url=webhook.url + "/validate",
+        ca_pem=webhook.cert.ca_pem,
+    )
+    client = HttpKubeClient(api.url)
+    yield client
+    client.stop()
+    api.stop()
+    webhook.stop()
+
+
+class TestJsonPatch:
+    def test_diff_and_apply_round_trip(self):
+        before = {"a": 1, "b": {"c": 2, "drop": 3}, "keep": "x"}
+        after = {"a": 5, "b": {"c": 2, "new": 7}, "keep": "x", "added": [1, 2]}
+        ops = json_patch(before, after)
+        assert apply_json_patch(before, ops) == after
+
+    def test_escaped_keys(self):
+        before = {"karpenter.sh/foo": 1}
+        after = {"karpenter.sh/foo": 2, "a~b": 3}
+        ops = json_patch(before, after)
+        assert apply_json_patch(before, ops) == after
+
+
+class TestWebhookOverTls:
+    def test_invalid_provisioner_rejected_with_message(self, stack):
+        bad = make_provisioner(requirements=[NodeSelectorRequirement("team", OP_IN, [])])
+        with pytest.raises(ApiStatusError) as err:
+            stack.create(bad)
+        assert err.value.code == 422
+        assert "team" in str(err.value)
+
+    def test_valid_provisioner_admitted_and_defaulted(self, stack):
+        prov = make_provisioner()
+        prov.spec.weight = None  # the defaulting webhook must fill this
+        from karpenter_tpu.api.objects import Taint
+
+        prov.spec.taints.append(Taint(key="dedicated", value="x", effect=""))
+        stack.create(prov)
+        stored = stack.get("Provisioner", prov.name, "")
+        assert stored.spec.weight == 0  # defaulting patch applied server-side
+        assert stored.spec.taints[0].effect == "NoSchedule"
+
+    def test_update_also_runs_admission(self, stack):
+        prov = make_provisioner()
+        stack.create(prov)
+        stored = stack.get("Provisioner", prov.name, "")
+        stored.spec.requirements = [NodeSelectorRequirement("team", OP_IN, [])]
+        with pytest.raises(ApiStatusError) as err:
+            stack.update(stored)
+        assert err.value.code == 422
+
+    def test_tls_verification_is_real(self, stack):
+        # a registration carrying the WRONG CA must fail the handshake and
+        # surface as an admission dispatch error, not silently pass
+        from karpenter_tpu.kube.certs import generate_serving_cert
+
+        webhook2 = AdmissionWebhookServer(cloud_provider=FakeCloudProvider()).start()
+        api2 = APIServer().start()
+        wrong_ca = generate_serving_cert().ca_pem
+        api2.state.register_webhooks(
+            kinds={"Provisioner"}, mutate_url=webhook2.url + "/mutate", validate_url=None, ca_pem=wrong_ca
+        )
+        client2 = HttpKubeClient(api2.url)
+        try:
+            with pytest.raises(Exception):
+                client2.create(make_provisioner())
+        finally:
+            client2.stop()
+            api2.stop()
+            webhook2.stop()
