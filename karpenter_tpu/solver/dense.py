@@ -247,7 +247,7 @@ class DenseSolver:
             self.stats.pods_to_host += len(leftover)
             return leftover
 
-        buckets = self._build_buckets(problem, scheduler.topology)
+        buckets = self._build_buckets(problem, scheduler.topology, scheduler)
         t_encoded = time.perf_counter()
         existing_committed = 0
         taken = None
@@ -278,7 +278,7 @@ class DenseSolver:
 
     # -- step 2: domain assignment / bucket construction ---------------------
 
-    def _build_buckets(self, problem: DenseProblem, topology) -> List[_Bucket]:
+    def _build_buckets(self, problem: DenseProblem, topology, scheduler=None) -> List[_Bucket]:
         buckets: List[_Bucket] = []
         rows_by_group: Dict[int, List[int]] = {}
         for row, gid in enumerate(problem.group_ids):
@@ -319,7 +319,7 @@ class DenseSolver:
                     else:
                         buckets.append(_Bucket(group_index=g, single_bin=True, pod_rows=rows))
                 else:
-                    zone = self._pick_affinity_zone(problem, topology, group)
+                    zone = self._pick_affinity_zone(problem, topology, group, scheduler)
                     if zone is None:
                         # no viable zone: host loop will produce the error
                         buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
@@ -445,14 +445,47 @@ class DenseSolver:
             buckets.append(_Bucket(group_index=group.index, pod_rows=rows[cursor:], zone="__infeasible__"))
         return buckets
 
-    def _pick_affinity_zone(self, problem, topology, group) -> Optional[str]:
+    def _pick_affinity_zone(self, problem, topology, group, scheduler=None) -> Optional[str]:
         g = group.index
         allowed = [z for i, z in enumerate(problem.zones) if problem.group_zone_allowed[g][i]]
         if not allowed:
             return None
         counts = self._existing_counts(topology, group, lbl.LABEL_TOPOLOGY_ZONE, allowed)
         populated = [z for z, c in zip(allowed, counts) if c > 0]
-        return populated[0] if populated else allowed[0]
+        if populated:
+            return populated[0]
+        # bootstrap choice: prefer the allowed zone holding the most free
+        # warm capacity, so the cohort fills existing nodes instead of
+        # opening fresh bins in an arbitrarily-pinned empty zone (the host
+        # loop gets this for free by trying existing nodes first)
+        if scheduler is not None and scheduler.existing_nodes:
+            # score zones by how much of the cohort's OWN request mix the
+            # accepting views there could absorb — cpu-only ranking would
+            # pin accelerator cohorts to zones with no usable accelerator
+            rows = [i for i, gid in enumerate(problem.group_ids) if int(gid) == g]
+            total = problem.requests[rows].sum(axis=0) if rows else None
+            score_by_zone: Dict[str, float] = {}
+            for view in scheduler.existing_nodes:
+                zone = view.node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE)
+                if zone not in allowed or total is None:
+                    continue
+                if not self._view_accepts(group, view):
+                    continue
+                avail = resource_vector(view.available)
+                used = resource_vector(view.requests)
+                if avail is None or used is None:
+                    continue
+                free = np.maximum(avail - used, 0.0)
+                positive = total > 1e-12
+                if not positive.any():
+                    continue
+                frac = float(np.minimum(free[positive] / total[positive], 1.0).min())
+                score_by_zone[zone] = score_by_zone.get(zone, 0.0) + frac
+            if score_by_zone:
+                best = max(score_by_zone.items(), key=lambda kv: kv[1])
+                if best[1] > 0:
+                    return best[0]
+        return allowed[0]
 
     # -- step 2.5: fill existing/in-flight node capacity ----------------------
 
@@ -914,7 +947,8 @@ class DenseSolver:
 
         # speculative assembly + audit + full commit preparation (node
         # construction), still under the in-flight round trip
-        sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
+        reroute = bool(scheduler.existing_nodes)
+        sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff, reroute_fragments=reroute)
         prep = self._prepare_commit(scheduler, problem, buckets, sol, taken)
 
         try:
@@ -960,7 +994,7 @@ class DenseSolver:
                 local[b] = (rows, reqs, pack)
                 changed = True
         if changed:  # genuine disagreement: re-run assembly + preparation
-            sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff)
+            sol = self._assemble(problem, buckets, local, bucket_extra, caps_eff, reroute_fragments=reroute)
             prep = self._prepare_commit(scheduler, problem, buckets, sol, taken)
         return prep
 
@@ -996,19 +1030,45 @@ class DenseSolver:
             place(mesh, allowed_p, P("pods", "types")),
         )
 
-    def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray, caps_eff: np.ndarray) -> dict:
+    _FRAGMENT_MAX_PODS = 3
+
+    def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray, caps_eff: np.ndarray, reroute_fragments: bool = False) -> dict:
         """Pure assembly + audit of the per-bucket packings: global bin ids,
         per-bin usage/rows, and surviving instance-type masks (same tolerance
         rule as resources.fits so audits can't disagree). Touches no scheduler
         state, so it runs speculatively under the device round trip and is
-        recomputed wholesale on (rare) reconciliation."""
+        recomputed wholesale on (rare) reconciliation.
+
+        reroute_fragments (warm clusters only): a MICRO-COHORT whose whole
+        pack is one bin of <=3 pods is handed to the exact host loop instead
+        of opening a near-empty fresh node — the host loop mixes such pods
+        onto existing capacity (or shares one node across cohorts), which
+        bucketed packing cannot. Deliberately narrow: only single-bin packs
+        (bin ordering and spill-donor assumptions stay intact), never
+        dedicated/single_bin semantics (one-pod bins ARE their contract),
+        never water-filled SPREAD buckets (their skew is correct only if the
+        whole per-domain assignment commits), and bounded by a per-solve
+        budget so a batch whose NATURAL pattern is tiny bins cannot stampede
+        into the O(pods x open-nodes) host loop."""
         bin_of_row = np.full((problem.P,), -1, np.int64)
         bin_bucket_list: List[int] = []
         next_bin = 0
+        reroute_budget = max(32, problem.P // 20) if reroute_fragments else 0
         for b, (rows, _reqs, pack) in enumerate(local):
             if pack is None:
                 continue  # all pods of this bucket fall back to the host loop
             ids_local, n_local = pack
+            if (
+                reroute_budget > 0
+                and n_local == 1
+                and len(rows) <= self._FRAGMENT_MAX_PODS
+                and not buckets[b].dedicated
+                and not buckets[b].single_bin
+                and problem.groups[buckets[b].group_index].kind != GroupKind.SPREAD
+            ):
+                reroute_budget -= len(rows)
+                ids_local = np.full_like(ids_local, -1)  # host loop owns them
+                n_local = 0
             bin_of_row[rows] = np.where(ids_local >= 0, ids_local + next_bin, -1)
             bin_bucket_list.extend([b] * n_local)
             next_bin += n_local
