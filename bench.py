@@ -383,21 +383,25 @@ def main() -> None:
     log("sweep 400 types x {1,50,100,500,1000,2000,5000} pods")
     sweep: dict = {}
     provider = FakeCloudProvider(instance_types(SWEEP_TYPES))
-    sweep_solver = DenseSolver(min_batch=1)
+    # production routing: tiny batches take the exact host loop (faster AND
+    # cheaper below the ~350-pod crossover measured in solver/dense.py),
+    # larger ones the dense device path — this is what a deployed Runtime does
+    sweep_solver = DenseSolver()
     provisioners = [make_provisioner()]
     for count in SWEEP_PODS:
         pods = build_workload(count, seed=13)
         run_once(pods, provider, provisioners, sweep_solver)  # warmup this shape
         trials = []
         for _ in range(3):
-            t, scheduled, nodes, _, _, _ = run_once(pods, provider, provisioners, sweep_solver)
+            t, scheduled, nodes, _, stats, _ = run_once(pods, provider, provisioners, sweep_solver)
             trials.append(t)
         elapsed = float(np.median(trials))
         pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
         sweep[str(count)] = round(pods_per_sec, 0)
+        path = "dense" if stats.pods_committed else "host"
         log(
             f"  [sweep] {count} pods: {elapsed*1000:.1f} ms, {pods_per_sec:,.0f} pods/sec,"
-            f" {nodes} nodes"
+            f" {nodes} nodes ({path})"
         )
 
     # --- cost regret vs exhaustive MILP ---

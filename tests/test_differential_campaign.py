@@ -34,6 +34,7 @@ from karpenter_tpu.api.objects import (
     ContainerPort,
     LabelSelector,
     PodAffinityTerm,
+    Taint,
     Toleration,
     TopologySpreadConstraint,
 )
@@ -59,11 +60,20 @@ def _random_workload(rng: np.random.Generator, count: int):
     mems = ["128Mi", "512Mi", "1Gi", "2Gi"]
     pods = []
     for i in range(count):
-        kind = rng.integers(0, 10)
+        kind = rng.integers(0, 11)
         size = {"cpu": cpus[rng.integers(len(cpus))], "memory": mems[rng.integers(len(mems))]}
         cohort = f"c{rng.integers(4)}"
         if kind < 4:  # plain
             pods.append(make_pod(labels={"app": cohort}, requests=size))
+        elif kind == 10:  # tolerates the dedicated provisioner's taint, so it
+            # may land on either template; untolerating pods must avoid it
+            pods.append(
+                make_pod(
+                    labels={"app": cohort},
+                    requests=size,
+                    tolerations=[Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")],
+                )
+            )
         elif kind < 6:  # zonal spread
             pods.append(
                 make_pod(
@@ -122,9 +132,18 @@ def _random_states(rng: np.random.Generator):
     return states
 
 
+def _provisioners():
+    # weight order: untainted default first, then a dedicated pool whose
+    # NoSchedule taint only kind-10 (tolerating) pods may land on
+    return [
+        make_provisioner(name="default", weight=10),
+        make_provisioner(name="dedicated", weight=1, taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")]),
+    ]
+
+
 def _solve(pods, states, provider, dense: bool):
     solver = DenseSolver(min_batch=1) if dense else None
-    scheduler = build_scheduler([make_provisioner()], provider, pods, state_nodes=states, dense_solver=solver)
+    scheduler = build_scheduler(_provisioners(), provider, pods, state_nodes=states, dense_solver=solver)
     return scheduler.solve(pods), solver
 
 
@@ -184,6 +203,16 @@ def _assert_invariants(results, pods):
             counts[zone] += 1
         if not incomplete and sum(counts.values()):
             assert max(counts.values()) - min(counts.values()) <= info["max_skew"], (label, counts)
+
+    # taint safety: only tolerating pods land on the dedicated pool
+    for node in results.new_nodes:
+        prov = node.requirements.get(PROVISIONER_NAME_LABEL)
+        if prov is None or "dedicated" not in prov.values:
+            continue
+        for pod in node.pods:
+            assert any(t.key == "dedicated" for t in pod.spec.tolerations), (
+                f"{pod.name} lacks the dedicated toleration but sits on a dedicated-pool node"
+            )
 
     # hostname anti-affinity: distinct hosts per cohort
     anti_groups = {}
