@@ -166,7 +166,7 @@ class ProvisionerController:
     def schedule(self, pods: Sequence[Pod], state_nodes: Sequence[object], opts: Optional[SchedulerOptions] = None) -> SchedulingResults:
         provisioners = [p for p in self.kube.list_provisioners()]
         cloud_provider = self.cloud_provider
-        if self.remote_solver is not None:
+        if self.remote_solver is not None and len(pods) >= self._remote_min_batch():
             from ...service.client import RemoteSchedulingError
 
             instance_types = {p.name: cloud_provider.get_instance_types(p) for p in provisioners}
@@ -203,6 +203,15 @@ class ProvisionerController:
             dense_solver=self.dense_solver,
         )
         return scheduler.solve(pods)
+
+    def _remote_min_batch(self) -> int:
+        """Below the host/device crossover the wire trip plus the sidecar's
+        device solve loses to the local exact loop on both latency and node
+        cost (the measurements on DenseSolver.__init__) — route small batches
+        locally even when a sidecar is configured."""
+        from ...solver.dense import MIN_BATCH_DEFAULT
+
+        return self.dense_solver.min_batch if self.dense_solver is not None else MIN_BATCH_DEFAULT
 
     def daemonset_pods(self) -> List[Pod]:
         """Pod templates of every DaemonSet, for per-template overhead."""

@@ -77,6 +77,15 @@ class StateNode:
         return out
 
 
+def _pod_key(pod: Pod) -> str:
+    """Namespaced name, the reference's binding key (cluster.go:129,266).
+    Keying by name (not uid) makes a same-name recreate displace the stale
+    entry, so usage never leaks when the old pod's delete event was missed
+    or consolidated away (state suite: 'track pods correctly if we miss
+    events or they are consolidated')."""
+    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+
 class Cluster:
     def __init__(self, kube: KubeCluster, cloud_provider: Optional[CloudProvider] = None, clock=None, nomination_ttl: float = 20.0):
         from ...utils.clock import Clock
@@ -87,8 +96,8 @@ class Cluster:
         self.nomination_ttl = nomination_ttl
         self._lock = threading.RLock()
         self._nodes: Dict[str, StateNode] = {}
-        self._bindings: Dict[str, str] = {}  # pod uid -> node name
-        self._pods: Dict[str, Pod] = {}  # pod uid -> pod (bound pods)
+        self._bindings: Dict[str, str] = {}  # pod key -> node name
+        self._pods: Dict[str, Pod] = {}  # pod key -> pod (bound pods)
         self._anti_affinity_pods: Dict[str, Pod] = {}
         self._nominated: Dict[str, float] = {}  # node name -> expiry
         self._consolidation_epoch = 0
@@ -116,9 +125,9 @@ class Cluster:
         self._populate_volume_limits(state)
         state.marked_for_deletion = node.metadata.deletion_timestamp is not None
         # re-apply pod bindings we know about
-        for uid, node_name in self._bindings.items():
-            if node_name == node.name and uid in self._pods:
-                self._apply_pod(state, self._pods[uid])
+        for key, node_name in self._bindings.items():
+            if node_name == node.name and key in self._pods:
+                self._apply_pod(state, self._pods[key])
         if existing is None:
             self._last_node_creation = self.clock.now()
         self._nodes[node.name] = state
@@ -152,29 +161,42 @@ class Cluster:
             self._update_pod(pod)
 
     def _update_pod(self, pod: Pod) -> None:
-        old_node = self._bindings.get(pod.uid)
+        key = _pod_key(pod)
+        old_node = self._bindings.get(key)
         new_node = pod.spec.node_name or None
-        if old_node and old_node != new_node:
+        stored = self._pods.get(key)
+        if old_node and (old_node != new_node or (stored is not None and stored.uid != pod.uid)):
+            # rebound, or recreated under the same name (uid changed — even on
+            # the SAME node): release the old incarnation's accounting and
+            # uid-keyed port/volume reservations before applying the new one
             self._remove_pod(pod)
         if new_node is None:
             if podutils.has_required_pod_anti_affinity(pod):
                 # pending anti-affinity pods matter once bound; track pod only
                 pass
             return
-        self._bindings[pod.uid] = new_node
-        self._pods[pod.uid] = pod
+        self._bindings[key] = new_node
+        self._pods[key] = pod
         if podutils.has_required_pod_anti_affinity(pod):
-            self._anti_affinity_pods[pod.uid] = pod
+            self._anti_affinity_pods[key] = pod
         state = self._nodes.get(new_node)
-        if state is not None and pod.uid not in state.pod_requests:
+        if state is None:
+            # bound to a node we haven't seen: pull it from the API now —
+            # creating the state entry replays this binding too — instead of
+            # waiting on a node event that may never come (cluster.go:448-464)
+            node = self.kube.get_node(new_node)
+            if node is not None:
+                self._update_node(node)
+        elif key not in state.pod_requests:
             self._apply_pod(state, pod)
         self._bump_epoch()
 
     def _apply_pod(self, state: StateNode, pod: Pod) -> None:
+        key = _pod_key(pod)
         requests = res.pod_requests(pod)
         limits = res.pod_limits(pod)
-        state.pod_requests[pod.uid] = requests
-        state.pod_limits[pod.uid] = limits
+        state.pod_requests[key] = requests
+        state.pod_limits[key] = limits
         state.available = res.subtract(state.available, requests)
         if podutils.is_owned_by_daemonset(pod):
             state.daemonset_requested = res.merge(state.daemonset_requested, requests)
@@ -183,22 +205,26 @@ class Cluster:
         state.volume_usage.add(pod)
 
     def _remove_pod(self, pod: Pod) -> None:
-        node_name = self._bindings.pop(pod.uid, None)
-        self._pods.pop(pod.uid, None)
-        self._anti_affinity_pods.pop(pod.uid, None)
+        key = _pod_key(pod)
+        node_name = self._bindings.pop(key, None)
+        # release the STORED pod's usage: on a same-name recreate the caller's
+        # pod is the new incarnation, but the accounting (and the uid the
+        # port/volume trackers keyed on) belongs to the old one
+        stored = self._pods.pop(key, pod)
+        self._anti_affinity_pods.pop(key, None)
         if node_name is None:
             return
         state = self._nodes.get(node_name)
         if state is not None:
-            requests = state.pod_requests.pop(pod.uid, None)
-            limits = state.pod_limits.pop(pod.uid, None)
+            requests = state.pod_requests.pop(key, None)
+            limits = state.pod_limits.pop(key, None)
             if requests is not None:
                 state.available = res.merge(state.available, requests)
-                if podutils.is_owned_by_daemonset(pod):
+                if podutils.is_owned_by_daemonset(stored):
                     state.daemonset_requested = res.subtract(state.daemonset_requested, requests)
                     state.daemonset_limits = res.subtract(state.daemonset_limits, limits or {})
-            state.host_port_usage.delete_pod(pod.uid)
-            state.volume_usage.delete_pod(pod.uid)
+            state.host_port_usage.delete_pod(stored.uid)
+            state.volume_usage.delete_pod(stored.uid)
         self._bump_epoch()
 
     # -- read interface --------------------------------------------------------
@@ -223,11 +249,18 @@ class Cluster:
             return [self._pods[uid] for uid, node in self._bindings.items() if node == name and uid in self._pods]
 
     def for_pods_with_anti_affinity(self, fn: Callable[[Pod, Optional[Node]], bool]) -> None:
+        """Visits each bound pod carrying a required anti-affinity term. Pods
+        whose node left the cache are skipped — the node-deletion event can
+        arrive before the pod's (cluster.go:124-139)."""
         with self._lock:
             pods = list(self._anti_affinity_pods.values())
         for pod in pods:
-            node = self.kube.get_node(pod.spec.node_name)
-            if not fn(pod, node):
+            with self._lock:
+                node_name = self._bindings.get(_pod_key(pod))
+                state = self._nodes.get(node_name) if node_name else None
+            if state is None:
+                continue
+            if not fn(pod, state.node):
                 return
 
     # -- nominations ------------------------------------------------------------
@@ -273,6 +306,6 @@ class Cluster:
             if node.name not in known_nodes:
                 return False
         for pod in self.kube.list_pods():
-            if pod.spec.node_name and not podutils.is_terminal(pod) and pod.uid not in known_pods:
+            if pod.spec.node_name and not podutils.is_terminal(pod) and _pod_key(pod) not in known_pods:
                 return False
         return True

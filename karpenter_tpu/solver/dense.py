@@ -61,6 +61,11 @@ from ..utils import resources as res
 
 _PAD = 128  # pad the pod axis to multiples of this for compile caching
 
+# The host-loop/device crossover (see the note on DenseSolver.__init__).
+# Shared by every routing site: the in-process solver default and the
+# provisioner's remote-sidecar gate.
+MIN_BATCH_DEFAULT = 320
+
 
 def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):
     """Host preview of ops/feasibility.py:bucket_type_cost — same formula,
@@ -124,7 +129,7 @@ class DenseSolver:
     # axes from ~400-500 up (2000: host 531ms/$589.5 vs dense 124ms/$539.2).
     # The fixed dense cost is device dispatch + encode, not compute, so the
     # crossover is stable across catalog sizes.
-    def __init__(self, min_batch: int = 320, num_slots: int = 8, mesh=None, peer_fabric=None):
+    def __init__(self, min_batch: int = MIN_BATCH_DEFAULT, num_slots: int = 8, mesh=None, peer_fabric=None):
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()

@@ -142,7 +142,9 @@ class TestRuntimeIntegration:
         rt = Runtime(
             kube=kube,
             cloud_provider=FakeCloudProvider(instance_types(8)),
-            options=Options(solver_service_address=f"127.0.0.1:{port}"),
+            # dense_min_batch=1 opens the sub-crossover remote gate so this
+            # 5-pod batch still exercises the sidecar path
+            options=Options(solver_service_address=f"127.0.0.1:{port}", dense_min_batch=1),
         )
         try:
             kube.create(make_provisioner())
@@ -152,6 +154,31 @@ class TestRuntimeIntegration:
             assert sum(len(n.pods) for n in results.new_nodes) == 5
             assert kube.list_nodes(), "nodes launched from the remote plan"
             assert handler.solves >= 1
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+            server.stop(grace=0.5)
+
+    def test_sub_crossover_batches_stay_local_despite_sidecar(self):
+        # below the host/device crossover the wire trip loses on latency AND
+        # node cost, so a configured sidecar must not see tiny batches
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        server, port, handler = serve("127.0.0.1:0")
+        kube = KubeCluster()
+        rt = Runtime(
+            kube=kube,
+            cloud_provider=FakeCloudProvider(instance_types(8)),
+            options=Options(solver_service_address=f"127.0.0.1:{port}"),  # default crossover gate
+        )
+        try:
+            kube.create(make_provisioner())
+            for _ in range(5):
+                kube.create(make_pod(requests={"cpu": 0.5}))
+            results = rt.provision_once()
+            assert sum(len(n.pods) for n in results.new_nodes) == 5
+            assert handler.solves == 0, "5-pod batch must be solved locally"
         finally:
             rt.stop()
             LeaderElector._leader = None
