@@ -19,10 +19,13 @@ from ...cloudprovider.types import CloudProvider
 from ...events import Recorder
 from ...logsetup import get_logger
 from ...kube.cluster import KubeCluster
+from ...scheduling.taints import Taints
 from ...utils import pod as podutils
 from .eviction import EvictionQueue
 
 log = get_logger("termination")
+
+_UNSCHEDULABLE = Taints([Taint(key=lbl.TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE)])
 
 
 class TerminationController:
@@ -72,41 +75,74 @@ class TerminationController:
         self.kube.update(node)
 
     def drain(self, node: Node) -> bool:
-        """Queue evictable pods; True once the node is fully drained."""
-        pods = self.kube.pods_on_node(node.name)
-        evictable = []
-        critical = []
-        for pod in pods:
-            if podutils.is_owned_by_node(pod) or podutils.is_owned_by_daemonset(pod):
-                continue  # daemonsets/static pods don't block termination
-            if podutils.is_terminal(pod):
-                continue
-            if podutils.is_terminating(pod):
-                # already being deleted; wait, but don't re-evict
-                evictable.append(None)
-                continue
+        """Queue evictable pods; True once nothing on the node blocks
+        deletion. Guard set and order mirror terminate.go:74-102,126-145:
+        terminal and stuck-terminating pods are invisible; an ownerless or
+        do-not-evict pod blocks the whole drain; pods tolerating the
+        unschedulable taint and static (node-owned) pods neither block nor
+        get evicted."""
+        to_evict = []
+        for pod in self._drain_relevant_pods(node):
+            # inability-to-evict guards come BEFORE the skip filters, so a
+            # do-not-evict static pod still blocks (suite_test.go:217)
+            if not pod.metadata.owner_references:
+                self.recorder.node_failed_to_drain(node, f"pod {pod.name} does not have any owner references")
+                return False
             if podutils.has_do_not_evict(pod):
                 self.recorder.node_failed_to_drain(node, f"pod {pod.name} has do-not-evict")
                 return False
+            if not self._obstructs_deletion(pod):
+                continue
+            to_evict.append(pod)
+        self._enqueue_for_eviction(to_evict)
+        self.eviction_queue.drain_once()
+        # The reference returns done=len(podsToEvict)==0 and reaches the
+        # fixed point on the next reconcile once the async queue empties the
+        # node; the in-memory eviction is synchronous, so recheck now — the
+        # same fixed point, one pass sooner.
+        return not any(self._obstructs_deletion(p) for p in self._drain_relevant_pods(node))
+
+    def _drain_relevant_pods(self, node: Node) -> List:
+        """Pods that matter to a drain: not terminal, not stuck terminating
+        past the 1-minute kubelet-partition window (terminate.go:126-145,166-171)."""
+        return [
+            p
+            for p in self.kube.pods_on_node(node.name)
+            if not podutils.is_terminal(p) and not self._is_stuck_terminating(p)
+        ]
+
+    def _is_stuck_terminating(self, pod) -> bool:
+        ts = pod.metadata.deletion_timestamp
+        return ts is not None and self.clock.now() > ts + 60.0
+
+    @staticmethod
+    def _obstructs_deletion(pod) -> bool:
+        """True when the pod keeps the node alive: not tolerating the
+        unschedulable taint (it would reschedule right back, terminate.go:90-93)
+        and not a static mirror / daemonset pod."""
+        if _UNSCHEDULABLE.tolerates(pod) is None:
+            return False
+        return not (podutils.is_owned_by_node(pod) or podutils.is_owned_by_daemonset(pod))
+
+    def _enqueue_for_eviction(self, pods: List) -> None:
+        """Non-critical pods go first; critical (system) pods enqueue only
+        once no non-critical pod is still RUNNING — a non-critical pod
+        already mid-termination no longer delays them, exactly the reference's
+        evict() (terminate.go:147-164: terminating pods are skipped before
+        the critical/non-critical split)."""
+        critical = []
+        non_critical = []
+        for pod in pods:
+            if podutils.is_terminating(pod):
+                continue
             if self._is_critical(pod):
                 critical.append(pod)
             else:
-                evictable.append(pod)
-        # evict regular pods first; critical (system) pods only once every
-        # regular pod is gone — including ones still terminating
-        # (terminate.go:138-159)
-        regular = [p for p in evictable if p is not None]
-        if regular:
-            self.eviction_queue.add(*regular)
-        elif critical and not evictable:
+                non_critical.append(pod)
+        if non_critical:
+            self.eviction_queue.add(*non_critical)
+        elif critical:
             self.eviction_queue.add(*critical)
-        self.eviction_queue.drain_once()
-        remaining = [
-            p
-            for p in self.kube.pods_on_node(node.name)
-            if not (podutils.is_owned_by_node(p) or podutils.is_owned_by_daemonset(p) or podutils.is_terminal(p))
-        ]
-        return not remaining
 
     @staticmethod
     def _is_critical(pod) -> bool:

@@ -61,10 +61,9 @@ from ..utils import resources as res
 
 _PAD = 128  # pad the pod axis to multiples of this for compile caching
 
-# The host-loop/device crossover (see the note on DenseSolver.__init__).
-# Shared by every routing site: the in-process solver default and the
-# provisioner's remote-sidecar gate.
-MIN_BATCH_DEFAULT = 320
+# The host-loop/device crossover (see the note on DenseSolver.__init__),
+# canonical in utils/options.py so every routing site shares one number.
+from ..utils.options import DENSE_MIN_BATCH_DEFAULT as MIN_BATCH_DEFAULT  # noqa: E402
 
 
 def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):

@@ -11,6 +11,12 @@ import os
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+# The host-loop/device crossover, canonical for every routing site: the
+# Options default below, DenseSolver's own default, and the provisioner's
+# remote-sidecar gate all read this one constant. The measurement behind the
+# number lives on DenseSolver.__init__ (solver/dense.py).
+DENSE_MIN_BATCH_DEFAULT = 320
+
 
 @dataclass
 class Options:
@@ -25,7 +31,7 @@ class Options:
     dense_solver_enabled: bool = True
     # below this batch size the exact host loop is faster and cheaper than a
     # device dispatch (measured crossover ~350 pods; see solver/dense.py)
-    dense_min_batch: int = 320
+    dense_min_batch: int = DENSE_MIN_BATCH_DEFAULT
     cluster_name: str = ""
     log_level: str = "info"
     solver_service_address: str = ""  # host:port of the gRPC solver sidecar (empty = in-process)

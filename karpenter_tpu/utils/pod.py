@@ -58,8 +58,10 @@ def _owned_by(pod: Pod, kind: str) -> bool:
 
 
 def is_reschedulable(pod: Pod) -> bool:
-    """Counts toward node emptiness / needs rescheduling on disruption."""
-    return not is_owned_by_daemonset(pod) and not is_terminal(pod)
+    """Counts toward node emptiness / needs rescheduling on disruption.
+    Daemonset pods, static (node-owned) mirror pods, and terminal pods do
+    not (emptiness.go:105-110)."""
+    return not is_owned_by_daemonset(pod) and not is_owned_by_node(pod) and not is_terminal(pod)
 
 
 def is_node_empty(pods) -> bool:

@@ -101,7 +101,8 @@ class TestNodeLifecycle:
 
     def test_expiration_ttl_deletes(self):
         env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
-        env.launch_node_with_pods(make_pod())
+        # owned: an ownerless pod would (correctly) block the drain
+        env.launch_node_with_pods(owned_pod())
         env.clock.step(3601)
         env.node_controller.reconcile_all()
         env.termination_controller.reconcile_all()

@@ -1,0 +1,169 @@
+"""Node lifecycle suite: the pkg/controllers/node/suite_test.go port.
+
+Scenario-for-scenario port of the reference's Expiration / Emptiness /
+Finalizer blocks (:80-300) against the NodeController, driving bare node
+objects through reconcile the way the reference drives envtest objects.
+The initialization block's depth (startup taints, extended resources) is
+covered in test_deprovisioning.py.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as lbl
+from tests.helpers import make_node, make_pod, make_provisioner
+from tests.test_deprovisioning import DeprovEnv, owned_pod
+
+OWNED = {lbl.PROVISIONER_NAME_LABEL: "default"}
+
+
+def initialized_labels():
+    return {**OWNED, lbl.LABEL_NODE_INITIALIZED: "true"}
+
+
+class TestExpiration:
+    def test_ignores_nodes_without_ttl(self):
+        env = DeprovEnv()  # default provisioner: no ttlSecondsUntilExpired
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        env.kube.create(node)
+        env.clock.step(10**6)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is None
+
+    def test_ignores_nodes_without_provisioner(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=30)])
+        node = make_node(allocatable={"cpu": 4})  # no provisioner label
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        env.kube.create(node)
+        env.clock.step(10**6)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is None
+
+    def test_deletes_nodes_after_expiry(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=30)])
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        node.metadata.creation_timestamp = env.clock.now()
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is None
+
+        env.clock.step(30)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is not None
+
+
+class TestEmptiness:
+    def test_does_not_ttl_uninitialized_nodes(self):
+        # ready-unknown / ready-false nodes never initialize, so emptiness
+        # does not apply (emptiness.go:52-55)
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=OWNED, allocatable={"cpu": 4}, ready=False)
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in env.kube.get_node(node.name).metadata.annotations
+
+    def test_labels_empty_nodes_with_ttl(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in env.kube.get_node(node.name).metadata.annotations
+
+    def test_removes_ttl_from_non_empty_nodes(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        node.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION] = str(env.clock.now())
+        env.kube.create(node)
+        env.kube.create(owned_pod(node_name=node.name, unschedulable=False))
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in env.kube.get_node(node.name).metadata.annotations
+
+    def test_deletes_empty_nodes_past_ttl(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        node.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION] = str(env.clock.now() - 100)
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is not None
+
+    def test_does_not_delete_empty_node_before_ttl(self):
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        node.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION] = str(env.clock.now() - 10)
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is None
+
+    def test_nominated_node_not_stamped(self):
+        # in-use per the last scheduling round (emptiness.go:63-66)
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        env.kube.create(node)
+        env.cluster.nominate_node_for_pod(node.name)
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION not in env.kube.get_node(node.name).metadata.annotations
+
+    def test_daemonset_and_static_pods_do_not_make_node_nonempty(self):
+        from karpenter_tpu.api.objects import OwnerReference
+
+        env = DeprovEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        node = make_node(labels=initialized_labels(), allocatable={"cpu": 4})
+        env.kube.create(node)
+        ds_pod = make_pod(node_name=node.name, unschedulable=False)
+        ds_pod.metadata.owner_references.append(OwnerReference(kind="DaemonSet", name="ds"))
+        mirror = make_pod(node_name=node.name, unschedulable=False)
+        mirror.metadata.owner_references.append(OwnerReference(kind="Node", name=node.name))
+        terminal = make_pod(node_name=node.name, unschedulable=False, phase="Succeeded")
+        for p in (ds_pod, mirror, terminal):
+            env.kube.create(p)
+        env.node_controller.reconcile_all()
+        assert lbl.EMPTINESS_TIMESTAMP_ANNOTATION in env.kube.get_node(node.name).metadata.annotations
+
+
+class TestFinalizer:
+    def test_adds_termination_finalizer_if_missing(self):
+        env = DeprovEnv()
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        node.metadata.finalizers.append("fake.com/finalizer")
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        finalizers = env.kube.get_node(node.name).metadata.finalizers
+        assert sorted(finalizers) == sorted(["fake.com/finalizer", lbl.TERMINATION_FINALIZER])
+
+    def test_does_nothing_if_terminating(self):
+        env = DeprovEnv()
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        node.metadata.finalizers.append("fake.com/finalizer")
+        env.kube.create(node)
+        env.kube.delete(node)  # graceful: deletion timestamp set, object held
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.finalizers == ["fake.com/finalizer"]
+
+    def test_idempotent_when_finalizer_exists(self):
+        env = DeprovEnv()
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        node.metadata.finalizers.extend([lbl.TERMINATION_FINALIZER, "fake.com/finalizer"])
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(node.name).metadata.finalizers == [lbl.TERMINATION_FINALIZER, "fake.com/finalizer"]
+
+    def test_does_nothing_if_not_owned_by_provisioner(self):
+        env = DeprovEnv()
+        node = make_node(allocatable={"cpu": 4})
+        node.metadata.finalizers.append("fake.com/finalizer")
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        updated = env.kube.get_node(node.name)
+        assert updated.metadata.finalizers == ["fake.com/finalizer"]
+        assert updated.metadata.owner_references == []
+
+    def test_adds_provisioner_owner_reference(self):
+        env = DeprovEnv()
+        node = make_node(labels=OWNED, allocatable={"cpu": 4})
+        env.kube.create(node)
+        env.node_controller.reconcile_all()
+        refs = env.kube.get_node(node.name).metadata.owner_references
+        assert [(r.kind, r.name) for r in refs] == [("Provisioner", "default")]

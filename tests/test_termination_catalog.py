@@ -1,0 +1,199 @@
+"""Termination suite: the pkg/controllers/termination/suite_test.go port.
+
+Scenario-for-scenario port of the reference's Reconciliation block (:96-530)
+against the TerminationController + EvictionQueue. The base lifecycle
+scenarios (cordon/drain/delete, do-not-evict, PDB, daemonset) live in
+test_deprovisioning.py; this catalog covers the full guard matrix —
+unschedulable-taint toleration, static pods, ownerless pods, terminal pods,
+eviction priority ordering, multi-pod drains, and the stuck-terminating
+grace window.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference, Toleration
+from tests.helpers import make_pod
+from tests.test_deprovisioning import DeprovEnv, owned_pod
+
+
+def env_with_node():
+    env = DeprovEnv()
+    nodes = env.launch_node_with_pods(owned_pod(requests={"cpu": 0.5}))
+    # the bootstrap pod is not part of any scenario: remove it
+    for pod in env.kube.list_pods():
+        env.kube.delete(pod, grace=False)
+    return env, nodes[0]
+
+
+def delete_node(env, node):
+    env.kube.delete(node)
+    return env.kube.get_node(node.name)
+
+
+def draining(env, name: str):
+    node = env.kube.get_node(name)
+    assert node is not None, f"node {name} is gone"
+    assert node.spec.unschedulable, "draining node must be cordoned"
+    assert lbl.TERMINATION_FINALIZER in node.metadata.finalizers
+    assert node.metadata.deletion_timestamp is not None
+    return node
+
+
+def unschedulable_toleration():
+    return Toleration(key=lbl.TAINT_NODE_UNSCHEDULABLE, operator="Exists", effect="NoSchedule")
+
+
+class TestTerminationCatalog:
+    def test_deletes_nodes(self):
+        env, node = env_with_node()
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+
+    def test_does_not_evict_pods_tolerating_unschedulable_taint(self):
+        # the tolerating pod would reschedule right back; it neither blocks
+        # the node nor gets evicted (terminate.go:90-93)
+        env, node = env_with_node()
+        pod_evict = owned_pod(node_name=node.name, unschedulable=False)
+        pod_skip = owned_pod(node_name=node.name, unschedulable=False, tolerations=[unschedulable_toleration()])
+        env.kube.create(pod_evict)
+        env.kube.create(pod_skip)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+        assert env.kube.get("Pod", pod_skip.name, pod_skip.namespace) is not None, "tolerating pod must survive"
+        assert env.kube.get("Pod", pod_evict.name, pod_evict.namespace) is None, "regular pod evicted"
+
+    def test_do_not_evict_pod_tolerating_unschedulable_taint_blocks(self):
+        # do-not-evict is checked before the toleration skip (suite_test.go:173)
+        env, node = env_with_node()
+        pod = owned_pod(
+            node_name=node.name,
+            unschedulable=False,
+            annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"},
+            tolerations=[unschedulable_toleration()],
+        )
+        env.kube.create(pod)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        draining(env, node.name)
+        assert env.recorder.of("FailedDraining")
+
+    def test_do_not_evict_static_pod_blocks(self):
+        # do-not-evict is checked before the static-pod skip (suite_test.go:217)
+        env, node = env_with_node()
+        pod = make_pod(node_name=node.name, unschedulable=False, annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"})
+        pod.metadata.owner_references.append(OwnerReference(kind="Node", name=node.name, uid="node-uid"))
+        env.kube.create(pod)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        draining(env, node.name)
+
+    def test_ownerless_pod_blocks_drain(self):
+        env, node = env_with_node()
+        pod_evict = owned_pod(node_name=node.name, unschedulable=False)
+        pod_no_owner = make_pod(node_name=node.name, unschedulable=False)
+        env.kube.create(pod_evict)
+        env.kube.create(pod_no_owner)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        draining(env, node.name)
+        # neither pod was enqueued: the drain aborted wholesale
+        assert len(env.termination_controller.eviction_queue) == 0
+        assert env.kube.get("Pod", pod_evict.name, pod_evict.namespace) is not None
+
+        # once the ownerless pod is gone the drain completes
+        env.kube.delete(pod_no_owner, grace=False)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+
+    def test_deletes_nodes_with_terminal_pods(self):
+        env, node = env_with_node()
+        env.kube.create(make_pod(node_name=node.name, unschedulable=False, phase="Succeeded"))
+        env.kube.create(make_pod(node_name=node.name, unschedulable=False, phase="Failed"))
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+
+    def test_evicts_non_critical_pods_first(self):
+        env, node = env_with_node()
+        pod_evict = owned_pod(node_name=node.name, unschedulable=False)
+        pod_node_critical = owned_pod(node_name=node.name, unschedulable=False)
+        pod_node_critical.spec.priority_class_name = "system-node-critical"
+        pod_cluster_critical = owned_pod(node_name=node.name, unschedulable=False)
+        pod_cluster_critical.spec.priority_class_name = "system-cluster-critical"
+        for p in (pod_evict, pod_node_critical, pod_cluster_critical):
+            env.kube.create(p)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        # first pass: only the non-critical pod is evicted
+        draining(env, node.name)
+        assert env.kube.get("Pod", pod_evict.name, pod_evict.namespace) is None
+        assert env.kube.get("Pod", pod_node_critical.name, pod_node_critical.namespace) is not None
+        assert env.kube.get("Pod", pod_cluster_critical.name, pod_cluster_critical.namespace) is not None
+        # second pass: critical pods go, then the node
+        env.termination_controller.reconcile_all()
+        assert env.kube.get("Pod", pod_node_critical.name, pod_node_critical.namespace) is None
+        assert env.kube.get("Pod", pod_cluster_critical.name, pod_cluster_critical.namespace) is None
+        assert env.kube.get_node(node.name) is None
+
+    def test_does_not_evict_static_pods(self):
+        env, node = env_with_node()
+        pod_evict = owned_pod(node_name=node.name, unschedulable=False)
+        pod_mirror = make_pod(node_name=node.name, unschedulable=False)
+        pod_mirror.metadata.owner_references.append(OwnerReference(kind="Node", name=node.name, uid="node-uid"))
+        env.kube.create(pod_evict)
+        env.kube.create(pod_mirror)
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None, "mirror pod must not block deletion"
+        assert env.kube.get("Pod", pod_mirror.name, pod_mirror.namespace) is not None, "mirror pod never evicted"
+        assert env.kube.get("Pod", pod_evict.name, pod_evict.namespace) is None
+
+    def test_does_not_delete_node_until_all_pods_deleted(self):
+        # a pod that survives eviction attempts (PDB) keeps the node draining
+        from karpenter_tpu.api.objects import LabelSelector, ObjectMeta, PodDisruptionBudget
+
+        env, node = env_with_node()
+        pods = [owned_pod(node_name=node.name, unschedulable=False, labels={"app": "guarded"}) for _ in range(2)]
+        for p in pods:
+            env.kube.create(p)
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="guard", namespace="default"),
+                selector=LabelSelector(match_labels={"app": "guarded"}),
+                disruptions_allowed=1,
+            )
+        )
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        # one eviction allowed; the other pod still blocks
+        draining(env, node.name)
+        assert len([p for p in env.kube.list_pods() if p.metadata.labels.get("app") == "guarded"]) == 1
+
+        pdb = env.kube.list("PodDisruptionBudget", "default")[0]
+        pdb.disruptions_allowed = 1
+        env.clock.step(1)  # per-item eviction backoff
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+
+    def test_waits_for_terminating_pods_then_gives_up_after_grace(self):
+        # a pod with a deletion timestamp blocks until the 1-minute
+        # kubelet-partition window passes, then stops counting
+        # (terminate.go:166-171, suite_test.go:505-530)
+        env, node = env_with_node()
+        pod = owned_pod(node_name=node.name, unschedulable=False)
+        pod.metadata.finalizers.append("test/hold")  # keeps the object terminating
+        env.kube.create(pod)
+        env.kube.delete(pod)  # graceful: sets deletion timestamp, object stays
+        assert env.kube.get("Pod", pod.name, pod.namespace).metadata.deletion_timestamp is not None
+        delete_node(env, node)
+        env.termination_controller.reconcile_all()
+        draining(env, node.name)  # still blocked by the terminating pod
+
+        env.clock.step(90)
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None, "stuck-terminating pod must stop blocking"
