@@ -77,6 +77,16 @@ class FleetInstance:
     subnet_id: str = ""
 
 
+class LaunchTemplateNotFoundError(RuntimeError):
+    """A fleet spec referenced a launch template the cloud no longer has —
+    the cache went out of sync with external deletion (the EC2
+    InvalidLaunchTemplateId analog, launchtemplate_test.go:138)."""
+
+    def __init__(self, template_ids):
+        super().__init__(f"launch templates not found: {sorted(template_ids)}")
+        self.template_ids = set(template_ids)
+
+
 class InsufficientCapacityError(RuntimeError):
     def __init__(self, pools):
         super().__init__(f"insufficient capacity for {pools}")
@@ -209,9 +219,17 @@ class CloudBackend:
                 err, self.next_error = self.next_error, None
                 raise err
             self.create_fleet_calls.append(request)
+            # EC2 rejects specs whose launch template is gone; if nothing
+            # launchable remains, surface the stale ids so the caller can
+            # re-sync its cache
+            known_templates = {t.template_id for t in self.launch_templates.values()}
+            stale = {s.launch_template_id for s in request.specs if s.launch_template_id not in known_templates}
+            specs = [s for s in request.specs if s.launch_template_id in known_templates]
+            if not specs and stale:
+                raise LaunchTemplateNotFoundError(stale)
             unavailable = []
             best: Optional[Tuple[float, FleetInstanceSpec]] = None
-            for spec in request.specs:
+            for spec in specs:
                 pool = (spec.instance_type, spec.zone, spec.capacity_type)
                 if pool in self.insufficient_capacity_pools:
                     unavailable.append(pool)

@@ -23,7 +23,7 @@ from __future__ import annotations
 import hashlib
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .backend import CloudBackend, LaunchTemplate
 
@@ -153,11 +153,21 @@ def get_image_family(name: Optional[str]) -> ImageFamily:
 
 
 class LaunchTemplateProvider:
-    def __init__(self, backend: CloudBackend, cluster_name: str = "cluster"):
+    # cached entries re-ensure against the cloud after this long, healing a
+    # PARTIALLY out-of-sync cache (one arch's template deleted externally)
+    # the way the reference's TTL'd describe + NotFound-recreate does
+    # (launchtemplate.go cache TTL); the all-stale case recovers immediately
+    # through the fleet error path (provider.py create)
+    CACHE_TTL_SECONDS = 600.0
+
+    def __init__(self, backend: CloudBackend, cluster_name: str = "cluster", clock=None):
+        from ...utils.clock import Clock
+
         self.backend = backend
         self.cluster_name = cluster_name
+        self.clock = clock or getattr(backend, "clock", None) or Clock()
         self._lock = threading.Lock()
-        self._cache: Dict[str, LaunchTemplate] = {}
+        self._cache: Dict[str, Tuple[LaunchTemplate, float]] = {}  # name -> (template, cached_at)
 
     def resolve(
         self,
@@ -177,16 +187,24 @@ class LaunchTemplateProvider:
             "|".join([image, ",".join(sorted(security_group_ids)), user_data]).encode()
         ).hexdigest()[:16]
         name = f"karpenter-tpu-{key_digest}"
+        now = self.clock.now()
         with self._lock:
             cached = self._cache.get(name)
-            if cached is not None:
-                return cached
+            if cached is not None and now - cached[1] < self.CACHE_TTL_SECONDS:
+                return cached[0]
         template = self.backend.ensure_launch_template(name, image, security_group_ids, user_data)
         with self._lock:
-            self._cache[name] = template
+            self._cache[name] = (template, now)
         return template
 
     def invalidate(self, name: str) -> None:
         with self._lock:
             self._cache.pop(name, None)
         self.backend.delete_launch_template(name)
+
+    def clear_cache(self) -> None:
+        """Drop every cached entry so the next resolve re-ensures against the
+        cloud — the recovery step when the cache went out of sync with an
+        external deletion (launchtemplate_test.go:138-160)."""
+        with self._lock:
+            self._cache.clear()

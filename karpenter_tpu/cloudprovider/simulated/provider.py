@@ -18,7 +18,7 @@ from ...api.objects import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta
 from ...api.provisioner import Provisioner
 from ...utils import resources as res
 from ..types import CloudProvider, InstanceType, NodeRequest
-from .backend import CloudBackend, FleetInstanceSpec, FleetRequest, InsufficientCapacityError
+from .backend import CloudBackend, FleetInstanceSpec, FleetRequest, InsufficientCapacityError, LaunchTemplateNotFoundError
 from .catalog import InstanceTypeCatalog, PricingProvider, SimulatedInstanceType, UnavailableOfferingsCache
 from .fleet import CreateFleetBatcher
 from .launchtemplate import FAMILIES, KubeletArgs, LaunchTemplateProvider
@@ -132,7 +132,7 @@ class SimulatedCloudProvider(CloudProvider):
         self.pricing = PricingProvider(self.backend)
         self.unavailable = UnavailableOfferingsCache(self.clock)
         self.catalog = InstanceTypeCatalog(self.backend, self.pricing, self.unavailable, self.clock)
-        self.launch_templates = LaunchTemplateProvider(self.backend, cluster_name)
+        self.launch_templates = LaunchTemplateProvider(self.backend, cluster_name, clock=self.clock)
         self.subnets = SubnetProvider(self.backend, self.clock)
         self.security_groups = SecurityGroupProvider(self.backend, self.clock)
         self.fleet_batcher = CreateFleetBatcher(self.backend, window=0.0)
@@ -207,6 +207,16 @@ class SimulatedCloudProvider(CloudProvider):
     # -- create / delete ----------------------------------------------------------
 
     def create(self, node_request: NodeRequest) -> Node:
+        try:
+            return self._create(node_request)
+        except LaunchTemplateNotFoundError:
+            # the launch-template cache went out of sync with an external
+            # deletion: drop it and rebuild once — the retry re-ensures every
+            # template against the cloud (launchtemplate_test.go:138-160)
+            self.launch_templates.clear_cache()
+            return self._create(node_request)
+
+    def _create(self, node_request: NodeRequest) -> Node:
         template = node_request.template
         requirements = template.requirements
         options = sorted(node_request.instance_type_options, key=lambda it: it.price())[:MAX_INSTANCE_TYPES]

@@ -1,0 +1,296 @@
+"""Provider-layer catalog: the reference AWS suite scenarios that are
+cloud-neutral, ported against the simulated provider.
+
+Covers the insufficient-capacity fallback matrix run through real
+provisioning rounds (instancetypes_test.go:294-425), launch-template
+equivalence and out-of-sync cache recovery (launchtemplate_test.go:86,138),
+and fleet-batcher error propagation / partial fulfillment
+(createfleetbatcher_test.go:157,250). Base coverage (caching, pricing,
+image families, networking, admission) lives in test_simulated_provider.py.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.simulated import CloudBackend, SimulatedCloudProvider
+from karpenter_tpu.cloudprovider.simulated.backend import (
+    FleetInstanceSpec,
+    FleetRequest,
+    InsufficientCapacityError,
+    LaunchTemplateNotFoundError,
+)
+from karpenter_tpu.cloudprovider.simulated.fleet import CreateFleetBatcher
+from karpenter_tpu.cloudprovider.simulated.launchtemplate import LaunchTemplateProvider
+
+LaunchTemplateProviderTTL = LaunchTemplateProvider.CACHE_TTL_SECONDS
+from karpenter_tpu.cloudprovider.types import NodeRequest
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import Runtime
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_pod, make_provisioner
+
+ZONES = ("zone-a", "zone-b", "zone-c")
+CAPACITY_TYPES = ("spot", "on-demand")
+
+
+class IceEnv:
+    """Provisioning rounds against the simulated provider with ICE injection —
+    the instancetypes_test reconciliation-attempt harness."""
+
+    def __init__(self):
+        self.clock = FakeClock()
+        self.kube = KubeCluster(clock=self.clock)
+        self.backend = CloudBackend(clock=self.clock)
+        self.provider = SimulatedCloudProvider(backend=self.backend, kube=self.kube, clock=self.clock)
+        self.runtime = Runtime(
+            kube=self.kube,
+            cloud_provider=self.provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False),
+        )
+        self.kube.create(make_provisioner())
+
+    def ice(self, type_name: str, zones=ZONES, capacity_types=CAPACITY_TYPES):
+        for zone in zones:
+            for ct in capacity_types:
+                self.backend.insufficient_capacity_pools.add((type_name, zone, ct))
+
+    def cheapest_type(self):
+        return min(self.provider.get_instance_types(make_provisioner()), key=lambda t: t.price())
+
+    def provision(self):
+        return self.runtime.provision_once()
+
+
+class TestInsufficientCapacityFallback:
+    def test_launches_different_type_on_second_attempt(self):
+        env = IceEnv()
+        cheapest = env.cheapest_type().name()
+        env.ice(cheapest)
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.provision()  # first attempt fails against the ICE'd pool
+        # the failed pools are negative-cached; the retry round launches a
+        # different instance type (instancetypes_test.go:294-324)
+        env.provision()
+        nodes = env.kube.list_nodes()
+        assert nodes, "second reconciliation attempt must launch"
+        assert all(n.metadata.labels[lbl.LABEL_INSTANCE_TYPE] != cheapest for n in nodes)
+
+    def test_launches_in_different_zone_on_second_attempt(self):
+        env = IceEnv()
+        cheapest = env.cheapest_type().name()
+        # the cheapest type is exhausted only in zone-a; a zone-a-or-b pod
+        # must land in zone-b on retry (instancetypes_test.go:325-351)
+        env.ice(cheapest, zones=("zone-a",))
+        env.kube.create(
+            make_pod(
+                requests={"cpu": "1", "memory": "1Gi"},
+                node_selector={lbl.LABEL_TOPOLOGY_ZONE: "zone-a"},
+            )
+        )
+        env.provision()
+        env.provision()
+        nodes = env.kube.list_nodes()
+        assert nodes, "retry round must launch despite the zone-a ICE"
+        # zone-pinned pod: the launch respects the selector by choosing
+        # another type in zone-a, never another zone
+        assert all(n.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE] == "zone-a" for n in nodes)
+        assert all(n.metadata.labels[lbl.LABEL_INSTANCE_TYPE] != cheapest for n in nodes)
+
+    def test_launches_on_demand_when_spot_unavailable(self):
+        env = IceEnv()
+        # every spot pool is exhausted; flexible workloads fall back to
+        # on-demand (instancetypes_test.go:404-424)
+        for info in env.backend.catalog:
+            env.ice(info.name, capacity_types=("spot",))
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.provision()
+        env.provision()
+        nodes = env.kube.list_nodes()
+        assert nodes
+        assert all(n.metadata.labels[lbl.LABEL_CAPACITY_TYPE] == "on-demand" for n in nodes)
+
+    def test_ice_cache_expiry_restores_pool(self):
+        env = IceEnv()
+        cheapest = env.cheapest_type().name()
+        env.ice(cheapest)
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.provision()
+        env.provision()
+        assert all(n.metadata.labels[lbl.LABEL_INSTANCE_TYPE] != cheapest for n in env.kube.list_nodes())
+
+        # capacity returns and the negative cache expires: the cheapest pool
+        # is launchable again (instancetypes_test.go:384-403)
+        env.backend.insufficient_capacity_pools.clear()
+        env.clock.step(3600)
+        env.provider.catalog.invalidate()
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.provision()
+        latest = env.kube.list_nodes()[-1]
+        assert latest.metadata.labels[lbl.LABEL_INSTANCE_TYPE] == cheapest
+
+
+class TestLaunchTemplateCache:
+    def _request(self, provider, provisioner):
+        template = NodeTemplate.from_provisioner(provisioner)
+        options = sorted(provider.get_instance_types(provisioner), key=lambda t: t.price())
+        return NodeRequest(template=template, instance_type_options=options)
+
+    def _env(self):
+        clock = FakeClock()
+        kube = KubeCluster(clock=clock)
+        backend = CloudBackend(clock=clock)
+        return backend, SimulatedCloudProvider(backend=backend, kube=kube, clock=clock)
+
+    def test_same_launch_template_for_equivalent_constraints(self):
+        backend, provider = self._env()
+        prov = make_provisioner(labels={"team": "a"})
+        provider.kube.create(prov)
+        # two independent launches with equivalent constraint sets (options
+        # ordered differently) digest to the SAME templates — one per
+        # architecture in the options, none new on the second create
+        # (launchtemplate_test.go:86)
+        provider.create(self._request(provider, prov))
+        first = set(backend.launch_templates)
+        second = self._request(provider, prov)
+        second.instance_type_options.reverse()
+        provider.create(second)
+        assert set(backend.launch_templates) == first
+
+    def test_different_constraints_get_different_templates(self):
+        backend, provider = self._env()
+        prov_a = make_provisioner(name="p1", labels={"team": "a"})
+        provider.kube.create(prov_a)
+        provider.create(self._request(provider, prov_a))
+        first = set(backend.launch_templates)
+        prov_b = make_provisioner(name="p2", labels={"team": "b"})
+        provider.kube.create(prov_b)
+        provider.create(self._request(provider, prov_b))
+        # different node labels change the bootstrap payload: fresh templates
+        assert set(backend.launch_templates) - first
+
+    def test_recovers_from_out_of_sync_cache(self):
+        backend, provider = self._env()
+        prov = make_provisioner()
+        provider.kube.create(prov)
+        provider.create(self._request(provider, prov))
+        before = set(backend.launch_templates)
+        assert before
+
+        # the templates vanish behind the cache (external deletion); the next
+        # create must detect the stale ids, re-ensure, and still launch
+        # (launchtemplate_test.go:138-160)
+        backend.launch_templates.clear()
+        node = provider.create(self._request(provider, prov))
+        assert node is not None
+        assert set(backend.launch_templates) == before, "templates re-created on recovery"
+
+    def test_partially_stale_cache_heals_after_ttl(self):
+        # only ONE of the templates vanishes: fleet calls keep succeeding
+        # from the surviving specs, so recovery rides the resolve-side TTL
+        # re-ensure instead of the fleet error path
+        backend, provider = self._env()
+        prov = make_provisioner()
+        provider.kube.create(prov)
+        provider.create(self._request(provider, prov))
+        before = set(backend.launch_templates)
+        assert len(before) >= 2, "needs one template per architecture"
+
+        victim = sorted(before)[0]
+        backend.delete_launch_template(victim)
+        provider.create(self._request(provider, prov))
+        assert victim not in backend.launch_templates, "within the TTL the stale entry is still trusted"
+
+        provider.clock.step(LaunchTemplateProviderTTL + 1)
+        provider.create(self._request(provider, prov))
+        assert set(backend.launch_templates) == before, "TTL re-ensure recreates the deleted template"
+
+
+class TestFleetBatcherFailureModes:
+    def _spec(self, backend):
+        lt = backend.ensure_launch_template("lt-test", "img-1", ["sg-1"], "")
+        info = backend.catalog[0]
+        return FleetInstanceSpec(
+            instance_type=info.name,
+            zone="zone-a",
+            capacity_type="on-demand",
+            launch_template_id=lt.template_id,
+            subnet_id="subnet-a",
+        )
+
+    def test_errors_propagate_to_all_waiters(self):
+        clock = FakeClock()
+        backend = CloudBackend(clock=clock)
+        request = FleetRequest(specs=[self._spec(backend)], capacity_type="on-demand")
+        backend.insufficient_capacity_pools.add((request.specs[0].instance_type, "zone-a", "on-demand"))
+        batcher = CreateFleetBatcher(backend, window=0.05)
+        errors = []
+
+        def call():
+            try:
+                batcher.create_fleet(request)
+            except InsufficientCapacityError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(errors) == 4, "every waiter must see the failure (createfleetbatcher_test.go:157)"
+
+    def test_partial_fulfillment_serves_launched_instances_first(self):
+        clock = FakeClock()
+        backend = CloudBackend(clock=clock)
+        request = FleetRequest(specs=[self._spec(backend)], capacity_type="on-demand")
+        real_create = backend.create_fleet
+        calls = {"n": 0}
+
+        def flaky(req):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise InsufficientCapacityError([(req.specs[0].instance_type, "zone-a", "on-demand")])
+            return real_create(req)
+
+        backend.create_fleet = flaky
+        batcher = CreateFleetBatcher(backend, window=0.05)
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(batcher.create_fleet(request))
+            except InsufficientCapacityError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 2 instances launched before capacity ran out: they reach waiters
+        # (no orphaned capacity), the shortfall errors
+        # (createfleetbatcher_test.go:250)
+        assert len(results) == 2
+        assert len(errors) == 2
+        assert len({r.instance_id for r in results}) == 2
+
+
+class TestStaleTemplateErrorShape:
+    def test_backend_raises_when_no_spec_launchable(self):
+        clock = FakeClock()
+        backend = CloudBackend(clock=clock)
+        spec = FleetInstanceSpec(
+            instance_type=backend.catalog[0].name,
+            zone="zone-a",
+            capacity_type="on-demand",
+            launch_template_id="lt-gone",
+            subnet_id="subnet-a",
+        )
+        with pytest.raises(LaunchTemplateNotFoundError) as err:
+            backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand"))
+        assert err.value.template_ids == {"lt-gone"}

@@ -129,7 +129,12 @@ class TestCreate:
 class TestFleetBatcher:
     def test_concurrent_identical_requests_coalesce(self, backend):
         batcher = CreateFleetBatcher(backend, window=0.05)
-        request_specs = [FleetInstanceSpec(instance_type="general-2x4", zone="zone-a", capacity_type="on-demand")]
+        lt = backend.ensure_launch_template("lt-batch", "img-1", ["sg-1"], "")
+        request_specs = [
+            FleetInstanceSpec(
+                instance_type="general-2x4", zone="zone-a", capacity_type="on-demand", launch_template_id=lt.template_id
+            )
+        ]
         results = []
         errors = []
 
