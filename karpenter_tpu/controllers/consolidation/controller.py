@@ -198,6 +198,11 @@ class ConsolidationController:
             else:
                 self.recorder.waiting_on_readiness(replacement)
                 return ConsolidationAction(ActionType.NO_ACTION, reason="waiting on replacement readiness")
+        # any framework-owned node still initializing blocks the WHOLE pass
+        # (controller.go:196-203,231): its in-flight capacity isn't in the
+        # simulation, so every replace/delete decision would double-count
+        if self._uninitialized_node_exists():
+            return ConsolidationAction(ActionType.NO_ACTION, reason="uninitialized nodes exist")
         candidates = self.candidate_nodes()
         if not candidates:
             return ConsolidationAction(ActionType.NO_ACTION, reason="no candidates")
@@ -221,6 +226,28 @@ class ConsolidationController:
                 self.perform(action)
                 return action
         return ConsolidationAction(ActionType.NO_ACTION, reason="no beneficial action")
+
+    def _uninitialized_node_exists(self) -> bool:
+        """An owned node still warming up blocks the pass — but only within
+        the same window the replace path waits on its own launches
+        (REPLACE_READY_TIMEOUT). Past that the node is presumed stuck, and a
+        launch that will never become capacity must not wedge consolidation
+        forever (the reference relies on external liveness cleanup it does
+        not have here; see the reaper note above)."""
+        blocked = False
+
+        def visit(state: StateNode) -> bool:
+            nonlocal blocked
+            node = state.node
+            if not state.owned() or state.initialized() or node.metadata.deletion_timestamp is not None:
+                return True
+            if self.clock.now() - node.metadata.creation_timestamp >= self.REPLACE_READY_TIMEOUT:
+                return True  # stuck, not warming
+            blocked = True
+            return False
+
+        self.cluster.for_each_node(visit)
+        return blocked
 
     def candidate_nodes(self) -> List[StateNode]:
         out: List[StateNode] = []

@@ -1,0 +1,191 @@
+"""Consolidation suite depth: the pkg/controllers/consolidation/suite_test.go
+scenarios not already covered by test_deprovisioning.py.
+
+Covers the granular disruption-cost cases (:116-161), lifetime-remaining
+scaling (:651), the anti-affinity deletion guard (:818), multi-empty-node
+deletion (:931), and the uninitialized-node full-pass block (:973).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.controllers.consolidation.controller import ActionType
+from karpenter_tpu.controllers.consolidation.helpers import (
+    POD_DELETION_COST_ANNOTATION,
+    disruption_cost,
+    lifetime_remaining,
+    pod_cost,
+)
+from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+from karpenter_tpu.cloudprovider.fake import instance_type, instance_types
+from karpenter_tpu.cloudprovider.types import Offering
+from karpenter_tpu.utils.clock import FakeClock
+from tests.helpers import make_node, make_pod, make_provisioner
+from tests.test_deprovisioning import DeprovEnv, consolidatable_provisioner, owned_pod
+
+
+class TestDisruptionCost:
+    def test_standard_cost_without_priority_or_deletion_cost(self):
+        assert pod_cost(make_pod()) == 1.0
+
+    def test_positive_deletion_cost_raises_cost(self):
+        expensive = make_pod(annotations={POD_DELETION_COST_ANNOTATION: "100"})
+        assert pod_cost(expensive) > pod_cost(make_pod())
+
+    def test_negative_deletion_cost_lowers_cost(self):
+        cheap = make_pod(annotations={POD_DELETION_COST_ANNOTATION: "-100"})
+        assert pod_cost(cheap) < pod_cost(make_pod())
+
+    def test_higher_deletion_costs_rank_higher(self):
+        costs = [
+            pod_cost(make_pod(annotations={POD_DELETION_COST_ANNOTATION: str(c)}))
+            for c in (-500, -10, 0, 10, 500)
+        ]
+        assert costs == sorted(costs)
+        assert costs[0] < costs[-1]
+
+    def test_higher_priority_raises_cost(self):
+        assert pod_cost(make_pod(priority=1_000_000)) > pod_cost(make_pod(priority=0))
+
+    def test_lower_priority_lowers_cost(self):
+        assert pod_cost(make_pod(priority=-1_000_000)) < pod_cost(make_pod(priority=0))
+
+    def test_invalid_deletion_cost_ignored(self):
+        assert pod_cost(make_pod(annotations={POD_DELETION_COST_ANNOTATION: "not-a-number"})) == 1.0
+
+    def test_lifetime_remaining_scales_node_cost(self):
+        # a node near its expiry TTL is cheaper to disrupt than a fresh one
+        # holding identical pods (suite_test.go:651, helpers.go:62-70)
+        clock = FakeClock()
+        fresh = make_node(allocatable={"cpu": 4})
+        fresh.metadata.creation_timestamp = clock.now()
+        old = make_node(allocatable={"cpu": 4})
+        old.metadata.creation_timestamp = clock.now() - 90
+        pods = [make_pod(), make_pod()]
+        ttl = 100.0
+        cost_fresh = disruption_cost(pods, lifetime_remaining(clock, fresh, ttl))
+        cost_old = disruption_cost(pods, lifetime_remaining(clock, old, ttl))
+        assert cost_old < cost_fresh
+        # no TTL -> full weight regardless of age
+        assert lifetime_remaining(clock, old, None) == 1.0
+
+
+class TestConsolidationGuards:
+    def test_wont_delete_node_violating_anti_affinity(self):
+        # two hostname-anti-affine pods on two nodes: neither node can be
+        # drained because its pod cannot co-locate with its sibling
+        # (suite_test.go:818)
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()], instance_types_list=instance_types(10))
+        anti = dict(
+            pod_anti_requirements=[
+                PodAffinityTerm(
+                    topology_key=lbl.LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "anti"}),
+                )
+            ],
+            labels={"app": "anti"},
+        )
+        p1 = owned_pod(requests={"cpu": "0.5"}, **anti)
+        env.launch_node_with_pods(p1)
+        p2 = owned_pod(requests={"cpu": "0.5"}, **anti)
+        env.launch_node_with_pods(p2)
+        assert len(env.kube.list_nodes()) == 2
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+        assert len(env.kube.list_nodes()) == 2
+
+    def test_deletes_multiple_empty_nodes_in_one_pass(self):
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        env = DeprovEnv(
+            provisioners=[consolidatable_provisioner()],
+            instance_types_list=[instance_type("only", cpu=4, memory="8Gi", price=1.0, offerings=od)],
+        )
+        # 3-cpu pods cannot share a 4-cpu node: one node each
+        pods = [owned_pod(requests={"cpu": "3"}) for _ in range(2)]
+        for pod in pods:
+            env.launch_node_with_pods(pod)
+        assert len(env.kube.list_nodes()) == 2
+        for pod in pods:
+            env.kube.delete(pod, grace=False)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY
+        assert len(action.nodes) == 2
+        env.termination_controller.reconcile_all()
+        assert env.kube.list_nodes() == []
+
+    def test_uninitialized_node_blocks_entire_pass(self):
+        # an empty consolidatable node exists, but another owned node is
+        # still initializing: NOTHING may happen this pass
+        # (suite_test.go:973, controller.go:196-203,231)
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+
+        warming = make_node(labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": 4}, ready=False)
+        warming.metadata.creation_timestamp = env.clock.now()
+        env.kube.create(warming)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION
+        assert "uninitialized" in action.reason
+
+        # the moment it initializes, the empty node goes
+        warming.status.conditions[0].status = "True"
+        env.kube.update(warming)
+        env.node_controller.reconcile_all()
+        assert env.kube.get_node(warming.name).metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY
+
+    def test_stuck_uninitialized_node_stops_blocking_after_window(self):
+        # a node that never initializes is presumed stuck once it outlives
+        # the replace-ready window — it must not wedge consolidation forever
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+
+        warming = make_node(labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": 4}, ready=False)
+        warming.metadata.creation_timestamp = env.clock.now()
+        env.kube.create(warming)
+        assert env.consolidation.process_cluster().type == ActionType.NO_ACTION
+
+        env.clock.step(env.consolidation.REPLACE_READY_TIMEOUT + 1)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY, "stuck node must stop blocking"
+
+    def test_replace_maintains_zonal_topology_spread(self):
+        # three spread pods across three zones; consolidating one node must
+        # not let the spread collapse (suite_test.go:721). The simulation
+        # runs the exact scheduler, so a replace/delete that would break the
+        # skew is never proposed.
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        od = lambda z: [Offering(capacity_type="on-demand", zone=z)]  # noqa: E731
+        env = DeprovEnv(
+            provisioners=[consolidatable_provisioner()],
+            instance_types_list=[
+                instance_type(f"t-{z}", cpu=4, memory="8Gi", price=2.0, offerings=od(z))
+                for z in ("test-zone-1", "test-zone-2", "test-zone-3")
+            ],
+        )
+        spread = dict(
+            labels={"app": "spread"},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=lbl.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "spread"}),
+                )
+            ],
+        )
+        pods = [owned_pod(requests={"cpu": "1"}, **spread) for _ in range(3)]
+        env.launch_node_with_pods(*pods)
+        zones_before = {
+            n.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE) for n in env.kube.list_nodes()
+        }
+        assert len(zones_before) == 3
+        action = env.consolidation.process_cluster()
+        # deleting any node would push skew to 2 > 1, so nothing may happen
+        assert action.type == ActionType.NO_ACTION
+        assert len(env.kube.list_nodes()) == 3

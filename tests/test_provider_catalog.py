@@ -25,8 +25,6 @@ from karpenter_tpu.cloudprovider.simulated.backend import (
 )
 from karpenter_tpu.cloudprovider.simulated.fleet import CreateFleetBatcher
 from karpenter_tpu.cloudprovider.simulated.launchtemplate import LaunchTemplateProvider
-
-LaunchTemplateProviderTTL = LaunchTemplateProvider.CACHE_TTL_SECONDS
 from karpenter_tpu.cloudprovider.types import NodeRequest
 from karpenter_tpu.kube.cluster import KubeCluster
 from karpenter_tpu.runtime import Runtime
@@ -206,7 +204,7 @@ class TestLaunchTemplateCache:
         provider.create(self._request(provider, prov))
         assert victim not in backend.launch_templates, "within the TTL the stale entry is still trusted"
 
-        provider.clock.step(LaunchTemplateProviderTTL + 1)
+        provider.clock.step(LaunchTemplateProvider.CACHE_TTL_SECONDS + 1)
         provider.create(self._request(provider, prov))
         assert set(backend.launch_templates) == before, "TTL re-ensure recreates the deleted template"
 
