@@ -164,12 +164,20 @@ class ProvisionerController:
         return pods
 
     def schedule(self, pods: Sequence[Pod], state_nodes: Sequence[object], opts: Optional[SchedulerOptions] = None) -> SchedulingResults:
-        provisioners = [p for p in self.kube.list_provisioners()]
+        # a provisioner being deleted must not place new capacity
+        # (provisioning suite: "should ignore provisioners that are deleting")
+        provisioners = [p for p in self.kube.list_provisioners() if p.metadata.deletion_timestamp is None]
         cloud_provider = self.cloud_provider
         if self.remote_solver is not None and len(pods) >= self._remote_min_batch():
             from ...service.client import RemoteSchedulingError
+            from ...scheduler.builder import apply_kubelet_max_pods
 
-            instance_types = {p.name: cloud_provider.get_instance_types(p) for p in provisioners}
+            # the same kubelet maxPods cap the local build applies — the
+            # client materializes launch options from THIS universe, so an
+            # uncapped list would launch nodes at native pod density
+            instance_types = {
+                p.name: apply_kubelet_max_pods(p, cloud_provider.get_instance_types(p)) for p in provisioners
+            }
             try:
                 results = self.remote_solver.solve(
                     provisioners,

@@ -348,12 +348,25 @@ def catalog_key(
     zones: Optional[Sequence[str]] = None,
     capacity_types: Optional[Sequence[str]] = None,
 ) -> tuple:
+    # keyed by the identity of the instance-type OBJECTS, not the list:
+    # providers hand out a fresh list copy per get_instance_types call while
+    # TTL-caching the items, so item identity is what's stable across solves.
+    # Cache holders must pin the items (see catalog_pin) so a live entry's
+    # ids can never be recycled onto different objects.
     return (
         tuple(template_signature(t) for t in templates),
-        tuple(id(instance_types.get(t.provisioner_name)) for t in templates),
+        tuple(tuple(id(it) for it in instance_types.get(t.provisioner_name) or ()) for t in templates),
         tuple(sorted(zones or ())),
         tuple(sorted(capacity_types or ())),
     )
+
+
+def catalog_pin(
+    templates: Sequence[NodeTemplate], instance_types: Dict[str, Sequence[InstanceType]]
+) -> tuple:
+    """The object references a catalog_key's ids point at — stored alongside
+    the cached encoding to keep them alive (id-reuse safety)."""
+    return tuple(tuple(instance_types.get(t.provisioner_name) or ()) for t in templates)
 
 
 def encode_catalog(

@@ -53,6 +53,63 @@ def daemonset_overhead(daemonset_pods: Iterable[Pod], template: NodeTemplate) ->
     return total
 
 
+class _MaxPodsInstanceType(InstanceType):
+    """A provisioner's kubeletConfiguration.maxPods caps pods-per-node below
+    the machine's native density (the reference applies this inside the AWS
+    provider's instance-type adapter, instancetypes.go pods()); applied here
+    so EVERY provider honors it and the dense encode sees the capped value."""
+
+    def __init__(self, inner: InstanceType, max_pods: int):
+        self._inner = inner
+        self._max_pods = float(max_pods)
+
+    def name(self) -> str:
+        return self._inner.name()
+
+    def requirements(self):
+        return self._inner.requirements()
+
+    def offerings(self):
+        return self._inner.offerings()
+
+    def resources(self) -> Dict[str, float]:
+        out = dict(self._inner.resources())
+        out[res.PODS] = min(out.get(res.PODS, self._max_pods), self._max_pods)
+        return out
+
+    def overhead(self) -> Dict[str, float]:
+        return self._inner.overhead()
+
+    def price(self) -> float:
+        return self._inner.price()
+
+
+# wrapper lists memoized on the wrapped instance-type OBJECTS (providers
+# return a fresh list copy per call but TTL-cache the items), so the dense
+# catalog cache and the vectorized filter cache — keyed the same way — stay
+# warm across solves; the entry pins the originals against id reuse
+_MAX_PODS_MEMO: Dict[tuple, tuple] = {}
+
+
+def apply_kubelet_max_pods(provisioner: Provisioner, types: List[InstanceType]) -> List[InstanceType]:
+    kc = provisioner.spec.kubelet_configuration
+    if kc is None or kc.max_pods is None:
+        return types
+    # idempotent: the remote-solver fallback re-enters build_scheduler with
+    # an already-capped snapshot; re-wrapping would mint fresh ids and defeat
+    # the warmed catalog/filter caches
+    if types and all(isinstance(it, _MaxPodsInstanceType) and it._max_pods == kc.max_pods for it in types):
+        return types
+    key = (tuple(id(it) for it in types), kc.max_pods)
+    entry = _MAX_PODS_MEMO.get(key)
+    if entry is None:
+        if len(_MAX_PODS_MEMO) >= 64:
+            _MAX_PODS_MEMO.clear()
+        entry = (tuple(types), [_MaxPodsInstanceType(it, kc.max_pods) for it in types])
+        _MAX_PODS_MEMO[key] = entry
+    return entry[1]
+
+
 def build_scheduler(
     provisioners: Sequence[Provisioner],
     cloud_provider: CloudProvider,
@@ -67,7 +124,9 @@ def build_scheduler(
 ) -> Scheduler:
     provisioners = order_by_weight(list(provisioners))
     node_templates = [NodeTemplate.from_provisioner(p) for p in provisioners]
-    instance_types = {p.name: cloud_provider.get_instance_types(p) for p in provisioners}
+    instance_types = {
+        p.name: apply_kubelet_max_pods(p, cloud_provider.get_instance_types(p)) for p in provisioners
+    }
     domains = compute_domains(provisioners, instance_types)
     topology = Topology(kube=kube, cluster=cluster, domains=domains, pods=list(pods))
     overhead = {t.provisioner_name: daemonset_overhead(daemonset_pods, t) for t in node_templates}

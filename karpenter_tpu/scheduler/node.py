@@ -161,23 +161,23 @@ _FILTER_CACHE_MEMO: Dict[tuple, CatalogFilterCache] = {}
 
 
 def catalog_filter_cache(types: Sequence[InstanceType]) -> Optional[CatalogFilterCache]:
-    """Memoized per catalog-list identity (the same discipline as
-    ir/encode.py's catalog key): providers hand out TTL-cached lists, so
-    repeated solves reuse the matrices and warmed compat masks instead of
-    rebuilding per Scheduler. An id() collision after GC is harmless —
-    instance-type objects unknown to a cache fall back to the exact
-    predicates in filter(). Returns None (callers use the pure-Python path)
-    when numpy is unavailable."""
+    """Memoized per instance-type OBJECT identity (the same discipline as
+    ir/encode.py's catalog_key): providers hand out a fresh list copy per
+    get_instance_types call while TTL-caching the items, so keying on the
+    items is what makes repeated solves reuse the matrices and warmed compat
+    masks instead of rebuilding per Scheduler. The entry pins the objects,
+    so a live key's ids can never be recycled. Returns None (callers use the
+    pure-Python path) when numpy is unavailable."""
     if np is None or not types:
         return None
-    key = (id(types), len(types))
+    key = tuple(id(it) for it in types)
     entry = _FILTER_CACHE_MEMO.get(key)
     if entry is None:
         if len(_FILTER_CACHE_MEMO) >= 64:
             _FILTER_CACHE_MEMO.clear()
-        # pin the key's list object: if it were GC'd, a recycled id could
-        # alias a different catalog onto a stale entry forever
-        entry = (types, CatalogFilterCache(types))
+        # pin the keyed objects: if one were GC'd, a recycled id could alias
+        # a different catalog onto a stale entry forever
+        entry = (tuple(types), CatalogFilterCache(types))
         _FILTER_CACHE_MEMO[key] = entry
     return entry[1]
 

@@ -54,7 +54,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
-from ..ir.encode import DenseProblem, GroupKind, catalog_key, encode_catalog, encode_problem, resource_vector
+from ..ir.encode import DenseProblem, GroupKind, catalog_key, catalog_pin, encode_catalog, encode_problem, resource_vector
 from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
@@ -237,12 +237,16 @@ class DenseSolver:
         zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
         capacity_types = scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ())
         ckey = catalog_key(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
-        catalog = self._catalog_encodings.get(ckey)
-        if catalog is None:
+        entry = self._catalog_encodings.get(ckey)
+        if entry is None:
             catalog = encode_catalog(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
             while len(self._catalog_encodings) >= self._catalogs_per_flavor:
                 self._catalog_encodings.pop(next(iter(self._catalog_encodings)))  # FIFO
-            self._catalog_encodings[ckey] = catalog
+            # the pin keeps the keyed instance-type objects alive so their
+            # ids can't be recycled onto a different catalog
+            self._catalog_encodings[ckey] = (catalog, catalog_pin(scheduler.node_templates, scheduler.instance_types))
+        else:
+            catalog = entry[0]
         problem = encode_problem(
             pods,
             scheduler.node_templates,

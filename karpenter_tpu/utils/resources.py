@@ -114,13 +114,20 @@ def requests_for_pods(*pods) -> ResourceList:
     return out
 
 
+def _effective_requests(container) -> ResourceList:
+    """Per-resource, a missing request defaults to the limit — the apiserver's
+    admission defaulting, which the scheduler must mirror for objects that
+    never crossed a real apiserver (provisioning suite :326)."""
+    return {**container.resources.limits, **container.resources.requests}
+
+
 def pod_requests(pod) -> ResourceList:
     running: ResourceList = {}
     for container in pod.spec.containers:
-        running = merge(running, container.resources.requests)
+        running = merge(running, _effective_requests(container))
     init_peak: ResourceList = {}
     for container in pod.spec.init_containers:
-        init_peak = max_resources(init_peak, container.resources.requests)
+        init_peak = max_resources(init_peak, _effective_requests(container))
     out = max_resources(running, init_peak)
     out[PODS] = out.get(PODS, 0.0) + 1.0
     if pod.spec.overhead:

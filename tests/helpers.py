@@ -134,6 +134,7 @@ def make_provisioner(
     ttl_seconds_until_expired: Optional[float] = None,
     consolidation_enabled: Optional[bool] = None,
     provider: Optional[dict] = None,
+    kubelet_configuration=None,
 ) -> Provisioner:
     spec = ProvisionerSpec(
         labels=dict(labels or {}),
@@ -146,6 +147,7 @@ def make_provisioner(
         ttl_seconds_until_expired=ttl_seconds_until_expired,
         consolidation=Consolidation(enabled=consolidation_enabled) if consolidation_enabled is not None else None,
         provider=provider,
+        kubelet_configuration=kubelet_configuration,
     )
     return Provisioner(metadata=ObjectMeta(name=name, namespace=""), spec=spec)
 

@@ -1,0 +1,295 @@
+"""Provisioning suite depth: pkg/controllers/provisioning/suite_test.go
+scenarios beyond test_provisioning.py's base coverage.
+
+Covers deleting-provisioner exclusion (:97), kubelet maxPods node splitting
+(:161), partial scheduling under provisioner limits (:207), extended-resource
+limits (:264), the daemonset-overhead matrix (:279-449), and the
+volume-topology depth block (:532-618).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement,
+    OP_IN,
+    OP_NOT_IN,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.api.provisioner import KubeletConfiguration
+from karpenter_tpu.cloudprovider.fake import instance_type, instance_types
+from karpenter_tpu.cloudprovider.types import Offering
+from tests.env import Environment
+from tests.helpers import make_pod, make_pods, make_provisioner
+
+
+def sized_types():
+    """The reference's tiered fake types: 2cpu/2Gi and 4cpu/4Gi."""
+    od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+    return [
+        instance_type("small", cpu=2, memory="2Gi", price=1.0, offerings=od),
+        instance_type("large", cpu=4, memory="4Gi", price=2.0, offerings=od),
+    ]
+
+
+def provision(env):
+    env.provision()
+    return env
+
+
+def node_of(env, pod_name):
+    results = env.provisioner_controller.last_results
+    for node in results.new_nodes:
+        if any(p.name == pod_name for p in node.pods):
+            return node
+    return None
+
+
+class TestProvisionerLifecycle:
+    def test_ignores_deleting_provisioners(self):
+        env = Environment()
+        prov = make_provisioner()
+        prov.metadata.finalizers.append("karpenter.sh/hold")
+        env.kube.create(prov)
+        env.kube.delete(prov)  # graceful: deletion timestamp set, object held
+        env.kube.create(make_pod(requests={"cpu": 1}))
+        env.provision()
+        assert env.kube.list_nodes() == [], "deleting provisioner must not launch"
+
+    def test_kubelet_max_pods_splits_nodes(self):
+        env = Environment(instance_types=instance_types(5))
+        env.kube.create(make_provisioner(kubelet_configuration=KubeletConfiguration(max_pods=1)))
+        for pod in make_pods(3, requests={"cpu": 0.1}):
+            env.kube.create(pod)
+        env.provision()
+        results = env.provisioner_controller.last_results
+        assert sum(len(n.pods) for n in results.new_nodes) == 3
+        assert len(results.new_nodes) == 3, "maxPods=1 forces one pod per node"
+        for node in results.new_nodes:
+            assert len(node.pods) == 1
+
+
+class TestResourceLimits:
+    def test_partial_scheduling_when_limits_exceeded(self):
+        # limits admit some pods; the remainder must fail, not the whole batch
+        # (suite_test.go:207-251)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner(limits={"cpu": 4}))
+        for pod in make_pods(6, requests={"cpu": 1.5}):
+            env.kube.create(pod)
+        env.provision()
+        results = env.provisioner_controller.last_results
+        scheduled = sum(len(n.pods) for n in results.new_nodes)
+        assert 0 < scheduled < 6
+        assert len(results.unschedulable) == 6 - scheduled
+        for err in results.unschedulable.values():
+            assert "limits" in err
+
+    def test_extended_resource_limits(self):
+        # the GPU-limits analog (:264): extended-resource limits cap launches
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        gpu_type = instance_type(
+            "gpu-box", cpu=8, memory="16Gi", price=5.0, offerings=od,
+            resources={"vendor.com/gpu": 2},
+        )
+        env = Environment(instance_types=[gpu_type])
+        env.kube.create(make_provisioner(limits={"vendor.com/gpu": 2}))
+        for pod in make_pods(2, requests={"vendor.com/gpu": 2, "cpu": 1}):
+            env.kube.create(pod)
+        env.provision()
+        results = env.provisioner_controller.last_results
+        # one node fits under the 2-gpu limit; the second pod exceeds it
+        assert sum(len(n.pods) for n in results.new_nodes) == 1
+        assert len(results.unschedulable) == 1
+
+
+class TestDaemonSetOverhead:
+    def _daemonset(self, env, requests=None, limits=None, node_selector=None, node_requirements=None, tolerations=None):
+        from karpenter_tpu.api.objects import DaemonSet
+
+        template = make_pod(
+            requests=requests,
+            limits=limits,
+            node_selector=node_selector,
+            node_requirements=node_requirements,
+            tolerations=tolerations,
+            unschedulable=False,
+        )
+        env.kube.create(DaemonSet(metadata=template.metadata, spec_template=template))
+
+    def test_accounts_for_overhead(self):
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        self._daemonset(env, requests={"cpu": 1, "memory": "1Gi"})
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"})
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        # ds(1cpu) + pod(1cpu) doesn't fit the 2cpu type: the 4cpu type wins
+        assert node.instance_type_options[0].name() == "large"
+
+    def test_accounts_for_overhead_with_startup_taint(self):
+        # startup taints don't exempt daemonsets from overhead accounting
+        # (suite_test.go:296)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner(startup_taints=[Taint(key="foo.com/taint", effect="NoSchedule")]))
+        self._daemonset(env, requests={"cpu": 1, "memory": "1Gi"})
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"})
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        assert node.instance_type_options[0].name() == "large"
+
+    def test_oversized_overhead_blocks_scheduling(self):
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        self._daemonset(env, requests={"cpu": 10000, "memory": "10000Gi"})
+        pod = make_pod(requests={"cpu": 0.1})
+        env.kube.create(pod)
+        env.provision()
+        assert node_of(env, pod.name) is None
+
+    def test_limits_only_daemonset_counts_as_requests(self):
+        # requests default from limits (suite_test.go:326)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        self._daemonset(env, limits={"cpu": 10000, "memory": "10000Gi"})
+        pod = make_pod(requests={"cpu": 0.1})
+        env.kube.create(pod)
+        env.provision()
+        assert node_of(env, pod.name) is None
+
+    def test_ignores_daemonsets_without_matching_tolerations(self):
+        # the provisioner is tainted; a daemonset that doesn't tolerate it
+        # will never run there, so its overhead must not count (:394)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner(taints=[Taint(key="foo", value="bar", effect="NoSchedule")]))
+        self._daemonset(env, requests={"cpu": 1, "memory": "1Gi"})
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"}, tolerations=[Toleration(operator="Exists")])
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        assert node.instance_type_options[0].name() == "small", "no overhead: the 2cpu type suffices"
+
+    def test_ignores_daemonsets_with_incompatible_selector(self):
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        self._daemonset(env, requests={"cpu": 1, "memory": "1Gi"}, node_selector={"node": "invalid"})
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"})
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        assert node.instance_type_options[0].name() == "small"
+
+    def test_accounts_daemonsets_with_notin_unspecified_key(self):
+        # NotIn on a key the template doesn't define is compatible (:430)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        self._daemonset(
+            env,
+            requests={"cpu": 1, "memory": "1Gi"},
+            node_requirements=[NodeSelectorRequirement("foo", OP_NOT_IN, ["bar"])],
+        )
+        pod = make_pod(
+            requests={"cpu": 1, "memory": "1Gi"},
+            node_requirements=[NodeSelectorRequirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])],
+        )
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        assert node.instance_type_options[0].name() == "large"
+
+
+class TestVolumeTopologyDepth:
+    def _pvc(self, env, name, storage_class=None, volume_name=""):
+        env.kube.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                storage_class_name=storage_class,
+                volume_name=volume_name,
+            )
+        )
+
+    def test_valid_pods_schedule_when_sibling_has_invalid_pvc(self):
+        # one pod references a missing PVC; its siblings must still schedule
+        # (suite_test.go:553)
+        env = Environment(instance_types=sized_types())
+        env.kube.create(make_provisioner())
+        bad = make_pod(requests={"cpu": 0.1}, pvcs=["missing-claim"])
+        good = make_pod(requests={"cpu": 0.1})
+        env.kube.create(bad)
+        env.kube.create(good)
+        env.provision()
+        assert node_of(env, good.name) is not None
+        assert node_of(env, bad.name) is None
+
+    def test_schedules_to_storage_class_zones(self):
+        # unbound volume: the storage class's allowed zones constrain the pod
+        # (suite_test.go:573)
+        env = Environment(instance_types=instance_types(5))
+        env.kube.create(make_provisioner())
+        env.kube.create(StorageClass(metadata=ObjectMeta(name="zonal", namespace=""), zones=["test-zone-3"]))
+        self._pvc(env, "claim-sc", storage_class="zonal")
+        pod = make_pod(requests={"cpu": 0.1}, pvcs=["claim-sc"])
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        zone_req = node.requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
+        assert zone_req is not None and zone_req.has("test-zone-3")
+        assert not zone_req.has("test-zone-1")
+
+    def test_incompatible_storage_class_zone_fails(self):
+        env = Environment(instance_types=instance_types(5))
+        env.kube.create(make_provisioner())
+        env.kube.create(StorageClass(metadata=ObjectMeta(name="nowhere", namespace=""), zones=["test-zone-unknown"]))
+        self._pvc(env, "claim-bad-sc", storage_class="nowhere")
+        pod = make_pod(
+            requests={"cpu": 0.1},
+            pvcs=["claim-bad-sc"],
+            node_requirements=[NodeSelectorRequirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])],
+        )
+        env.kube.create(pod)
+        env.provision()
+        assert node_of(env, pod.name) is None
+
+    def test_schedules_to_bound_volume_zone(self):
+        # bound volume: the PV's zone wins (suite_test.go:596)
+        env = Environment(instance_types=instance_types(5))
+        env.kube.create(make_provisioner())
+        env.kube.create(PersistentVolume(metadata=ObjectMeta(name="pv-bound", namespace=""), zones=["test-zone-2"]))
+        self._pvc(env, "claim-bound", volume_name="pv-bound")
+        pod = make_pod(requests={"cpu": 0.1}, pvcs=["claim-bound"])
+        env.kube.create(pod)
+        env.provision()
+        node = node_of(env, pod.name)
+        assert node is not None
+        zone_req = node.requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
+        assert zone_req is not None and zone_req.has("test-zone-2")
+        assert not zone_req.has("test-zone-1")
+
+    def test_incompatible_bound_volume_zone_fails(self):
+        env = Environment(instance_types=instance_types(5))
+        env.kube.create(make_provisioner())
+        env.kube.create(PersistentVolume(metadata=ObjectMeta(name="pv-off", namespace=""), zones=["test-zone-2"]))
+        self._pvc(env, "claim-off", volume_name="pv-off")
+        pod = make_pod(
+            requests={"cpu": 0.1},
+            pvcs=["claim-off"],
+            node_requirements=[NodeSelectorRequirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-1"])],
+        )
+        env.kube.create(pod)
+        env.provision()
+        assert node_of(env, pod.name) is None

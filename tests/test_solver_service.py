@@ -159,6 +159,27 @@ class TestRuntimeIntegration:
             LeaderElector._leader = None
             server.stop(grace=0.5)
 
+    def test_kubelet_max_pods_caps_remote_launch_options(self, service):
+        # the remote universe must carry the same maxPods cap as the local
+        # build: the client materializes launch options from it, and an
+        # uncapped option would launch nodes at native pod density
+        from karpenter_tpu.api.provisioner import KubeletConfiguration
+
+        client, handler = service
+        provisioner = make_provisioner(kubelet_configuration=KubeletConfiguration(max_pods=1))
+        from karpenter_tpu.scheduler.builder import apply_kubelet_max_pods
+
+        types = {
+            provisioner.name: apply_kubelet_max_pods(
+                provisioner, FakeCloudProvider(instance_types(6)).get_instance_types(provisioner)
+            )
+        }
+        results = client.solve([provisioner], types, make_pods(3, requests={"cpu": 0.1}))
+        assert sum(len(n.pods) for n in results.new_nodes) == 3
+        assert len(results.new_nodes) == 3, "maxPods=1 must split nodes on the remote path"
+        for node in results.new_nodes:
+            assert all(it.resources().get("pods") == 1.0 for it in node.instance_type_options)
+
     def test_sub_crossover_batches_stay_local_despite_sidecar(self):
         # below the host/device crossover the wire trip loses on latency AND
         # node cost, so a configured sidecar must not see tiny batches
