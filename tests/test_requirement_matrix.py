@@ -19,23 +19,27 @@ from karpenter_tpu.api.objects import OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN
 from karpenter_tpu.scheduling.requirement import INF, Requirement
 
 
-def reqs():
-    return {
-        "exists": Requirement("key", OP_EXISTS),
-        "doesNotExist": Requirement("key", OP_DOES_NOT_EXIST),
-        "inA": Requirement("key", OP_IN, "A"),
-        "inB": Requirement("key", OP_IN, "B"),
-        "inAB": Requirement("key", OP_IN, "A", "B"),
-        "notInA": Requirement("key", OP_NOT_IN, "A"),
-        "in1": Requirement("key", OP_IN, "1"),
-        "in9": Requirement("key", OP_IN, "9"),
-        "in19": Requirement("key", OP_IN, "1", "9"),
-        "notIn12": Requirement("key", OP_NOT_IN, "1", "2"),
-        "greaterThan1": Requirement("key", OP_GT, "1"),
-        "greaterThan9": Requirement("key", OP_GT, "9"),
-        "lessThan1": Requirement("key", OP_LT, "1"),
-        "lessThan9": Requirement("key", OP_LT, "9"),
-    }
+# the one spec table both matrices build from (requirement_test.go:29-42)
+SPECS = {
+    "exists": (OP_EXISTS,),
+    "doesNotExist": (OP_DOES_NOT_EXIST,),
+    "inA": (OP_IN, "A"),
+    "inB": (OP_IN, "B"),
+    "inAB": (OP_IN, "A", "B"),
+    "notInA": (OP_NOT_IN, "A"),
+    "in1": (OP_IN, "1"),
+    "in9": (OP_IN, "9"),
+    "in19": (OP_IN, "1", "9"),
+    "notIn12": (OP_NOT_IN, "1", "2"),
+    "greaterThan1": (OP_GT, "1"),
+    "greaterThan9": (OP_GT, "9"),
+    "lessThan1": (OP_LT, "1"),
+    "lessThan9": (OP_LT, "9"),
+}
+
+
+def reqs(key: str = "key"):
+    return {name: Requirement(key, spec[0], *spec[1:]) for name, spec in SPECS.items()}
 
 
 # probe values covering every region the 14 requirements partition:
@@ -90,6 +94,64 @@ class TestIntersectionMatrix:
 
         out = table["inAB"].intersection(table["notInA"])
         assert not out.complement and out.values == {"B"}
+
+
+class TestCompatibleMatrix:
+    """requirements_test.go:48-290 — the full 15x15 Compatible matrix over a
+    well-known key (zone), transcribed exactly. Compatible = non-empty
+    intersection, with the NotIn/DoesNotExist-pair escape (both sides allow
+    the label to be absent)."""
+
+    NAMES = [
+        "unconstrained", "exists", "doesNotExist", "inA", "inB", "inAB", "notInA",
+        "in1", "in9", "in19", "notIn12", "greaterThan1", "greaterThan9", "lessThan1", "lessThan9",
+    ]
+    ALL = set(NAMES)
+    COMPATIBLE_WITH = {
+        "unconstrained": ALL,
+        "exists": ALL - {"doesNotExist"},
+        "doesNotExist": {"unconstrained", "doesNotExist", "notInA", "notIn12"},
+        "inA": {"unconstrained", "exists", "inA", "inAB", "notIn12"},
+        "inB": {"unconstrained", "exists", "inB", "inAB", "notInA", "notIn12"},
+        "inAB": {"unconstrained", "exists", "inA", "inB", "inAB", "notInA", "notIn12"},
+        "notInA": ALL - {"inA"},
+        "in1": {"unconstrained", "exists", "notInA", "in1", "in19", "lessThan9"},
+        "in9": {"unconstrained", "exists", "notInA", "in9", "in19", "notIn12", "greaterThan1"},
+        "in19": {"unconstrained", "exists", "notInA", "in1", "in9", "in19", "notIn12", "greaterThan1", "lessThan9"},
+        "notIn12": ALL - {"in1"},
+        "greaterThan1": {"unconstrained", "exists", "notInA", "in9", "in19", "notIn12", "greaterThan1", "greaterThan9", "lessThan9"},
+        "greaterThan9": {"unconstrained", "exists", "notInA", "notIn12", "greaterThan1", "greaterThan9"},
+        "lessThan1": {"unconstrained", "exists", "notInA", "notIn12", "lessThan1", "lessThan9"},
+        "lessThan9": {"unconstrained", "exists", "notInA", "in1", "in19", "notIn12", "greaterThan1", "lessThan1", "lessThan9"},
+    }
+
+    @staticmethod
+    def _zone_reqs():
+        from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE as ZONE
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        out = {"unconstrained": Requirements()}
+        out.update({name: Requirements(req) for name, req in reqs(key=ZONE).items()})
+        return out
+
+    @pytest.mark.parametrize("a_name", NAMES)
+    def test_row(self, a_name):
+        table = self._zone_reqs()
+        for b_name in self.NAMES:
+            err = table[a_name].compatible(table[b_name])
+            expected_ok = b_name in self.COMPATIBLE_WITH[a_name]
+            assert (err is None) == expected_ok, (
+                f"{a_name}.compatible({b_name}) = {err!r}, expected {'ok' if expected_ok else 'error'}"
+            )
+
+    def test_normalizes_aliased_labels(self):
+        # requirements_test.go:25-29
+        from karpenter_tpu.api.labels import LABEL_TOPOLOGY_ZONE as ZONE
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        reqs = Requirements(Requirement("failure-domain.beta.kubernetes.io/zone", OP_IN, "test"))
+        assert not reqs.has("failure-domain.beta.kubernetes.io/zone")
+        assert reqs.get(ZONE).has("test")
 
 
 class TestHasMatrix:
