@@ -1,0 +1,430 @@
+"""Deployment manifest generator: the Helm-chart analog.
+
+The reference ships charts/karpenter (Deployment, RBAC, webhooks, CRDs,
+settings ConfigMaps, PDB, Service/ServiceMonitor). This framework's
+deployment surface is generated from the SAME sources of truth the runtime
+uses — `utils/options.py` for flags/ports, `config.py` for the
+global-settings ConfigMap, the webhook server's port for admission wiring —
+so the manifests cannot drift from the binaries.
+
+    python -m karpenter_tpu.cmd.gen_manifests > deploy/karpenter-tpu.yaml
+    python -m karpenter_tpu.cmd.gen_manifests --solver-sidecar --tpu-resource google.com/tpu=1
+
+Renders plain YAML (kubectl-appliable); parameterization covers what the
+chart's values.yaml exposes where it applies to this runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional
+
+from ..config import CONFIGMAP_NAME, DEFAULT_CONFIGMAP_DATA
+from ..utils.options import Options
+
+APP_LABELS = {"app.kubernetes.io/name": "karpenter-tpu", "app.kubernetes.io/instance": "karpenter-tpu"}
+WEBHOOK_LABELS = {"app.kubernetes.io/name": "karpenter-tpu-webhook", "app.kubernetes.io/instance": "karpenter-tpu"}
+
+
+def _meta(name: str, namespace: Optional[str], labels: Dict[str, str]) -> Dict:
+    meta = {"name": name, "labels": dict(labels)}
+    if namespace is not None:
+        meta["namespace"] = namespace
+    return meta
+
+
+def crd_provisioner() -> Dict:
+    """karpenter.sh/v1alpha5 Provisioner — structural schema; the deep rule
+    set (api/provisioner.py validate()) runs in the validating webhook, the
+    same split the reference uses."""
+    requirement = {
+        "type": "object",
+        "required": ["key", "operator"],
+        "properties": {
+            "key": {"type": "string"},
+            "operator": {"type": "string", "enum": ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]},
+            "values": {"type": "array", "items": {"type": "string"}},
+        },
+    }
+    taint = {
+        "type": "object",
+        "required": ["key", "effect"],
+        "properties": {
+            "key": {"type": "string"},
+            "value": {"type": "string"},
+            "effect": {"type": "string", "enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
+        },
+    }
+    spec_props = {
+        "labels": {"type": "object", "additionalProperties": {"type": "string"}},
+        "annotations": {"type": "object", "additionalProperties": {"type": "string"}},
+        "taints": {"type": "array", "items": taint},
+        "startupTaints": {"type": "array", "items": taint},
+        "requirements": {"type": "array", "items": requirement},
+        "kubeletConfiguration": {
+            "type": "object",
+            "properties": {
+                "clusterDNS": {"type": "array", "items": {"type": "string"}},
+                "maxPods": {"type": "integer", "minimum": 1},
+                "podsPerCore": {"type": "integer", "minimum": 1},
+                "systemReserved": {"type": "object", "additionalProperties": True},
+                "kubeReserved": {"type": "object", "additionalProperties": True},
+            },
+        },
+        "limits": {
+            "type": "object",
+            "properties": {"resources": {"type": "object", "additionalProperties": True}},
+        },
+        "provider": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        "providerRef": {"type": "string"},
+        "ttlSecondsAfterEmpty": {"type": "integer", "minimum": 0},
+        "ttlSecondsUntilExpired": {"type": "integer", "minimum": 0},
+        "weight": {"type": "integer", "minimum": 1, "maximum": 100},
+        "consolidation": {"type": "object", "properties": {"enabled": {"type": "boolean"}}},
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "provisioners.karpenter.sh"},
+        "spec": {
+            "group": "karpenter.sh",
+            "names": {"kind": "Provisioner", "listKind": "ProvisionerList", "plural": "provisioners", "singular": "provisioner"},
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": "v1alpha5",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {"type": "object", "properties": spec_props},
+                                "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+
+
+def crd_nodeclass() -> Dict:
+    """NodeClass — the provider-owned template CR (the AWSNodeTemplate
+    analog; cloudprovider/simulated/provider.py NodeClass)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "nodeclasses.karpenter.sh"},
+        "spec": {
+            "group": "karpenter.sh",
+            "names": {"kind": "NodeClass", "listKind": "NodeClassList", "plural": "nodeclasses", "singular": "nodeclass"},
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": "v1alpha1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "properties": {
+                                        "imageFamily": {"type": "string"},
+                                        "imageId": {"type": "string"},
+                                        "userData": {"type": "string"},
+                                        "subnetSelector": {"type": "object", "additionalProperties": {"type": "string"}},
+                                        "securityGroupSelector": {"type": "object", "additionalProperties": {"type": "string"}},
+                                        "securityGroupIds": {"type": "array", "items": {"type": "string"}},
+                                        "tags": {"type": "object", "additionalProperties": {"type": "string"}},
+                                        "includePreviousGeneration": {"type": "boolean"},
+                                    },
+                                }
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def rbac(namespace: str) -> List[Dict]:
+    """Exactly what the runtime touches: watches + writes in kube/client.py
+    and the controllers — no more."""
+    cluster_rules = [
+        # read: the watch set the state cache and scheduler consume
+        {"apiGroups": ["karpenter.sh"], "resources": ["provisioners", "provisioners/status", "nodeclasses"], "verbs": ["get", "list", "watch"]},
+        {"apiGroups": [""], "resources": ["pods", "nodes", "persistentvolumes", "persistentvolumeclaims"], "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["storage.k8s.io"], "resources": ["storageclasses", "csinodes"], "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["apps"], "resources": ["daemonsets"], "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["policy"], "resources": ["poddisruptionbudgets"], "verbs": ["get", "list", "watch"]},
+        # write: node lifecycle + eviction + status
+        {"apiGroups": ["karpenter.sh"], "resources": ["provisioners/status"], "verbs": ["create", "delete", "patch"]},
+        {"apiGroups": [""], "resources": ["nodes"], "verbs": ["create", "patch", "update", "delete"]},
+        {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
+        {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+    ]
+    namespace_rules = [
+        # the karpenter-global-settings / logging ConfigMap watches (config.py)
+        {"apiGroups": [""], "resources": ["configmaps"], "verbs": ["get", "list", "watch"]},
+        # Lease leader election (kube/leaderelection.py)
+        {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"], "verbs": ["get", "list", "watch", "create", "update", "patch"]},
+    ]
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": _meta("karpenter-tpu", namespace, APP_LABELS)},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole", "metadata": _meta("karpenter-tpu", None, APP_LABELS), "rules": cluster_rules},
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": _meta("karpenter-tpu", None, APP_LABELS),
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": "karpenter-tpu"},
+            "subjects": [{"kind": "ServiceAccount", "name": "karpenter-tpu", "namespace": namespace}],
+        },
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role", "metadata": _meta("karpenter-tpu", namespace, APP_LABELS), "rules": namespace_rules},
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": _meta("karpenter-tpu", namespace, APP_LABELS),
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "Role", "name": "karpenter-tpu"},
+            "subjects": [{"kind": "ServiceAccount", "name": "karpenter-tpu", "namespace": namespace}],
+        },
+    ]
+
+
+def configmaps(namespace: str) -> List[Dict]:
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": _meta(CONFIGMAP_NAME, namespace, APP_LABELS),
+            "data": dict(DEFAULT_CONFIGMAP_DATA),
+        }
+    ]
+
+
+def controller_deployment(args) -> Dict:
+    defaults = Options()
+    container_args = [
+        "--cluster-name", args.cluster_name,
+        "--metrics-port", str(defaults.metrics_port),
+        "--health-probe-port", str(defaults.health_probe_port),
+    ]
+    if args.solver_sidecar:
+        container_args += ["--solver-service-address", "127.0.0.1:8433"]
+    containers = [
+        {
+            "name": "controller",
+            "image": args.image,
+            "args": container_args,
+            "env": [
+                {"name": "SYSTEM_NAMESPACE", "valueFrom": {"fieldRef": {"fieldPath": "metadata.namespace"}}},
+            ],
+            "ports": [
+                {"name": "http-metrics", "containerPort": defaults.metrics_port, "protocol": "TCP"},
+                {"name": "http", "containerPort": defaults.health_probe_port, "protocol": "TCP"},
+            ],
+            "livenessProbe": {"httpGet": {"path": "/healthz", "port": "http"}, "initialDelaySeconds": 30, "timeoutSeconds": 30},
+            "readinessProbe": {"httpGet": {"path": "/readyz", "port": "http"}, "timeoutSeconds": 30},
+            "resources": {"requests": {"cpu": "1", "memory": "1Gi"}, "limits": {"cpu": "1", "memory": "1Gi"}},
+        }
+    ]
+    if args.solver_sidecar:
+        sidecar = {
+            "name": "solver",
+            "image": args.image,
+            "command": ["python", "-m", "karpenter_tpu.cmd.solver_service"],
+            "args": ["--address", "127.0.0.1:8433"],
+            "resources": {"requests": {}, "limits": {}},
+        }
+        if args.tpu_resource:
+            name, _, qty = args.tpu_resource.partition("=")
+            sidecar["resources"]["requests"][name] = qty or "1"
+            sidecar["resources"]["limits"][name] = qty or "1"
+        containers.append(sidecar)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta("karpenter-tpu", args.namespace, APP_LABELS),
+        "spec": {
+            "replicas": args.replicas,
+            "revisionHistoryLimit": 10,
+            "strategy": {"rollingUpdate": {"maxUnavailable": 1}},
+            "selector": {"matchLabels": dict(APP_LABELS)},
+            "template": {
+                "metadata": {"labels": dict(APP_LABELS)},
+                "spec": {
+                    "serviceAccountName": "karpenter-tpu",
+                    "priorityClassName": "system-cluster-critical",
+                    "dnsPolicy": "Default",
+                    "containers": containers,
+                    # never schedule onto capacity we manage (chart affinity)
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {"matchExpressions": [{"key": "karpenter.sh/provisioner-name", "operator": "DoesNotExist"}]}
+                                ]
+                            }
+                        }
+                    },
+                    "tolerations": [{"key": "CriticalAddonsOnly", "operator": "Exists"}],
+                },
+            },
+        },
+    }
+
+
+def webhook_bundle(args) -> List[Dict]:
+    """Separate admission process (cmd/webhook.py) with self-managed serving
+    certs (kube/certs.py): the Deployment, its Service, and the admission
+    registrations. caBundle is patched at startup by the webhook process the
+    same way knative's cert rotation does it."""
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta("karpenter-tpu-webhook", args.namespace, WEBHOOK_LABELS),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": dict(WEBHOOK_LABELS)},
+            "template": {
+                "metadata": {"labels": dict(WEBHOOK_LABELS)},
+                "spec": {
+                    "serviceAccountName": "karpenter-tpu",
+                    "containers": [
+                        {
+                            "name": "webhook",
+                            "image": args.image,
+                            "command": ["python", "-m", "karpenter_tpu.cmd.webhook"],
+                            "args": ["--host", "0.0.0.0", "--port", "8443"],
+                            "ports": [{"name": "https-webhook", "containerPort": 8443, "protocol": "TCP"}],
+                            "resources": {"requests": {"cpu": "200m", "memory": "256Mi"}},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta("karpenter-tpu-webhook", args.namespace, WEBHOOK_LABELS),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": dict(WEBHOOK_LABELS),
+            "ports": [{"name": "https-webhook", "port": 443, "targetPort": "https-webhook", "protocol": "TCP"}],
+        },
+    }
+    client_config = {"service": {"name": "karpenter-tpu-webhook", "namespace": args.namespace, "port": 443}}
+    crd_rule = {
+        "apiGroups": ["karpenter.sh"],
+        "apiVersions": ["v1alpha5", "v1alpha1"],
+        "operations": ["CREATE", "UPDATE"],
+        "resources": ["provisioners", "nodeclasses"],
+    }
+    mutating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": _meta("defaulting.webhook.karpenter-tpu.sh", None, WEBHOOK_LABELS),
+        "webhooks": [
+            {
+                "name": "defaulting.webhook.karpenter-tpu.sh",
+                "admissionReviewVersions": ["v1"],
+                "clientConfig": client_config,
+                "rules": [crd_rule],
+                "sideEffects": "None",
+                "failurePolicy": "Fail",
+            }
+        ],
+    }
+    validating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": _meta("validation.webhook.karpenter-tpu.sh", None, WEBHOOK_LABELS),
+        "webhooks": [
+            {
+                "name": "validation.webhook.karpenter-tpu.sh",
+                "admissionReviewVersions": ["v1"],
+                "clientConfig": client_config,
+                "rules": [crd_rule],
+                "sideEffects": "None",
+                "failurePolicy": "Fail",
+            }
+        ],
+    }
+    return [deployment, service, mutating, validating]
+
+
+def stability(namespace: str, service_monitor: bool) -> List[Dict]:
+    defaults = Options()
+    out = [
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": _meta("karpenter-tpu", namespace, APP_LABELS),
+            "spec": {"maxUnavailable": 1, "selector": {"matchLabels": dict(APP_LABELS)}},
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta("karpenter-tpu", namespace, APP_LABELS),
+            "spec": {
+                "type": "ClusterIP",
+                "selector": dict(APP_LABELS),
+                "ports": [{"name": "http-metrics", "port": defaults.metrics_port, "targetPort": "http-metrics", "protocol": "TCP"}],
+            },
+        },
+    ]
+    if service_monitor:
+        out.append(
+            {
+                "apiVersion": "monitoring.coreos.com/v1",
+                "kind": "ServiceMonitor",
+                "metadata": _meta("karpenter-tpu", namespace, APP_LABELS),
+                "spec": {
+                    "selector": {"matchLabels": dict(APP_LABELS)},
+                    "endpoints": [{"port": "http-metrics"}],
+                },
+            }
+        )
+    return out
+
+
+def render(args) -> List[Dict]:
+    docs: List[Dict] = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": args.namespace, "labels": dict(APP_LABELS)}},
+        crd_provisioner(),
+        crd_nodeclass(),
+    ]
+    docs += rbac(args.namespace)
+    docs += configmaps(args.namespace)
+    docs.append(controller_deployment(args))
+    docs += webhook_bundle(args)
+    docs += stability(args.namespace, args.service_monitor)
+    return docs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-gen-manifests", description=__doc__)
+    parser.add_argument("--namespace", default="karpenter")
+    parser.add_argument("--image", default="karpenter-tpu:latest")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--cluster-name", default="cluster")
+    parser.add_argument("--solver-sidecar", action="store_true", help="add the gRPC solver sidecar container")
+    parser.add_argument("--tpu-resource", default="", help="device resource for the sidecar, e.g. google.com/tpu=1")
+    parser.add_argument("--service-monitor", action="store_true", help="emit a prometheus-operator ServiceMonitor")
+    args = parser.parse_args(argv)
+
+    import yaml
+
+    sys.stdout.write(yaml.safe_dump_all(render(args), sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,154 @@
+"""Deployment manifest generator tests: the chart-lint analog.
+
+The manifests are generated from the runtime's own sources of truth, so
+these tests pin the consistency contracts: every container flag is a real
+flag of the binary it targets, probe ports match Options, the admission
+registrations point at the webhook Service, and the settings ConfigMap
+matches config.py's defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from karpenter_tpu.cmd.gen_manifests import main, render
+from karpenter_tpu.config import CONFIGMAP_NAME, DEFAULT_CONFIGMAP_DATA
+from karpenter_tpu.utils.options import Options
+
+
+def _args(**overrides):
+    ns = argparse.Namespace(
+        namespace="karpenter",
+        image="karpenter-tpu:latest",
+        replicas=2,
+        cluster_name="cluster",
+        solver_sidecar=False,
+        tpu_resource="",
+        service_monitor=False,
+    )
+    for key, value in overrides.items():
+        setattr(ns, key, value)
+    return ns
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d["kind"] == kind]
+
+
+class TestManifestBundle:
+    def test_bundle_has_every_chart_object_kind(self):
+        docs = render(_args(service_monitor=True))
+        kinds = {d["kind"] for d in docs}
+        assert kinds >= {
+            "Namespace",
+            "CustomResourceDefinition",
+            "ServiceAccount",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "Role",
+            "RoleBinding",
+            "ConfigMap",
+            "Deployment",
+            "Service",
+            "MutatingWebhookConfiguration",
+            "ValidatingWebhookConfiguration",
+            "PodDisruptionBudget",
+            "ServiceMonitor",
+        }
+        assert len(by_kind(docs, "CustomResourceDefinition")) == 2  # Provisioner + NodeClass
+        assert len(by_kind(docs, "Deployment")) == 2  # controller + webhook
+
+    def test_yaml_round_trips(self, capsys):
+        assert main([]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert all("kind" in d for d in docs)
+
+    def test_controller_args_are_real_flags(self):
+        from karpenter_tpu.utils import options
+
+        docs = render(_args(solver_sidecar=True))
+        controller = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        containers = {c["name"]: c for c in controller["spec"]["template"]["spec"]["containers"]}
+        flags = [a for a in containers["controller"]["args"] if a.startswith("--")]
+        # parse with the REAL parser: unknown or malformed flags abort here
+        parsed = options.parse(containers["controller"]["args"])
+        assert parsed.solver_service_address == "127.0.0.1:8433"
+        assert flags, "controller must be configured through flags"
+
+    def test_probe_ports_match_options(self):
+        defaults = Options()
+        docs = render(_args())
+        controller = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        container = controller["spec"]["template"]["spec"]["containers"][0]
+        ports = {p["name"]: p["containerPort"] for p in container["ports"]}
+        assert ports["http-metrics"] == defaults.metrics_port
+        assert ports["http"] == defaults.health_probe_port
+        metrics_service = next(d for d in by_kind(docs, "Service") if d["metadata"]["name"] == "karpenter-tpu")
+        assert metrics_service["spec"]["ports"][0]["port"] == defaults.metrics_port
+
+    def test_settings_configmap_matches_config_defaults(self):
+        docs = render(_args())
+        cm = next(d for d in by_kind(docs, "ConfigMap") if d["metadata"]["name"] == CONFIGMAP_NAME)
+        assert cm["data"] == DEFAULT_CONFIGMAP_DATA
+
+    def test_webhook_registrations_point_at_webhook_service(self):
+        docs = render(_args())
+        service_names = {d["metadata"]["name"] for d in by_kind(docs, "Service")}
+        for kind in ("MutatingWebhookConfiguration", "ValidatingWebhookConfiguration"):
+            cfg = by_kind(docs, kind)[0]
+            client = cfg["webhooks"][0]["clientConfig"]["service"]
+            assert client["name"] in service_names
+            assert client["namespace"] == "karpenter"
+            rules = cfg["webhooks"][0]["rules"][0]
+            assert "provisioners" in rules["resources"] and "nodeclasses" in rules["resources"]
+
+    def test_sidecar_carries_tpu_resource(self):
+        docs = render(_args(solver_sidecar=True, tpu_resource="google.com/tpu=4"))
+        controller = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        containers = {c["name"]: c for c in controller["spec"]["template"]["spec"]["containers"]}
+        assert containers["solver"]["resources"]["requests"] == {"google.com/tpu": "4"}
+        assert containers["solver"]["resources"]["limits"] == {"google.com/tpu": "4"}
+
+    def test_controller_never_schedules_on_managed_capacity(self):
+        docs = render(_args())
+        controller = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        terms = controller["spec"]["template"]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert any(
+            expr["key"] == "karpenter.sh/provisioner-name" and expr["operator"] == "DoesNotExist"
+            for term in terms
+            for expr in term["matchExpressions"]
+        )
+
+    def test_rbac_covers_runtime_verbs(self):
+        docs = render(_args())
+        cluster_role = by_kind(docs, "ClusterRole")[0]
+        flat = [(g, r, v) for rule in cluster_role["rules"] for g in rule["apiGroups"] for r in rule["resources"] for v in rule["verbs"]]
+        assert ("", "pods/eviction", "create") in flat, "termination drains via the eviction API"
+        assert ("", "nodes", "create") in flat and ("", "nodes", "delete") in flat
+        assert ("karpenter.sh", "provisioners", "watch") in flat
+        role = by_kind(docs, "Role")[0]
+        lease_verbs = {v for rule in role["rules"] if "leases" in rule["resources"] for v in rule["verbs"]}
+        assert {"create", "update"} <= lease_verbs, "Lease leader election needs CAS writes"
+
+    def test_rendered_files_in_sync(self):
+        # deploy/*.yaml are the checked-in renders; regenerating must be a
+        # no-op (the docgen-in-sync discipline, like METRICS.md)
+        import io
+        import pathlib
+        from contextlib import redirect_stdout
+
+        for path, argv in (
+            ("deploy/karpenter-tpu.yaml", []),
+            ("deploy/karpenter-tpu-sidecar.yaml", ["--solver-sidecar", "--tpu-resource", "google.com/tpu=1", "--service-monitor"]),
+        ):
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                main(argv)
+            on_disk = pathlib.Path(__file__).resolve().parent.parent / path
+            assert buf.getvalue() == on_disk.read_text(), f"{path} is stale; re-run gen_manifests"
