@@ -473,6 +473,27 @@ class Lease:
     kind = "Lease"
 
 
+@dataclass
+class MutatingWebhookConfiguration:
+    """admissionregistration.k8s.io/v1 — the defaulting registration. The
+    webhooks array stays wire-shaped (raw dicts): the apiserver consumes
+    clientConfig/rules directly and the webhook process patches
+    caBundle/url into it at startup (cmd/webhook.py)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[dict] = field(default_factory=list)
+
+    kind = "MutatingWebhookConfiguration"
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[dict] = field(default_factory=list)
+
+    kind = "ValidatingWebhookConfiguration"
+
+
 def resource_list(**kwargs) -> Dict[str, float]:
     """Convenience builder: resource_list(cpu='100m', memory='1Gi') -> floats.
 

@@ -149,6 +149,11 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
     spec = provisioner.spec
 
     errs.extend(f"metadata: {e}" for e in lbl.dns1123_name_errors(provisioner.metadata.name))
+    # the name is minted into the karpenter.sh/provisioner-name node LABEL,
+    # whose value caps at 63 characters — a longer name would launch nodes
+    # the apiserver rejects
+    if len(provisioner.metadata.name) > 63:
+        errs.append(f"metadata: name {provisioner.metadata.name!r} must be at most 63 characters")
 
     # labels (validateLabels): restricted keys incl. the provisioner-name
     # label itself, plus key/value syntax

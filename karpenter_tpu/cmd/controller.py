@@ -16,31 +16,48 @@ import sys
 import time
 
 
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
 def build_kube_backend(options):
-    """Select the cluster backend (controllers.go:86-103's config step)."""
+    """Select the cluster backend (controllers.go:86-103's config step):
+    --apiserver-url wins; else the in-cluster serviceaccount credential set
+    (rest.InClusterConfig: $KUBERNETES_SERVICE_HOST + mounted token/ca.crt);
+    else the in-memory simulation backend."""
     url = options.apiserver_url
+    ca_file = token_file = None
     if not url and os.environ.get("KUBERNETES_SERVICE_HOST"):
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         if ":" in host:  # IPv6 service host
             host = f"[{host}]"
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        if port in ("443", "6443"):
-            # the real in-cluster endpoint is TLS + token auth, which this
-            # client does not speak yet — refuse a plain-HTTP dial that can
-            # only fail, and fall back to the simulation backend loudly
+        token_path = os.path.join(SERVICEACCOUNT_DIR, "token")
+        ca_path = os.path.join(SERVICEACCOUNT_DIR, "ca.crt")
+        if os.path.exists(token_path):
+            url = f"https://{host}:{port}"
+            ca_file, token_file = ca_path, token_path
+        else:
             print(
-                "karpenter-tpu: in-cluster apiserver detected on TLS port "
-                f"{port}; plain-HTTP client unsupported there — set "
-                "--apiserver-url to an HTTP endpoint or run in-memory",
+                "karpenter-tpu: in-cluster apiserver detected but no "
+                f"serviceaccount token at {token_path}; falling back to the "
+                "in-memory backend — set --apiserver-url to override",
                 file=sys.stderr,
             )
-        else:
-            url = f"http://{host}:{port}"
     if url:
         from ..kube.client import HttpKubeClient
         from ..utils.clock import Clock
 
-        return HttpKubeClient(url, qps=options.kube_client_qps, burst=options.kube_client_burst, clock=Clock()), url
+        return (
+            HttpKubeClient(
+                url,
+                qps=options.kube_client_qps,
+                burst=options.kube_client_burst,
+                clock=Clock(),
+                ca_file=ca_file,
+                token_file=token_file,
+            ),
+            url,
+        )
     from ..kube.cluster import KubeCluster
 
     return KubeCluster(), ""
@@ -55,6 +72,19 @@ def main(argv=None) -> int:
     kube, url = build_kube_backend(options)
     provider = FakeCloudProvider()
     runtime = Runtime(kube=kube, cloud_provider=provider, options=options)
+
+    # probes + /metrics serve from the moment the process is up — BEFORE
+    # runtime.start(), which blocks on leader election: a standby replica
+    # must still answer kubelet probes (controllers.go:167-181)
+    from ..observability import ObservabilityServer
+
+    obs = ObservabilityServer(
+        healthy=runtime.healthy,
+        ready=lambda: runtime.ready() and runtime.healthy(),
+        health_port=options.health_probe_port,
+        metrics_port=options.metrics_port,
+    )
+    obs.start()
     runtime.start()
 
     stop = {"flag": False}
@@ -71,6 +101,7 @@ def main(argv=None) -> int:
             time.sleep(0.5)
     finally:
         runtime.stop()
+        obs.stop()
     return 0
 
 

@@ -22,9 +22,11 @@ from typing import Dict, List, Optional
 
 from ..config import CONFIGMAP_NAME, DEFAULT_CONFIGMAP_DATA
 from ..utils.options import Options
+from .webhook import ADMISSION_RULE, DEFAULT_WEBHOOK_PORT, MUTATING_NAME, VALIDATING_NAME
 
 APP_LABELS = {"app.kubernetes.io/name": "karpenter-tpu", "app.kubernetes.io/instance": "karpenter-tpu"}
 WEBHOOK_LABELS = {"app.kubernetes.io/name": "karpenter-tpu-webhook", "app.kubernetes.io/instance": "karpenter-tpu"}
+SOLVER_SIDECAR_ADDR = "127.0.0.1:8433"
 
 
 def _meta(name: str, namespace: Optional[str], labels: Dict[str, str]) -> Dict:
@@ -80,7 +82,8 @@ def crd_provisioner() -> Dict:
         "providerRef": {"type": "string"},
         "ttlSecondsAfterEmpty": {"type": "integer", "minimum": 0},
         "ttlSecondsUntilExpired": {"type": "integer", "minimum": 0},
-        "weight": {"type": "integer", "minimum": 1, "maximum": 100},
+        # [0, 100], matching the webhook's validate() (api/provisioner.py)
+        "weight": {"type": "integer", "minimum": 0, "maximum": 100},
         "consolidation": {"type": "object", "properties": {"enabled": {"type": "boolean"}}},
     }
     return {
@@ -169,6 +172,25 @@ def rbac(namespace: str) -> List[Dict]:
         {"apiGroups": [""], "resources": ["nodes"], "verbs": ["create", "patch", "update", "delete"]},
         {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
         {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+        # the webhook patches its serving CA bundle into its own
+        # registrations at startup (cmd/webhook.py register_configurations)
+        {
+            "apiGroups": ["admissionregistration.k8s.io"],
+            "resources": ["mutatingwebhookconfigurations", "validatingwebhookconfigurations"],
+            "verbs": ["get", "list", "watch", "create"],
+        },
+        {
+            "apiGroups": ["admissionregistration.k8s.io"],
+            "resources": ["mutatingwebhookconfigurations"],
+            "verbs": ["update"],
+            "resourceNames": [MUTATING_NAME],
+        },
+        {
+            "apiGroups": ["admissionregistration.k8s.io"],
+            "resources": ["validatingwebhookconfigurations"],
+            "verbs": ["update"],
+            "resourceNames": [VALIDATING_NAME],
+        },
     ]
     namespace_rules = [
         # the karpenter-global-settings / logging ConfigMap watches (config.py)
@@ -216,7 +238,7 @@ def controller_deployment(args) -> Dict:
         "--health-probe-port", str(defaults.health_probe_port),
     ]
     if args.solver_sidecar:
-        container_args += ["--solver-service-address", "127.0.0.1:8433"]
+        container_args += ["--solver-service-address", SOLVER_SIDECAR_ADDR]
     containers = [
         {
             "name": "controller",
@@ -239,7 +261,7 @@ def controller_deployment(args) -> Dict:
             "name": "solver",
             "image": args.image,
             "command": ["python", "-m", "karpenter_tpu.cmd.solver_service"],
-            "args": ["--address", "127.0.0.1:8433"],
+            "args": ["--address", SOLVER_SIDECAR_ADDR],
             "resources": {"requests": {}, "limits": {}},
         }
         if args.tpu_resource:
@@ -301,8 +323,12 @@ def webhook_bundle(args) -> List[Dict]:
                             "name": "webhook",
                             "image": args.image,
                             "command": ["python", "-m", "karpenter_tpu.cmd.webhook"],
-                            "args": ["--host", "0.0.0.0", "--port", "8443"],
-                            "ports": [{"name": "https-webhook", "containerPort": 8443, "protocol": "TCP"}],
+                            "args": ["--host", "0.0.0.0", "--port", str(DEFAULT_WEBHOOK_PORT)],
+                            "env": [
+                                # the serving cert needs the Service DNS SANs
+                                {"name": "SYSTEM_NAMESPACE", "valueFrom": {"fieldRef": {"fieldPath": "metadata.namespace"}}},
+                            ],
+                            "ports": [{"name": "https-webhook", "containerPort": DEFAULT_WEBHOOK_PORT, "protocol": "TCP"}],
                             "resources": {"requests": {"cpu": "200m", "memory": "256Mi"}},
                         }
                     ],
@@ -321,19 +347,14 @@ def webhook_bundle(args) -> List[Dict]:
         },
     }
     client_config = {"service": {"name": "karpenter-tpu-webhook", "namespace": args.namespace, "port": 443}}
-    crd_rule = {
-        "apiGroups": ["karpenter.sh"],
-        "apiVersions": ["v1alpha5", "v1alpha1"],
-        "operations": ["CREATE", "UPDATE"],
-        "resources": ["provisioners", "nodeclasses"],
-    }
+    crd_rule = dict(ADMISSION_RULE)  # one rule definition, shared with the webhook's self-registration
     mutating = {
         "apiVersion": "admissionregistration.k8s.io/v1",
         "kind": "MutatingWebhookConfiguration",
-        "metadata": _meta("defaulting.webhook.karpenter-tpu.sh", None, WEBHOOK_LABELS),
+        "metadata": _meta(MUTATING_NAME, None, WEBHOOK_LABELS),
         "webhooks": [
             {
-                "name": "defaulting.webhook.karpenter-tpu.sh",
+                "name": MUTATING_NAME,
                 "admissionReviewVersions": ["v1"],
                 "clientConfig": client_config,
                 "rules": [crd_rule],
@@ -345,10 +366,10 @@ def webhook_bundle(args) -> List[Dict]:
     validating = {
         "apiVersion": "admissionregistration.k8s.io/v1",
         "kind": "ValidatingWebhookConfiguration",
-        "metadata": _meta("validation.webhook.karpenter-tpu.sh", None, WEBHOOK_LABELS),
+        "metadata": _meta(VALIDATING_NAME, None, WEBHOOK_LABELS),
         "webhooks": [
             {
-                "name": "validation.webhook.karpenter-tpu.sh",
+                "name": VALIDATING_NAME,
                 "admissionReviewVersions": ["v1"],
                 "clientConfig": client_config,
                 "rules": [crd_rule],

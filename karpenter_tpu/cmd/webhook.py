@@ -1,21 +1,89 @@
 """Admission webhook entry point (cmd/webhook/main.go analog).
 
-    python -m karpenter_tpu.cmd.webhook --port 8443 [--register URL]
+    python -m karpenter_tpu.cmd.webhook --port 8443 [--apiserver-url URL]
 
 Serves the AdmissionReview protocol over HTTPS with self-managed serving
-certs (the knative cert-rotation analog, kube/certs.py). With --register,
-posts its webhook configuration (mutate/validate URLs + CA bundle) to a
-karpenter-tpu apiserver's /register-webhooks convenience endpoint; against
-a real apiserver the same material goes into Mutating/Validating
-WebhookConfiguration objects.
+certs (the knative cert-rotation analog, kube/certs.py). With
+--apiserver-url (or $KUBERNETES_APISERVER_URL), it upserts its own
+Mutating/Validating WebhookConfiguration objects at startup — patching the
+serving CA bundle (and, when no service ref resolves, its direct URL) into
+the registrations the way knative's cert controller does. kubectl-applied
+configurations from deploy/ are completed in place; absent ones are created.
 """
 
 from __future__ import annotations
 
 import argparse
+import base64
+import os
 import signal
 import sys
 import threading
+
+DEFAULT_WEBHOOK_PORT = 8443
+WEBHOOK_SERVICE_NAME = "karpenter-tpu-webhook"
+MUTATING_NAME = "defaulting.webhook.karpenter-tpu.sh"
+VALIDATING_NAME = "validation.webhook.karpenter-tpu.sh"
+
+
+def service_dns_sans(namespace: str) -> list:
+    """The names a real apiserver dials for a service-ref registration."""
+    return [
+        WEBHOOK_SERVICE_NAME,
+        f"{WEBHOOK_SERVICE_NAME}.{namespace}",
+        f"{WEBHOOK_SERVICE_NAME}.{namespace}.svc",
+        f"{WEBHOOK_SERVICE_NAME}.{namespace}.svc.cluster.local",
+    ]
+ADMISSION_RULE = {
+    "apiGroups": ["karpenter.sh"],
+    "apiVersions": ["v1alpha5", "v1alpha1"],
+    "operations": ["CREATE", "UPDATE"],
+    "resources": ["provisioners", "nodeclasses"],
+}
+
+
+def register_configurations(client, server_url: str, ca_pem: bytes, advertise_url: str = "") -> None:
+    """Upsert the admission registrations with this server's CA bundle.
+
+    A configuration that carries a service ref keeps it (in-cluster routing)
+    and only gains the caBundle; one without gets the direct URL — the form
+    the apiserver emulator dispatches."""
+    from ..api.objects import MutatingWebhookConfiguration, ObjectMeta, ValidatingWebhookConfiguration
+    from ..kube.client import ApiStatusError, Conflict
+
+    bundle = base64.b64encode(ca_pem).decode()
+    url = advertise_url or server_url
+
+    for cls, name, path in (
+        (MutatingWebhookConfiguration, MUTATING_NAME, "/mutate"),
+        (ValidatingWebhookConfiguration, VALIDATING_NAME, "/validate"),
+    ):
+        current = client.get(cls.kind, name, namespace="")
+        if current is None:
+            cfg = cls(
+                metadata=ObjectMeta(name=name, namespace=""),
+                webhooks=[
+                    {
+                        "name": name,
+                        "admissionReviewVersions": ["v1"],
+                        "clientConfig": {"url": url + path, "caBundle": bundle},
+                        "rules": [dict(ADMISSION_RULE)],
+                        "sideEffects": "None",
+                        "failurePolicy": "Fail",
+                    }
+                ],
+            )
+            try:
+                client.create(cfg)
+            except (ApiStatusError, Conflict):
+                current = client.get(cls.kind, name, namespace="")  # lost the create race
+        if current is not None:
+            for hook in current.webhooks:
+                cc = hook.setdefault("clientConfig", {})
+                cc["caBundle"] = bundle
+                if not cc.get("service"):
+                    cc["url"] = url + path
+            client.update(current)
 
 
 def main(argv=None) -> int:
@@ -25,13 +93,39 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(prog="karpenter-tpu-webhook")
     parser.add_argument("--host", default="127.0.0.1")
-    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--port", type=int, default=DEFAULT_WEBHOOK_PORT)
     parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--apiserver-url",
+        default=os.environ.get("KUBERNETES_APISERVER_URL", ""),
+        help="upsert the WebhookConfiguration objects (caBundle + url) against this apiserver",
+    )
+    parser.add_argument(
+        "--advertise-url", default="", help="external URL the apiserver should dial (default: the serving address)"
+    )
     args = parser.parse_args(argv)
     configure(args.log_level)
 
-    server = AdmissionWebhookServer(host=args.host, port=args.port, cloud_provider=FakeCloudProvider())
+    # in-cluster, the apiserver dials the Service DNS name: the serving cert
+    # must carry those SANs ($SYSTEM_NAMESPACE is injected by the generated
+    # Deployment)
+    namespace = os.environ.get("SYSTEM_NAMESPACE", "")
+    server = AdmissionWebhookServer(
+        host=args.host,
+        port=args.port,
+        cloud_provider=FakeCloudProvider(),
+        extra_sans=service_dns_sans(namespace) if namespace else None,
+    )
     server.start()
+    # the same backend selection as the controller: explicit URL, else the
+    # in-cluster serviceaccount credential set
+    from ..utils.options import Options
+    from .controller import build_kube_backend
+
+    client, url = build_kube_backend(Options(apiserver_url=args.apiserver_url))
+    if url:
+        register_configurations(client, server.url, server.cert.ca_pem, args.advertise_url)
+        print(f"karpenter-tpu webhook registered configurations at {url}", file=sys.stderr)
     print(f"karpenter-tpu webhook serving AdmissionReview at {server.url} (CA bundle on stdout below)", file=sys.stderr)
     print(server.cert.ca_pem.decode(), flush=True)  # parents read this via a block-buffered pipe
 

@@ -8,7 +8,7 @@ mutable with the same change-notification contract.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 DEFAULT_BATCH_MAX_DURATION = 10.0
 DEFAULT_BATCH_IDLE_DURATION = 1.0
@@ -68,7 +68,16 @@ class Config:
 # -- live ConfigMap watch (pkg/config/config.go:84-170) ----------------------
 
 CONFIGMAP_NAME = "karpenter-global-settings"
-CONFIGMAP_NAMESPACE = "karpenter"  # the system namespace (config.go:85-88)
+CONFIGMAP_NAMESPACE = "karpenter"  # default system namespace (config.go:85-88)
+
+
+def system_namespace() -> str:
+    """The namespace the settings ConfigMap lives in — $SYSTEM_NAMESPACE,
+    injected by the generated Deployment via the downward API, exactly the
+    reference's informer wiring (suite_test.go: os.Getenv("SYSTEM_NAMESPACE"))."""
+    import os
+
+    return os.environ.get("SYSTEM_NAMESPACE") or CONFIGMAP_NAMESPACE
 
 DEFAULT_CONFIGMAP_DATA = {
     "batchMaxDuration": "10s",
@@ -88,7 +97,7 @@ def parse_duration(value: str) -> float:
     return float(text)
 
 
-def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: str = CONFIGMAP_NAMESPACE) -> None:
+def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: Optional[str] = None) -> None:
     """Subscribe the Config to the settings ConfigMap.
 
     Mirrors the reference watcher (config.go:84-170): a content hash
@@ -102,6 +111,8 @@ def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: st
     from .logsetup import get_logger
 
     log = get_logger("config")
+    if namespace is None:
+        namespace = system_namespace()
     # the launch-time configuration is the fallback for unset/removed keys
     base = {
         "batchMaxDuration": f"{config.batch_max_duration}s",

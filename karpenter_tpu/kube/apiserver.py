@@ -67,6 +67,14 @@ class _Status:
         }
 
 
+class _Unreachable:
+    """A stored webhook registration with failurePolicy Fail and no dialable
+    endpoint: matching writes must fail closed, like a real apiserver."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
 class APIServerState:
     """The object store + watch hub, shared across handler threads."""
 
@@ -77,11 +85,15 @@ class APIServerState:
         self._journal: List[Tuple[int, str, str, dict]] = []  # (rv, kind, type, wire)
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
         self._clock = clock
-        # admission webhook registrations: the Mutating/Validating
-        # WebhookConfiguration analog — (kinds, mutate_url, validate_url,
-        # ca_pem); writes to matching kinds dispatch over HTTPS with the
-        # registered CA bundle verifying the webhook's serving cert
+        # admission webhook registrations: in-process registrations (the
+        # test convenience) plus _dynamic_webhooks derived from stored
+        # WebhookConfiguration objects; writes to matching kinds dispatch
+        # over HTTPS with the registered CA bundle verifying the webhook's
+        # serving cert
         self._webhooks: List[tuple] = []
+        self._dynamic_webhooks: List[tuple] = []
+
+    WEBHOOK_CONFIG_KINDS = ("MutatingWebhookConfiguration", "ValidatingWebhookConfiguration")
 
     def register_webhooks(self, kinds, mutate_url: Optional[str], validate_url: Optional[str], ca_pem: bytes) -> None:
         import ssl
@@ -90,6 +102,53 @@ class APIServerState:
         # once instead of re-parsing the PEM on every admitted write
         ctx = ssl.create_default_context(cadata=ca_pem.decode())
         self._webhooks.append((set(kinds), mutate_url, validate_url, ctx))
+
+    def _rebuild_dynamic_webhooks(self) -> None:
+        """Derive admission dispatch from STORED Mutating/Validating
+        WebhookConfiguration objects — the real registration path: kubectl
+        applies the configurations, the webhook process patches in its
+        caBundle + url, and writes start dispatching. Entries without a
+        resolvable url or caBundle are skipped exactly like an apiserver
+        that cannot reach the service."""
+        import base64
+        import ssl
+
+        plural_to_kind = {plural: kind for kind, (_, plural, _) in API_REGISTRY.items()}
+        dynamic: List[tuple] = []
+        for (kind, _, _), wire in list(self._objects.items()):
+            if kind not in self.WEBHOOK_CONFIG_KINDS:
+                continue
+            for hook in wire.get("webhooks") or []:
+                kinds = {
+                    plural_to_kind[res]
+                    for rule in hook.get("rules") or []
+                    for res in rule.get("resources") or []
+                    if res in plural_to_kind
+                }
+                if not kinds:
+                    continue
+                client = hook.get("clientConfig") or {}
+                url = client.get("url")
+                bundle = client.get("caBundle")
+                ctx = None
+                if url and bundle:
+                    try:
+                        ctx = ssl.create_default_context(cadata=base64.b64decode(bundle).decode())
+                    except Exception:
+                        ctx = None  # malformed bundle: unreachable
+                if ctx is None:
+                    # fail CLOSED like a real apiserver that cannot call the
+                    # webhook — unless the registration opts into Ignore
+                    if (hook.get("failurePolicy") or "Fail") == "Fail":
+                        dynamic.append((kinds, None, None, _Unreachable(hook.get("name", "webhook"))))
+                    continue
+                if kind == "MutatingWebhookConfiguration":
+                    dynamic.append((kinds, url, None, ctx))
+                else:
+                    dynamic.append((kinds, None, url, ctx))
+        # defaulting before validation across entries (webhooks.go:41-96)
+        dynamic.sort(key=lambda entry: entry[1] is None)
+        self._dynamic_webhooks = dynamic
 
     def _call_webhook(self, url: str, ctx, wire: dict, operation: str) -> dict:
         import urllib.request
@@ -112,9 +171,13 @@ class APIServerState:
     def _admit(self, kind: str, wire: dict, operation: str) -> dict:
         """Run registered webhooks: defaulting (apply JSONPatch) then
         validation (webhooks.go:41-96 ordering); a disallow maps to 422."""
-        for kinds, mutate_url, validate_url, ctx in self._webhooks:
+        if kind in self.WEBHOOK_CONFIG_KINDS:
+            return wire  # registrations themselves are not webhook-admitted
+        for kinds, mutate_url, validate_url, ctx in list(self._webhooks) + list(self._dynamic_webhooks):
             if kind not in kinds:
                 continue
+            if isinstance(ctx, _Unreachable):
+                raise ApiError(500, "InternalError", f"failed calling webhook {ctx.name}: no reachable endpoint registered")
             if mutate_url:
                 out = self._call_webhook(mutate_url, ctx, wire, operation).get("response") or {}
                 if not out.get("allowed", False):
@@ -151,6 +214,8 @@ class APIServerState:
         for want_kind, q in list(self._watchers):
             if want_kind == kind:
                 q.put(record)
+        if kind in self.WEBHOOK_CONFIG_KINDS:
+            self._rebuild_dynamic_webhooks()
 
     # -- verbs (wire dicts in, wire dicts out; raise (code, reason, msg)) ----
 

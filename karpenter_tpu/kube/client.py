@@ -72,14 +72,33 @@ class ApiStatusError(RuntimeError):
 
 
 class HttpKubeClient:
-    """KubeCluster-surface client over the Kubernetes REST protocol."""
+    """KubeCluster-surface client over the Kubernetes REST protocol.
 
-    def __init__(self, base_url: str, qps: float = DEFAULT_QPS, burst: int = DEFAULT_BURST, clock=None):
+    https:// base URLs speak TLS; `ca_file` pins the server CA and
+    `token_file` adds bearer-token auth — together the in-cluster
+    serviceaccount credential set (client-go rest.InClusterConfig)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        qps: float = DEFAULT_QPS,
+        burst: int = DEFAULT_BURST,
+        clock=None,
+        ca_file: Optional[str] = None,
+        token_file: Optional[str] = None,
+    ):
         from ..utils.clock import Clock
 
         parsed = urlparse(base_url)
         self._host = parsed.hostname or "127.0.0.1"
-        self._port = parsed.port or 80
+        self._tls = parsed.scheme == "https"
+        self._port = parsed.port or (443 if self._tls else 80)
+        self._ssl_context = None
+        if self._tls:
+            import ssl
+
+            self._ssl_context = ssl.create_default_context(cafile=ca_file)
+        self._token_file = token_file
         self._limiter = TokenBucket(qps, burst)
         # same default as KubeCluster: consumers dereference kube.clock.now()
         self.clock = clock or Clock()
@@ -89,17 +108,32 @@ class HttpKubeClient:
 
     # -- transport -----------------------------------------------------------
 
+    def _new_connection(self, timeout: float) -> http.client.HTTPConnection:
+        if self._tls:
+            return http.client.HTTPSConnection(self._host, self._port, timeout=timeout, context=self._ssl_context)
+        return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
+
+    def _auth_headers(self) -> Dict[str, str]:
+        if self._token_file is None:
+            return {}
+        try:
+            # re-read per request: kubelet rotates projected tokens in place
+            with open(self._token_file) as fh:
+                return {"Authorization": f"Bearer {fh.read().strip()}"}
+        except OSError:
+            return {}
+
     def _connection(self, fresh: bool = False) -> http.client.HTTPConnection:
         conn = None if fresh else getattr(self._local, "conn", None)
         if conn is None:
-            conn = http.client.HTTPConnection(self._host, self._port, timeout=30)
+            conn = self._new_connection(timeout=30)
             self._local.conn = conn
         return conn
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         self._limiter.take()
         payload = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": "application/json", **self._auth_headers()}
         # keep-alive per thread; one transparent retry on a dead connection
         for attempt in range(2):
             conn = self._connection(fresh=attempt > 0)
@@ -267,9 +301,9 @@ class HttpKubeClient:
                 time.sleep(0.05)
 
     def _stream(self, kind: str, rv: int, handler: Callable[[WatchEvent], None], known: Dict[str, object]) -> int:
-        conn = http.client.HTTPConnection(self._host, self._port, timeout=300)
+        conn = self._new_connection(timeout=300)
         try:
-            conn.request("GET", rest_path(kind) + f"?watch=true&resourceVersion={rv}")
+            conn.request("GET", rest_path(kind) + f"?watch=true&resourceVersion={rv}", headers=self._auth_headers())
             resp = conn.getresponse()
             if resp.status == 410:
                 return 0  # journal compacted: relist

@@ -36,6 +36,8 @@ API_REGISTRY: Dict[str, tuple] = {
     "DaemonSet": ("apps/v1", "daemonsets", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
     "Provisioner": ("karpenter.sh/v1alpha5", "provisioners", False),
+    "MutatingWebhookConfiguration": ("admissionregistration.k8s.io/v1", "mutatingwebhookconfigurations", False),
+    "ValidatingWebhookConfiguration": ("admissionregistration.k8s.io/v1", "validatingwebhookconfigurations", False),
 }
 
 KIND_CLASSES: Dict[str, type] = {
@@ -51,6 +53,8 @@ KIND_CLASSES: Dict[str, type] = {
     "DaemonSet": obj.DaemonSet,
     "Lease": obj.Lease,
     "Provisioner": Provisioner,
+    "MutatingWebhookConfiguration": obj.MutatingWebhookConfiguration,
+    "ValidatingWebhookConfiguration": obj.ValidatingWebhookConfiguration,
 }
 
 

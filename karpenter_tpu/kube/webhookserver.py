@@ -25,7 +25,7 @@ import ssl
 import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import List, Optional
 
 from ..logsetup import get_logger
 from .certs import ServingCert, generate_serving_cert
@@ -124,8 +124,18 @@ class AdmissionWebhookServer:
     """The webhook deployment: HTTPS AdmissionReview endpoint with
     self-managed serving certs (the knative cert-rotation analog)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, cloud_provider=None, cert: Optional[ServingCert] = None):
-        self.cert = cert or generate_serving_cert(sans=[host, "localhost"])
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        cloud_provider=None,
+        cert: Optional[ServingCert] = None,
+        extra_sans: Optional[List[str]] = None,
+    ):
+        # extra_sans carries the in-cluster Service DNS names — the names a
+        # real apiserver dials for service-ref registrations — so the
+        # self-managed cert verifies there too (cmd/webhook.py)
+        self.cert = cert or generate_serving_cert(sans=[host, "localhost", *(extra_sans or [])])
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.cloud_provider = cloud_provider  # type: ignore[attr-defined]

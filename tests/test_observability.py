@@ -1,0 +1,143 @@
+"""Observability endpoints + webhook self-registration: what the generated
+Deployment's probes, metrics Service, and admission registrations rely on.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.observability import ObservabilityServer
+
+
+class TestObservabilityServer:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    def test_probes_and_metrics(self):
+        state = {"healthy": True, "ready": False}
+        registry = Registry()
+        registry.counter("karpenter_test_total", "help").inc()
+        server = ObservabilityServer(
+            healthy=lambda: state["healthy"],
+            ready=lambda: state["ready"],
+            health_port=0,
+            metrics_port=0,
+            host="127.0.0.1",
+            registry=registry,
+        )
+        server.start()
+        health_port, metrics_port = server.ports
+        try:
+            assert self._get(health_port, "/healthz") == (200, "ok\n")
+            code, body = self._get(health_port, "/readyz")
+            assert code == 503 and "readiness" in body
+
+            state["ready"] = True
+            assert self._get(health_port, "/readyz") == (200, "ok\n")
+            state["healthy"] = False
+            assert self._get(health_port, "/healthz")[0] == 503
+
+            code, text = self._get(metrics_port, "/metrics")
+            assert code == 200
+            assert "karpenter_test_total 1" in text
+
+            assert self._get(health_port, "/nope")[0] == 404
+        finally:
+            server.stop()
+
+    def test_disabled_ports_bind_nothing(self):
+        server = ObservabilityServer(healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=-1)
+        assert server.ports == []
+        server.start()
+        server.stop()
+
+
+class TestWebhookSelfRegistration:
+    def test_registration_completes_applied_configurations(self):
+        """kubectl-applied (service-ref) configurations gain the CA bundle;
+        writes then dispatch through the live webhook over HTTPS."""
+        import base64
+
+        from karpenter_tpu.api.objects import MutatingWebhookConfiguration, ObjectMeta, ValidatingWebhookConfiguration
+        from karpenter_tpu.cmd.webhook import ADMISSION_RULE, MUTATING_NAME, VALIDATING_NAME, register_configurations
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.kube.apiserver import APIServer
+        from karpenter_tpu.kube.client import HttpKubeClient
+        from karpenter_tpu.kube.webhookserver import AdmissionWebhookServer
+        from tests.helpers import make_provisioner
+
+        api = APIServer(host="127.0.0.1", port=0).start()
+        webhook = AdmissionWebhookServer(host="127.0.0.1", port=0, cloud_provider=FakeCloudProvider()).start()
+        client = HttpKubeClient(api.url)
+        try:
+            from karpenter_tpu.kube.client import ApiStatusError
+
+            # url-less configurations applied from the rendered manifests:
+            # failurePolicy Fail + no dialable endpoint = every matching
+            # write fails CLOSED until the webhook patches itself in
+            for cls, name in ((MutatingWebhookConfiguration, MUTATING_NAME), (ValidatingWebhookConfiguration, VALIDATING_NAME)):
+                client.create(cls(metadata=ObjectMeta(name=name, namespace=""), webhooks=[
+                    {"name": name, "admissionReviewVersions": ["v1"], "clientConfig": {}, "rules": [dict(ADMISSION_RULE)], "sideEffects": "None", "failurePolicy": "Fail"},
+                ]))
+            with pytest.raises(ApiStatusError) as err:
+                client.create(make_provisioner(name="pre-registration"))
+            assert err.value.code == 500, "unreachable Fail-policy webhook must fail closed"
+
+            register_configurations(client, webhook.url, webhook.cert.ca_pem)
+            stored = client.get("MutatingWebhookConfiguration", MUTATING_NAME, namespace="")
+            bundle = stored.webhooks[0]["clientConfig"]["caBundle"]
+            assert base64.b64decode(bundle) == webhook.cert.ca_pem
+            assert stored.webhooks[0]["clientConfig"]["url"].endswith("/mutate")
+
+            # now the validating webhook rejects an invalid object
+            with pytest.raises(ApiStatusError):
+                client.create(make_provisioner(name="y" * 70))
+            # and defaulting applies (weight default via DefaultHook chain)
+            ok = make_provisioner(name="good")
+            created = client.create(ok)
+            assert created.metadata.name == "good"
+        finally:
+            webhook.stop()
+            api.stop()
+
+    def test_registration_creates_when_absent(self):
+        from karpenter_tpu.cmd.webhook import MUTATING_NAME, VALIDATING_NAME, register_configurations
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.kube.apiserver import APIServer
+        from karpenter_tpu.kube.client import HttpKubeClient
+        from karpenter_tpu.kube.webhookserver import AdmissionWebhookServer
+
+        api = APIServer(host="127.0.0.1", port=0).start()
+        webhook = AdmissionWebhookServer(host="127.0.0.1", port=0, cloud_provider=FakeCloudProvider()).start()
+        client = HttpKubeClient(api.url)
+        try:
+            register_configurations(client, webhook.url, webhook.cert.ca_pem)
+            assert client.get("MutatingWebhookConfiguration", MUTATING_NAME, namespace="") is not None
+            assert client.get("ValidatingWebhookConfiguration", VALIDATING_NAME, namespace="") is not None
+        finally:
+            webhook.stop()
+            api.stop()
+
+
+class TestSystemNamespace:
+    def test_configmap_namespace_follows_env(self, monkeypatch):
+        from karpenter_tpu.config import CONFIGMAP_NAME, Config, watch_config
+        from karpenter_tpu.api.objects import ConfigMap, ObjectMeta
+        from karpenter_tpu.kube.cluster import KubeCluster
+
+        monkeypatch.setenv("SYSTEM_NAMESPACE", "my-system")
+        kube = KubeCluster()
+        config = Config()
+        watch_config(kube, config)
+        kube.create(ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="my-system"), data={"batchIdleDuration": "3s"}))
+        assert config.batch_idle_duration == 3.0
+        # a same-named map in the DEFAULT namespace must not drive settings
+        kube.create(ConfigMap(metadata=ObjectMeta(name=CONFIGMAP_NAME, namespace="karpenter"), data={"batchIdleDuration": "9s"}))
+        assert config.batch_idle_duration == 3.0

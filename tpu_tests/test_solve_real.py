@@ -1,0 +1,78 @@
+"""REAL-TPU end-to-end solve coverage.
+
+The CPU suites pin dense-vs-host equivalence on the virtual mesh; this tier
+runs the FULL production solve — encode, device dispatch (Pallas or jnp),
+speculation, audit, commit — on a real chip and re-asserts the differential
+invariants there, so a real-Mosaic/XLA:TPU divergence is caught by a test
+rather than a production fallback. Run explicitly:
+
+    KARPENTER_TPU_REAL=1 python -m pytest tpu_tests/ -q
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+if os.environ.get("KARPENTER_TPU_REAL") != "1":
+    pytest.skip("set KARPENTER_TPU_REAL=1 (and run on TPU) for real-chip coverage", allow_module_level=True)
+
+os.environ["JAX_PLATFORMS"] = ""
+import jax
+
+if jax.default_backend() != "tpu":
+    pytest.skip("no TPU backend", allow_module_level=True)
+
+import numpy as np
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from tests.helpers import make_provisioner
+from tests.test_differential_campaign import (
+    _assert_invariants,
+    _provisioners,
+    _random_states,
+    _random_workload,
+    _rename,
+    _scheduled_names,
+)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_real_chip_differential(seed):
+    rng = np.random.default_rng(7000 + seed)
+    provider = FakeCloudProvider(instance_types(int(rng.integers(30, 100))))
+    pods_dense = _rename(_random_workload(rng, int(rng.integers(60, 120))), seed)
+    states_dense = _random_states(rng)
+    rng2 = np.random.default_rng(7000 + seed)
+    provider2 = FakeCloudProvider(instance_types(int(rng2.integers(30, 100))))
+    pods_host = _rename(_random_workload(rng2, int(rng2.integers(60, 120))), seed)
+    states_host = _random_states(rng2)
+
+    solver = DenseSolver(min_batch=1)
+    dense_results = build_scheduler(
+        _provisioners(), provider, pods_dense, state_nodes=states_dense, dense_solver=solver
+    ).solve(pods_dense)
+    host_results = build_scheduler(
+        _provisioners(), provider2, pods_host, state_nodes=states_host, dense_solver=None
+    ).solve(pods_host)
+
+    assert solver.stats.batches == 1, "the dense path must actually run on the chip"
+    assert _scheduled_names(dense_results) == _scheduled_names(host_results)
+    _assert_invariants(dense_results, pods_dense)
+    _assert_invariants(host_results, pods_host)
+
+
+def test_real_chip_large_batch_commits_dense():
+    from tests.helpers import make_pod
+
+    provider = FakeCloudProvider(instance_types(200))
+    pods = [make_pod(name=f"rb-{i:04d}", requests={"cpu": 0.25, "memory": "256Mi"}) for i in range(2000)]
+    solver = DenseSolver(min_batch=1)
+    results = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver).solve(pods)
+    placed = sum(len(n.pods) for n in results.new_nodes) + sum(len(v.pods) for v in results.existing_nodes)
+    assert placed == 2000
+    assert solver.stats.pods_committed >= 1900, "bulk of the batch must commit through the device path"
+    assert solver.stats.device_seconds > 0
