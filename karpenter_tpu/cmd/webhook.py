@@ -42,12 +42,13 @@ ADMISSION_RULE = {
 }
 
 
-def register_configurations(client, server_url: str, ca_pem: bytes, advertise_url: str = "") -> None:
+def register_configurations(client, server_url: str, ca_pem: bytes, advertise_url: str = "", namespace: str = "") -> None:
     """Upsert the admission registrations with this server's CA bundle.
 
     A configuration that carries a service ref keeps it (in-cluster routing)
-    and only gains the caBundle; one without gets the direct URL — the form
-    the apiserver emulator dispatches."""
+    and only gains the caBundle; one without gets the direct URL. When
+    CREATING absent configurations in-cluster (namespace known), the service
+    ref is minted — never the bind address, which an apiserver can't dial."""
     from ..api.objects import MutatingWebhookConfiguration, ObjectMeta, ValidatingWebhookConfiguration
     from ..kube.client import ApiStatusError, Conflict
 
@@ -60,13 +61,20 @@ def register_configurations(client, server_url: str, ca_pem: bytes, advertise_ur
     ):
         current = client.get(cls.kind, name, namespace="")
         if current is None:
+            if namespace:
+                client_config = {
+                    "service": {"name": WEBHOOK_SERVICE_NAME, "namespace": namespace, "port": 443},
+                    "caBundle": bundle,
+                }
+            else:
+                client_config = {"url": url + path, "caBundle": bundle}
             cfg = cls(
                 metadata=ObjectMeta(name=name, namespace=""),
                 webhooks=[
                     {
                         "name": name,
                         "admissionReviewVersions": ["v1"],
-                        "clientConfig": {"url": url + path, "caBundle": bundle},
+                        "clientConfig": client_config,
                         "rules": [dict(ADMISSION_RULE)],
                         "sideEffects": "None",
                         "failurePolicy": "Fail",
@@ -75,15 +83,21 @@ def register_configurations(client, server_url: str, ca_pem: bytes, advertise_ur
             )
             try:
                 client.create(cfg)
-            except (ApiStatusError, Conflict):
-                current = client.get(cls.kind, name, namespace="")  # lost the create race
-        if current is not None:
-            for hook in current.webhooks:
-                cc = hook.setdefault("clientConfig", {})
-                cc["caBundle"] = bundle
-                if not cc.get("service"):
-                    cc["url"] = url + path
-            client.update(current)
+                continue
+            except Conflict:
+                pass  # lost the create race: fall through to the update path
+            except ApiStatusError as err:
+                if err.code != 409:
+                    raise  # a real failure must not be reported as success
+            current = client.get(cls.kind, name, namespace="")
+            if current is None:
+                raise RuntimeError(f"webhook configuration {name} vanished during registration")
+        for hook in current.webhooks:
+            cc = hook.setdefault("clientConfig", {})
+            cc["caBundle"] = bundle
+            if not cc.get("service"):
+                cc["url"] = url + path
+        client.update(current)
 
 
 def main(argv=None) -> int:
@@ -124,7 +138,7 @@ def main(argv=None) -> int:
 
     client, url = build_kube_backend(Options(apiserver_url=args.apiserver_url))
     if url:
-        register_configurations(client, server.url, server.cert.ca_pem, args.advertise_url)
+        register_configurations(client, server.url, server.cert.ca_pem, args.advertise_url, namespace=namespace)
         print(f"karpenter-tpu webhook registered configurations at {url}", file=sys.stderr)
     print(f"karpenter-tpu webhook serving AdmissionReview at {server.url} (CA bundle on stdout below)", file=sys.stderr)
     print(server.cert.ca_pem.decode(), flush=True)  # parents read this via a block-buffered pipe

@@ -101,7 +101,7 @@ class APIServerState:
         # the CA bundle is immutable per registration: build its TLS context
         # once instead of re-parsing the PEM on every admitted write
         ctx = ssl.create_default_context(cadata=ca_pem.decode())
-        self._webhooks.append((set(kinds), mutate_url, validate_url, ctx))
+        self._webhooks.append((set(kinds), None, mutate_url, validate_url, ctx))
 
     def _rebuild_dynamic_webhooks(self) -> None:
         """Derive admission dispatch from STORED Mutating/Validating
@@ -113,18 +113,29 @@ class APIServerState:
         import base64
         import ssl
 
-        plural_to_kind = {plural: kind for kind, (_, plural, _) in API_REGISTRY.items()}
+        # (group, plural) -> kind, the rule-scoping a real apiserver applies
+        group_plural_to_kind = {
+            (api_version.rsplit("/", 1)[0] if "/" in api_version else "", plural): kind
+            for kind, (api_version, plural, _) in API_REGISTRY.items()
+        }
         dynamic: List[tuple] = []
         for (kind, _, _), wire in list(self._objects.items()):
             if kind not in self.WEBHOOK_CONFIG_KINDS:
                 continue
             for hook in wire.get("webhooks") or []:
-                kinds = {
-                    plural_to_kind[res]
-                    for rule in hook.get("rules") or []
-                    for res in rule.get("resources") or []
-                    if res in plural_to_kind
-                }
+                kinds = set()
+                operations = set()
+                for rule in hook.get("rules") or []:
+                    groups = rule.get("apiGroups") or ["*"]
+                    for res in rule.get("resources") or []:
+                        for group in groups:
+                            if group == "*":
+                                kinds.update(k for (g, p), k in group_plural_to_kind.items() if p == res)
+                            else:
+                                mapped = group_plural_to_kind.get((group, res))
+                                if mapped:
+                                    kinds.add(mapped)
+                    operations.update(rule.get("operations") or ["*"])
                 if not kinds:
                     continue
                 client = hook.get("clientConfig") or {}
@@ -140,14 +151,14 @@ class APIServerState:
                     # fail CLOSED like a real apiserver that cannot call the
                     # webhook — unless the registration opts into Ignore
                     if (hook.get("failurePolicy") or "Fail") == "Fail":
-                        dynamic.append((kinds, None, None, _Unreachable(hook.get("name", "webhook"))))
+                        dynamic.append((kinds, operations, None, None, _Unreachable(hook.get("name", "webhook"))))
                     continue
                 if kind == "MutatingWebhookConfiguration":
-                    dynamic.append((kinds, url, None, ctx))
+                    dynamic.append((kinds, operations, url, None, ctx))
                 else:
-                    dynamic.append((kinds, None, url, ctx))
+                    dynamic.append((kinds, operations, None, url, ctx))
         # defaulting before validation across entries (webhooks.go:41-96)
-        dynamic.sort(key=lambda entry: entry[1] is None)
+        dynamic.sort(key=lambda entry: entry[2] is None)
         self._dynamic_webhooks = dynamic
 
     def _call_webhook(self, url: str, ctx, wire: dict, operation: str) -> dict:
@@ -173,9 +184,11 @@ class APIServerState:
         validation (webhooks.go:41-96 ordering); a disallow maps to 422."""
         if kind in self.WEBHOOK_CONFIG_KINDS:
             return wire  # registrations themselves are not webhook-admitted
-        for kinds, mutate_url, validate_url, ctx in list(self._webhooks) + list(self._dynamic_webhooks):
+        for kinds, operations, mutate_url, validate_url, ctx in list(self._webhooks) + list(self._dynamic_webhooks):
             if kind not in kinds:
                 continue
+            if operations is not None and "*" not in operations and operation not in operations:
+                continue  # the rule's operations scope a real apiserver honors
             if isinstance(ctx, _Unreachable):
                 raise ApiError(500, "InternalError", f"failed calling webhook {ctx.name}: no reachable endpoint registered")
             if mutate_url:

@@ -2,9 +2,10 @@
 
 The scenario suites pin known shapes; this campaign sweeps RANDOM workload
 mixes (plain cohorts, zonal spreads, zonal self-affinity, hostname
-anti-affinity, selectors, tolerated taints, host ports) against random warm
-clusters across seeds, asserting on every instance the invariants that must
-hold regardless of which path placed each pod:
+anti-affinity, selectors, tolerated taints, host ports, preferred-affinity
+relaxation) against random warm clusters across seeds, asserting on every
+instance the invariants that must hold regardless of which path placed each
+pod:
 
   - same set of scheduled pods as the host oracle (schedulability parity)
   - no existing node filled beyond its available resources
@@ -31,9 +32,13 @@ from karpenter_tpu.api.labels import (
     PROVISIONER_NAME_LABEL,
 )
 from karpenter_tpu.api.objects import (
+    OP_IN,
     ContainerPort,
     LabelSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
     PodAffinityTerm,
+    PreferredSchedulingTerm,
     Taint,
     Toleration,
     TopologySpreadConstraint,
@@ -60,7 +65,7 @@ def _random_workload(rng: np.random.Generator, count: int):
     mems = ["128Mi", "512Mi", "1Gi", "2Gi"]
     pods = []
     for i in range(count):
-        kind = rng.integers(0, 11)
+        kind = rng.integers(0, 12)
         size = {"cpu": cpus[rng.integers(len(cpus))], "memory": mems[rng.integers(len(mems))]}
         cohort = f"c{rng.integers(4)}"
         if kind < 4:  # plain
@@ -72,6 +77,24 @@ def _random_workload(rng: np.random.Generator, count: int):
                     labels={"app": cohort},
                     requests=size,
                     tolerations=[Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")],
+                )
+            )
+        elif kind == 11:  # preferred zone affinity: exercises the relaxation
+            # ladder — both paths must relax identically when the preference
+            # can't hold
+            zone = ZONES[rng.integers(3)]
+            pods.append(
+                make_pod(
+                    labels={"app": cohort},
+                    requests=size,
+                    node_preferences=[
+                        PreferredSchedulingTerm(
+                            weight=int(rng.integers(1, 100)),
+                            preference=NodeSelectorTerm(
+                                match_expressions=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, [zone])]
+                            ),
+                        )
+                    ],
                 )
             )
         elif kind < 6:  # zonal spread

@@ -54,6 +54,80 @@ def _spawn(module, *args, env_extra=None):
     )
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def test_deployment_shaped_topology(apiserver):
+    """The deploy/ bundle's shape, end to end: the webhook registers ITSELF
+    (WebhookConfiguration objects over the wire, caBundle patched in), the
+    controller serves the probes and /metrics the Deployment targets, and
+    admission enforces through the registered objects — no in-process
+    registration anywhere."""
+    import urllib.request
+
+    health_port, metrics_port = _free_port(), _free_port()
+    webhook = _spawn(
+        "karpenter_tpu.cmd.webhook", "--port", "0", env_extra={"KUBERNETES_APISERVER_URL": apiserver.url}
+    )
+    controller = _spawn(
+        "karpenter_tpu.cmd.controller",
+        "--disable-dense-solver",
+        "--batch-max-duration", "0.3",
+        "--batch-idle-duration", "0.05",
+        "--health-probe-port", str(health_port),
+        "--metrics-port", str(metrics_port),
+        env_extra={"KUBERNETES_APISERVER_URL": apiserver.url},
+    )
+    client = HttpKubeClient(apiserver.url)
+    try:
+        # the webhook upserts its own registrations with its CA bundle
+        cfg = _wait(
+            lambda: (lambda c: c if c is not None and c.webhooks[0]["clientConfig"].get("caBundle") else None)(
+                client.get("ValidatingWebhookConfiguration", "validation.webhook.karpenter-tpu.sh", namespace="")
+            ),
+            message="webhook self-registration",
+        )
+        assert cfg.webhooks[0]["clientConfig"]["url"].endswith("/validate")
+
+        # the probes the generated Deployment points at are live
+        def http_status(port, path):
+            try:
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=2) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as err:
+                return err.code, ""
+            except OSError:
+                return None, ""
+
+        assert _wait(lambda: http_status(health_port, "/healthz")[0] == 200 or None, message="healthz")
+        assert _wait(lambda: http_status(health_port, "/readyz")[0] == 200 or None, message="readyz")
+        code, metrics_text = http_status(metrics_port, "/metrics")
+        assert code == 200 and "karpenter" in metrics_text
+
+        # admission enforces THROUGH the self-registered configuration
+        with pytest.raises(ApiStatusError):
+            client.create(make_provisioner(name="bad", requirements=[NodeSelectorRequirement("team", OP_IN, [])]))
+
+        client.create(make_provisioner())
+        client.create(make_pod(requests={"cpu": "0.5"}))
+        nodes = _wait(lambda: client.list_nodes() or None, message="nodes from the controller process")
+        assert len(nodes) >= 1
+    finally:
+        for proc in (controller, webhook):
+            proc.terminate()
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+        client.stop()
+
+
 def test_full_deployment_topology(apiserver):
     webhook = _spawn("karpenter_tpu.cmd.webhook", "--port", "0")
     controller = None
