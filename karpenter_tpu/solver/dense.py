@@ -312,9 +312,13 @@ class DenseSolver:
                     # every hostname is a fresh domain: one pod per node
                     buckets.append(_Bucket(group_index=g, dedicated=True, pod_rows=rows))
                 elif group.topology_key == lbl.LABEL_TOPOLOGY_ZONE:
-                    buckets.extend(self._water_fill(problem, topology, group, rows, problem.zones, problem.group_zone_allowed[g], "zone"))
+                    buckets.extend(
+                        self._water_fill(problem, topology, group, rows, problem.zones, problem.group_zone_allowed[g], "zone", scheduler)
+                    )
                 else:  # capacity type
-                    buckets.extend(self._water_fill(problem, topology, group, rows, problem.capacity_types, problem.group_ct_allowed[g], "ct"))
+                    buckets.extend(
+                        self._water_fill(problem, topology, group, rows, problem.capacity_types, problem.group_ct_allowed[g], "ct", scheduler)
+                    )
             elif group.kind == GroupKind.AFFINITY:
                 if group.topology_key == lbl.LABEL_HOSTNAME:
                     # Required self-affinity pins the component to an
@@ -390,7 +394,43 @@ class DenseSolver:
                     counts[i] += tg.domains.get(domain, 0)
         return counts
 
-    def _water_fill(self, problem, topology, group, rows: List[int], domains: List[str], allowed: np.ndarray, pin_kind: str) -> List[_Bucket]:
+    def _accepting_view_free(self, group, view) -> Optional[np.ndarray]:
+        """Free-capacity vector of an existing-node view IF this group's
+        constraint shape can land there (the shared warm-capacity model of
+        _pick_affinity_zone and _warm_absorbable)."""
+        if not self._view_accepts(group, view):
+            return None
+        avail = resource_vector(view.available)
+        used = resource_vector(view.requests)
+        if avail is None or used is None:
+            return None
+        return np.maximum(avail - used, 0.0)
+
+    def _warm_absorbable(self, scheduler, problem, group, rows: List[int], domains: List[str]) -> np.ndarray:
+        """Per-domain estimate of how many of this cohort's pods the ACCEPTING
+        existing-node views there could absorb. Zeroes when there is no warm
+        capacity."""
+        scores = np.zeros(len(domains), dtype=np.float64)
+        if scheduler is None or not scheduler.existing_nodes or not rows:
+            return scores
+        typical = problem.requests[rows].mean(axis=0)
+        positive = typical > 1e-12
+        if not positive.any():
+            return scores
+        index = {d: i for i, d in enumerate(domains)}
+        for view in scheduler.existing_nodes:
+            pos = index.get(view.node.metadata.labels.get(group.topology_key))
+            if pos is None:
+                continue
+            free = self._accepting_view_free(group, view)
+            if free is None:
+                continue
+            scores[pos] += float(np.floor((free[positive] / typical[positive]).min()))
+        return scores
+
+    def _water_fill(
+        self, problem, topology, group, rows: List[int], domains: List[str], allowed: np.ndarray, pin_kind: str, scheduler=None
+    ) -> List[_Bucket]:
         """Distribute the group's pods across allowed domains, lowest current
         count first (water filling) — the closed-form of the reference's
         per-pod min-count domain choice (topologygroup.go:157-184)."""
@@ -419,8 +459,18 @@ class DenseSolver:
             frozen = [i for i, d in enumerate(domains) if not allowed[i] and (pod_req is None or pod_req.has(d))]
             if frozen:
                 cap = counts_all[frozen].min() + group.max_skew
-        # fill lowest-count domains first; target[i] - counts[i] pods go to i
-        order = np.argsort(counts, kind="stable")
+        # fill lowest-count domains first; among EQUAL counts, prefer domains
+        # whose warm nodes can absorb more of this cohort — the skew math
+        # only depends on the sorted counts, so the tie-break is free, and it
+        # keeps spread fragments off fresh bins when existing capacity exists
+        # in a sibling domain (the host loop gets this by trying existing
+        # nodes first; campaign seed 12 is the regression shape)
+        if len(np.unique(counts)) < len(counts):
+            warm = self._warm_absorbable(scheduler, problem, group, rows, [domains[i] for i in allowed_idx])
+            order = np.lexsort((-warm, counts))
+        else:
+            # no ties: warm scores cannot change the order, skip the scan
+            order = np.argsort(counts, kind="stable")
         counts_sorted = counts[order]
         targets = counts_sorted.copy()
         remaining = n
@@ -483,13 +533,9 @@ class DenseSolver:
                 zone = view.node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE)
                 if zone not in allowed or total is None:
                     continue
-                if not self._view_accepts(group, view):
+                free = self._accepting_view_free(group, view)
+                if free is None:
                     continue
-                avail = resource_vector(view.available)
-                used = resource_vector(view.requests)
-                if avail is None or used is None:
-                    continue
-                free = np.maximum(avail - used, 0.0)
                 positive = total > 1e-12
                 if not positive.any():
                     continue
