@@ -66,7 +66,8 @@ def schedule(pods, provisioners=None, provider=None, path="host", cluster_pods=(
         for state in state_nodes:
             kube.create(state.node)
         for pod in cluster_pods:
-            pod.status.phase = "Running"
+            if pod.status.phase in ("", "Pending"):
+                pod.status.phase = "Running"  # bound fixtures default to live
             kube.create(pod)
     cluster = None
     if kube is not None:

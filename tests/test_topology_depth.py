@@ -1,0 +1,188 @@
+"""Topology depth, part 3: suite_test.go scenarios beyond the catalog's
+matrix — domain discovery under requirement changes, the pod-counting
+filter matrix, selector-less and interdependent selectors, multi-cohort
+hostname spread, ScheduleAnyway zonal violation, and arch-keyed spread.
+Every scenario runs on both the host loop and the dense path.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.labels import LABEL_ARCH, LABEL_HOSTNAME, LABEL_TOPOLOGY_ZONE
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    NodeSelectorRequirement,
+    OP_IN,
+    SCHEDULE_ANYWAY,
+    TopologySpreadConstraint,
+)
+from tests.helpers import make_pod, make_pods, make_provisioner, make_state_node
+from tests.test_scheduler_catalog import path, schedule, zones_of  # noqa: F401 - fixture re-export
+
+
+def spread(max_skew=1, key=LABEL_TOPOLOGY_ZONE, app="a", when=None, selector=...):
+    if selector is ...:
+        selector = LabelSelector(match_labels={"app": app})
+    kwargs = {"max_skew": max_skew, "topology_key": key, "label_selector": selector}
+    if when:
+        kwargs["when_unsatisfiable"] = when
+    return TopologySpreadConstraint(**kwargs)
+
+
+def warm_node(zone, name=None, cpu=32):
+    labels = {lbl.PROVISIONER_NAME_LABEL: "default", LABEL_TOPOLOGY_ZONE: zone}
+    state = make_state_node(labels=labels, allocatable={"cpu": cpu, "memory": "64Gi", "pods": 110})
+    if name:
+        state.node.metadata.name = name
+    return state
+
+
+class TestDomainDiscovery:
+    def test_domains_discovered_from_existing_pods_pin_skew(self, path):
+        # suite_test.go:916 — a pod already in zone-1 counts even though the
+        # provisioner now only offers zone-2/3: skew 1 allows 2 per new zone.
+        # The zone-1 node is FULL (the reference sizes rr=1.1 so no second
+        # pod fits), keeping its count pinned at 1.
+        host = warm_node("test-zone-1", cpu=0.5)
+        bound = [make_pod(labels={"app": "a"}, node_name=host.node.name, unschedulable=False)]
+        prov = make_provisioner(
+            requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-2", "test-zone-3"])]
+        )
+        pods = make_pods(10, labels={"app": "a"}, requests={"cpu": "1.1"}, topology_spread_constraints=[spread()])
+        results = schedule(pods, provisioners=[prov], path=path, state_nodes=[host], cluster_pods=bound)
+        placed = zones_of(results)
+        assert placed.get("test-zone-2", 0) == 2 and placed.get("test-zone-3", 0) == 2, placed
+        assert len(results.unschedulable) == 6
+
+    def test_provisioner_zonal_constraint_with_existing_pod(self, path):
+        # suite_test.go:764 — existing zone-1 pod + provisioner allowing all
+        # three zones: the fill balances against the existing count
+        host = warm_node("test-zone-1", cpu=0.5)  # full: new pods need fresh nodes
+        bound = [make_pod(labels={"app": "a"}, node_name=host.node.name, unschedulable=False)]
+        pods = make_pods(5, labels={"app": "a"}, requests={"cpu": "1"}, topology_spread_constraints=[spread()])
+        results = schedule(pods, path=path, state_nodes=[host], cluster_pods=bound)
+        placed = zones_of(results)
+        # end counts must be (2,2,2): one new in zone-1, two each elsewhere
+        assert placed.get("test-zone-1", 0) == 1 and placed.get("test-zone-2") == 2 and placed.get("test-zone-3") == 2, placed
+
+
+class TestPodCountingFilters:
+    def test_only_qualifying_bound_pods_count_toward_skew(self, path):
+        # suite_test.go:948 — the full ignore matrix: missing labels, no
+        # domain on the node, terminating, Failed, Succeeded
+        zone1 = warm_node("test-zone-1", cpu=0.5)
+        zone2 = warm_node("test-zone-2", cpu=0.5)
+        bare = make_state_node(
+            labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": 0.5, "memory": "64Gi", "pods": 110}
+        )
+        terminating = make_pod(labels={"app": "a"}, node_name=zone1.node.name, unschedulable=False)
+        terminating.metadata.deletion_timestamp = 10.0
+        # every IGNORED row piles onto zone-1: if any of them were wrongly
+        # counted, zone-1's count inflates past the skew window and the final
+        # balance below becomes unreachable — each row has teeth
+        cluster_pods = [
+            make_pod(node_name=zone1.node.name, unschedulable=False),  # ignored: missing labels
+            make_pod(labels={"app": "a"}, node_name=bare.node.name, unschedulable=False),  # ignored: no domain
+            terminating,  # ignored: terminating
+            make_pod(labels={"app": "a"}, node_name=zone1.node.name, unschedulable=False, phase="Failed"),
+            make_pod(labels={"app": "a"}, node_name=zone1.node.name, unschedulable=False, phase="Succeeded"),
+            make_pod(labels={"app": "a"}, namespace="wrong-ns", node_name=zone1.node.name, unschedulable=False),  # ignored: other namespace
+            make_pod(labels={"app": "a"}, node_name=zone1.node.name, unschedulable=False),  # counts: zone-1
+            make_pod(labels={"app": "a"}, node_name=zone1.node.name, unschedulable=False),  # counts: zone-1
+            make_pod(labels={"app": "a"}, node_name=zone2.node.name, unschedulable=False),  # counts: zone-2
+        ]
+        pods = make_pods(6, labels={"app": "a"}, requests={"cpu": "1"}, topology_spread_constraints=[spread()])
+        results = schedule(
+            pods, path=path, state_nodes=[zone1, zone2, bare], cluster_pods=cluster_pods, namespaces=("wrong-ns",)
+        )
+        placed = zones_of(results)
+        assert len(results.unschedulable) == 0
+        # true counts start (2,1,0): six new pods balance the end state to
+        # exactly (3,3,3) — any wrongly-counted zone-1 row skews the final
+        # multiset (e.g. believed-7 zone-1 forces (2,4,3))
+        final = {
+            "test-zone-1": 2 + placed.get("test-zone-1", 0),
+            "test-zone-2": 1 + placed.get("test-zone-2", 0),
+            "test-zone-3": placed.get("test-zone-3", 0),
+        }
+        assert final == {"test-zone-1": 3, "test-zone-2": 3, "test-zone-3": 3}, final
+
+    def test_selectorless_constraint_matches_all_pods(self, path):
+        # suite_test.go:978 — no labelSelector: every pod in the batch counts
+        pods = make_pods(6, requests={"cpu": "0.5"}, topology_spread_constraints=[spread(selector=None)])
+        results = schedule(pods, path=path)
+        placed = zones_of(results)
+        assert len(results.unschedulable) == 0
+        assert placed and max(placed.values()) - min(placed.values()) <= 1, placed
+
+    def test_interdependent_selectors_pack_onto_one_node(self, path):
+        # suite_test.go:990 — hostname spread whose selector matches NO pod
+        # in the batch: skew never moves, everything may share a node
+        constraint = spread(key=LABEL_HOSTNAME, selector=LabelSelector(match_labels={"app": "nothing-matches"}))
+        pods = make_pods(5, requests={"cpu": "0.5"}, topology_spread_constraints=[constraint])
+        results = schedule(pods, path=path)
+        assert len(results.unschedulable) == 0
+        hosts = [n for n in results.new_nodes if n.pods] + [v for v in results.existing_nodes if v.pods]
+        assert len(hosts) == 1, f"expected one shared node, got {len(hosts)}"
+
+
+class TestMultiCohortHostnameSpread:
+    def test_two_deployments_balance_independently(self, path):
+        # suite_test.go:1049 — each cohort spreads over hostnames on its own
+        pods = []
+        for app in ("a", "b"):
+            pods += make_pods(
+                4,
+                labels={"app": app},
+                requests={"cpu": "0.5"},
+                topology_spread_constraints=[spread(key=LABEL_HOSTNAME, app=app)],
+            )
+        results = schedule(pods, path=path)
+        assert len(results.unschedulable) == 0
+        for app in ("a", "b"):
+            per_host = [
+                sum(1 for p in n.pods if p.metadata.labels.get("app") == app)
+                for n in results.new_nodes
+                if n.pods
+            ] + [
+                sum(1 for p in v.pods if p.metadata.labels.get("app") == app)
+                for v in results.existing_nodes
+                if v.pods
+            ]
+            counted = [c for c in per_host if c]
+            assert counted and max(counted) - min(counted) <= 1, (app, per_host)
+
+
+class TestScheduleAnyway:
+    def test_zonal_schedule_anyway_violates_rather_than_fails(self, path):
+        # suite_test.go:883 inverse — the provisioner only offers one zone;
+        # with ScheduleAnyway the skew is violated, nothing goes pending
+        prov = make_provisioner(requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-2"])])
+        pods = make_pods(
+            5, labels={"app": "a"}, requests={"cpu": "0.5"}, topology_spread_constraints=[spread(when=SCHEDULE_ANYWAY)]
+        )
+        results = schedule(pods, provisioners=[prov], path=path)
+        assert len(results.unschedulable) == 0
+        assert zones_of(results) == {"test-zone-2": 5}
+
+
+class TestCustomKeySpread:
+    def test_balance_across_arch(self, path):
+        # suite_test.go:1372 — the spread key is the arch label; the fake
+        # catalog offers amd64 + arm64, so the cohort must split across them
+        pods = make_pods(
+            6, labels={"app": "a"}, requests={"cpu": "0.5"}, topology_spread_constraints=[spread(key=LABEL_ARCH)]
+        )
+        results = schedule(pods, path=path)
+        assert len(results.unschedulable) == 0
+        archs = {}
+        for node in results.new_nodes:
+            if not node.pods:
+                continue
+            req = node.requirements.get(LABEL_ARCH)
+            arch = next(iter(req.values)) if req and len(req.values) == 1 and not req.complement else None
+            archs[arch] = archs.get(arch, 0) + len(node.pods)
+        assert len(archs) >= 2, archs
+        assert max(archs.values()) - min(archs.values()) <= 1, archs
