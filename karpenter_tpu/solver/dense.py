@@ -132,6 +132,9 @@ class DenseSolver:
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # per-solve memos (reset at each presolve; see _accepting_view_free)
+        self._view_free_memo: Dict[int, Optional[np.ndarray]] = {}
+        self._view_accepts_memo: Dict[tuple, bool] = {}
         # multi-host SPMD: with a PeerFabric (parallel/peers.py) the sharded
         # dispatch broadcasts each solve so every process of the global mesh
         # enters the same jitted program; the fabric's mesh becomes the mesh
@@ -232,6 +235,11 @@ class DenseSolver:
             return pods
         self.stats.batches += 1
         self.stats.pods_in += len(pods)
+        # reset the per-solve memos over (group, existing-view) queries:
+        # bucket construction (warm tie-break + affinity bootstrap) and the
+        # fill probe ask acceptance/freeness for the same pairs
+        self._view_free_memo.clear()
+        self._view_accepts_memo.clear()
 
         t0 = time.perf_counter()
         zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
@@ -397,14 +405,19 @@ class DenseSolver:
     def _accepting_view_free(self, group, view) -> Optional[np.ndarray]:
         """Free-capacity vector of an existing-node view IF this group's
         constraint shape can land there (the shared warm-capacity model of
-        _pick_affinity_zone and _warm_absorbable)."""
+        _pick_affinity_zone and _warm_absorbable). The freeness half is
+        group-independent and memoized per solve — valid ONLY before
+        _fill_existing starts committing (view.add rebinds view.requests);
+        the fill invalidates the memo on entry."""
         if not self._view_accepts(group, view):
             return None
+        if id(view) in self._view_free_memo:
+            return self._view_free_memo[id(view)]
         avail = resource_vector(view.available)
         used = resource_vector(view.requests)
-        if avail is None or used is None:
-            return None
-        return np.maximum(avail - used, 0.0)
+        free = None if avail is None or used is None else np.maximum(avail - used, 0.0)
+        self._view_free_memo[id(view)] = free
+        return free
 
     def _warm_absorbable(self, scheduler, problem, group, rows: List[int], domains: List[str]) -> np.ndarray:
         """Per-domain estimate of how many of this cohort's pods the ACCEPTING
@@ -553,7 +566,15 @@ class DenseSolver:
         """Exact host-algebra gate: can this group's constraint shape land on
         this existing node at all (taints + requirement compatibility)?
         Resource fit and topology tightening are re-checked per pod at commit
-        time by ExistingNodeView.add, so this gate only prunes."""
+        time by ExistingNodeView.add, so this gate only prunes. Memoized per
+        solve: bucket construction and the fill probe ask the same pairs."""
+        key = (id(group), id(view))
+        cached = self._view_accepts_memo.get(key)
+        if cached is None:
+            cached = self._view_accepts_memo[key] = self._view_accepts_uncached(group, view)
+        return cached
+
+    def _view_accepts_uncached(self, group, view) -> bool:
         pod = group.pods[0]
         if view.taints.tolerates(pod) is not None:
             return False
@@ -609,7 +630,10 @@ class DenseSolver:
             zone_of.append(view.node.metadata.labels.get(lbl.LABEL_TOPOLOGY_ZONE))
             ct_of.append(view.node.metadata.labels.get(lbl.LABEL_CAPACITY_TYPE))
 
-        compat_cache: Dict[tuple, bool] = {}
+        # commits below rebind view.requests: the pre-fill freeness memo is
+        # invalid from here on (the acceptance memo stays — view.add re-checks
+        # exactly, so stale-True only costs a probe)
+        self._view_free_memo.clear()
         committed = 0
         # group-membership scans are cohort-constant: one context per solver
         # group, one inverse-owner index per fill (topology.cohort_context)
@@ -631,12 +655,7 @@ class DenseSolver:
                 return False
             if bucket.capacity_type is not None and ct_of[vi] != bucket.capacity_type:
                 return False
-            key = (bucket.group_index, vi)
-            ok = compat_cache.get(key)
-            if ok is None:
-                ok = self._view_accepts(group, views[vi])
-                compat_cache[key] = ok
-            return ok
+            return self._view_accepts(group, views[vi])  # per-solve memoized
 
         def commit(vi: int, row: int, ctx=None) -> bool:
             nonlocal committed
