@@ -134,19 +134,35 @@ class Cluster:
         self._bump_epoch()
 
     def _populate_capacity(self, state: StateNode) -> None:
-        """Uninitialized nodes may not report capacity yet; fall back to the
-        instance-type data (cluster.go:203-245)."""
-        if state.allocatable or self.cloud_provider is None:
+        """Initialized nodes are trusted verbatim. Uninitialized ones fall
+        back to instance-type data — including per-resource restoration of
+        extended resources the kubelet zeroes out at startup (issue #1459,
+        cluster.go:203-245): a zero in BOTH capacity and allocatable for a
+        resource the instance type advertises means "not registered yet",
+        not "absent"."""
+        node = state.node
+        if state.initialized() or self.cloud_provider is None:
             if not state.available:
                 state.available = dict(state.allocatable)
             return
         from ...cloudprovider.types import lookup_instance_type
 
-        it = lookup_instance_type(self.cloud_provider, state.node, self.kube.list_provisioners())
-        if it is not None:
-            state.capacity = dict(it.resources())
-            state.allocatable = res.clamp_negative_to_zero(res.subtract(it.resources(), it.overhead()))
-            state.available = dict(state.allocatable)
+        it = lookup_instance_type(self.cloud_provider, node, self.kube.list_provisioners())
+        if it is None:
+            if not state.available:
+                state.available = dict(state.allocatable)
+            return
+        state.capacity = dict(it.resources())
+        # restored values are allocatable-equivalent: capacity minus the
+        # instance type's kube/system overhead, so the scheduler never packs
+        # into the reserved slice the kubelet will claim
+        effective = res.clamp_negative_to_zero(res.subtract(it.resources(), it.overhead()))
+        allocatable = dict(node.status.allocatable)
+        for name, value in effective.items():
+            if value > 0 and not node.status.capacity.get(name) and not allocatable.get(name):
+                allocatable[name] = value
+        state.allocatable = allocatable
+        state.available = dict(allocatable)
 
     def _populate_volume_limits(self, state: StateNode) -> None:
         csi = self.kube.get_csi_node(state.name)

@@ -212,6 +212,34 @@ class TestDaemonSetOverhead:
         assert node.instance_type_options[0].name() == "large"
 
 
+class TestKubeletResourceZeroing:
+    def test_zeroed_extended_resources_do_not_relaunch(self):
+        # suite_test.go:4065 (issue #1459): kubelet zeroes extended resources
+        # at startup; the uninitialized in-flight node must still count its
+        # instance type's GPU, so the second GPU pod reuses it
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        gpu_type = instance_type(
+            "gpu-box", cpu=8, memory="16Gi", price=5.0, offerings=od,
+            resources={"vendor.com/gpu": 2},
+        )
+        env = Environment(instance_types=[gpu_type])
+        env.kube.create(make_provisioner())
+        env.kube.create(make_pod(requests={"cpu": 0.1, "vendor.com/gpu": 1}))
+        env.provision()
+        nodes = env.kube.list_nodes()
+        assert len(nodes) == 1
+
+        # simulate the kubelet zeroing the extended resource on the node
+        node = nodes[0]
+        node.status.capacity = {"vendor.com/gpu": 0.0}
+        node.status.allocatable = {"vendor.com/gpu": 0.0}
+        env.kube.update(node)
+
+        env.kube.create(make_pod(requests={"cpu": 0.1, "vendor.com/gpu": 1}))
+        env.provision()
+        assert len(env.kube.list_nodes()) == 1, "the in-flight node must absorb the second GPU pod"
+
+
 class TestVolumeTopologyDepth:
     def _pvc(self, env, name, storage_class=None, volume_name=""):
         env.kube.create(
