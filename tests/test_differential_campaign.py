@@ -14,7 +14,9 @@ pod:
   - new-node cost within a bounded factor of the host oracle's
 
 Runs in the suite with a handful of seeds; KARPENTER_TPU_CAMPAIGN_SEEDS=n
-widens the sweep for soak runs.
+widens the sweep and KARPENTER_TPU_CAMPAIGN_SCALE=k multiplies the batch
+size (dense shapes change with scale: padding tiles, group fan-out, spill)
+for soak runs.
 """
 
 from __future__ import annotations
@@ -50,6 +52,9 @@ from tests.helpers import make_pod, make_provisioner, make_state_node
 
 ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
 SEEDS = range(int(os.environ.get("KARPENTER_TPU_CAMPAIGN_SEEDS", "6")))
+# multiplies the 40-140 pod batch size for scale soaks (dense-path shapes
+# change with batch size: padding tiles, signature-group fan-out, spill)
+SCALE = int(os.environ.get("KARPENTER_TPU_CAMPAIGN_SCALE", "1"))
 
 
 def _rename(pods, seed):
@@ -258,12 +263,12 @@ def _assert_invariants(results, pods):
 def test_randomized_differential_campaign(seed):
     rng = np.random.default_rng(1000 + seed)
     provider = FakeCloudProvider(instance_types(int(rng.integers(20, 120))))
-    pods_dense = _rename(_random_workload(rng, int(rng.integers(40, 140))), seed)
+    pods_dense = _rename(_random_workload(rng, SCALE * int(rng.integers(40, 140))), seed)
     states_dense = _random_states(rng)
     # rebuild identical inputs for the host run (solves mutate their inputs)
     rng2 = np.random.default_rng(1000 + seed)
     provider2 = FakeCloudProvider(instance_types(int(rng2.integers(20, 120))))
-    pods_host = _rename(_random_workload(rng2, int(rng2.integers(40, 140))), seed)
+    pods_host = _rename(_random_workload(rng2, SCALE * int(rng2.integers(40, 140))), seed)
     states_host = _random_states(rng2)
 
     dense_results, solver = _solve(pods_dense, states_dense, provider, dense=True)
