@@ -53,6 +53,7 @@ class PeerFabric:
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
         self._seq = 0
+        self.catalog_broadcasts = 0  # how many dispatches re-sent the catalog
         # catalog epoch cache: caps/prices change rarely, so they are
         # broadcast and placed once per catalog, not per solve — every
         # process updates in lockstep when header[5] announces a new one
@@ -135,6 +136,7 @@ class PeerFabric:
         try:
             self._seq += 1
             has_catalog = int(key != self._catalog_key)
+            self.catalog_broadcasts += has_catalog
             header = np.asarray([OP_SOLVE, Bp, R, Tp, self._seq, has_catalog, 0, 0], dtype=np.int32)
             self._broadcast(header)
             parts = [bucket_stats, allowed]
@@ -214,10 +216,12 @@ def _demo_pods(count: int):
     return pods
 
 
-def run_demo_process(coordinator: str, num_processes: int, process_id: int, pod_count: int = 96) -> dict:
-    """One process of the multi-host demo solve: process 0 runs a full
-    production scheduler solve through DenseSolver(peer_fabric=...), peers
-    serve the SPMD loop. Returns a result dict (for the dryrun / tests).
+def run_demo_process(coordinator: str, num_processes: int, process_id: int, pod_count: int = 96, solves: int = 1) -> dict:
+    """One process of the multi-host demo solve: process 0 runs `solves`
+    sequential production scheduler solves through the SAME
+    DenseSolver(peer_fabric=...) — exercising the catalog-epoch reuse across
+    broadcasts — while peers serve the SPMD loop. Returns a result dict
+    (for the dryrun / tests).
 
     Spawned by __graft_entry__.dryrun_multihost and the multi-process test
     via `python -m karpenter_tpu.parallel.peers`.
@@ -234,26 +238,37 @@ def run_demo_process(coordinator: str, num_processes: int, process_id: int, pod_
     from .. import solver as solver_mod
 
     provider = FakeCloudProvider(instance_types(64))
-    pods = _demo_pods(pod_count)
     dense = solver_mod.DenseSolver(min_batch=1, peer_fabric=fabric)
     from ..api.provisioner import Provisioner
 
-    scheduler = build_scheduler([Provisioner()], provider, pods, dense_solver=dense)
-    results = scheduler.solve(pods)
-    fabric.shutdown()
-    scheduled = sum(len(n.pods) for n in results.new_nodes) + sum(len(v.pods) for v in results.existing_nodes)
+    solves = max(1, solves)
+    scheduled = unschedulable = 0
+    try:
+        for _ in range(solves):
+            pods = _demo_pods(pod_count)
+            scheduler = build_scheduler([Provisioner()], provider, pods, dense_solver=dense)
+            results = scheduler.solve(pods)
+            scheduled += sum(len(n.pods) for n in results.new_nodes) + sum(len(v.pods) for v in results.existing_nodes)
+            unschedulable += len(results.unschedulable)
+    finally:
+        # a coordinator error between solves must not leave peers wedged in
+        # the broadcast barrier: release them before the traceback surfaces
+        fabric.shutdown(best_effort=True)
     return {
         "process": 0,
         "scheduled": scheduled,
-        "requested": pod_count,
+        "requested": pod_count * solves,
+        "solves": solves,
+        "catalog_broadcasts": fabric.catalog_broadcasts,
+        "dense_batches": dense.stats.batches,
         "dense_committed": dense.stats.pods_committed,
         "devices": len(jax.devices()),
         "mesh": {k: int(v) for k, v in fabric.mesh.shape.items()},
-        "unschedulable": len(results.unschedulable),
+        "unschedulable": unschedulable,
     }
 
 
-def run_demo_fleet(n_processes: int = 2, devices_per_process: int = 4, pod_count: int = 96, timeout: float = 300.0):
+def run_demo_fleet(n_processes: int = 2, devices_per_process: int = 4, pod_count: int = 96, timeout: float = 300.0, solves: int = 1):
     """Spawn the demo fleet as OS processes and return their parsed result
     dicts (coordinator first). Shared by __graft_entry__.dryrun_multihost and
     tests/test_multihost_peers.py; children are killed on any failure."""
@@ -280,6 +295,7 @@ def run_demo_fleet(n_processes: int = 2, devices_per_process: int = 4, pod_count
                         "--num-processes", str(n_processes),
                         "--process-id", str(pid),
                         "--pods", str(pod_count),
+                        "--solves", str(solves),
                         "--cpu-devices", str(devices_per_process),
                     ],
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=root,
@@ -309,6 +325,7 @@ if __name__ == "__main__":
     parser.add_argument("--num-processes", type=int, required=True)
     parser.add_argument("--process-id", type=int, required=True)
     parser.add_argument("--pods", type=int, default=96)
+    parser.add_argument("--solves", type=int, default=1)
     parser.add_argument(
         "--cpu-devices",
         type=int,
@@ -326,6 +343,6 @@ if __name__ == "__main__":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    out = run_demo_process(args.coordinator, args.num_processes, args.process_id, args.pods)
+    out = run_demo_process(args.coordinator, args.num_processes, args.process_id, args.pods, args.solves)
     json.dump(out, sys.stdout)
     print()
