@@ -307,6 +307,63 @@ class TestRuntimeOverHttp:
             rt.stop()
             driver.stop()
 
+    def test_consolidation_end_to_end_over_http(self, server):
+        """The live-cluster consolidation scenario (the reference's
+        test/suites/consolidation analog): capacity empties out, the
+        consolidation pass deletes the empty node, and the termination flow
+        finalizes it — every step over HTTP sockets."""
+        from karpenter_tpu.api.objects import OwnerReference
+
+        rt = self._runtime(server, leader_elect=False)
+        driver = HttpKubeClient(server.url)
+        try:
+            # synchronous drive (no background batch loop): each step below
+            # is one deterministic reconcile, the way the reference drives
+            # its controllers in envtest
+            rt.cluster.nomination_ttl = 0.2  # let fresh nominations lapse fast
+            driver.create(make_provisioner(consolidation_enabled=True))
+            pods = []
+            for i in range(2):
+                pod = make_pod(name=f"work-{i}", requests={"cpu": "3"})
+                pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+                pods.append(driver.create(pod))
+            rt.provision_once()
+            nodes = eventually(lambda: driver.list_nodes() or None, message="nodes over HTTP")
+            assert len(nodes) == 2, "3-cpu pods cannot share a 4-cpu node"
+
+            # kubelets come up; the lifecycle controller initializes both
+            for node in nodes:
+                node.status.conditions = [NodeCondition(type="Ready", status="True")]
+                driver.update(node)
+            rt.reconcile_once()
+            eventually(
+                lambda: all(
+                    (driver.get_node(n.name) or n).metadata.labels.get("karpenter.sh/initialized") == "true"
+                    for n in nodes
+                ),
+                message="nodes initialized over HTTP",
+            )
+
+            # bind one pod per node, then one workload scales away
+            for pod, node in zip(pods, nodes):
+                driver.bind_pod(pod, node.name)
+            driver.delete(pods[1], grace=False)
+            action = eventually(
+                lambda: (lambda a: a if a.type.name != "NO_ACTION" else None)(rt.consolidation.process_cluster()),
+                message="consolidation action over HTTP",
+            )
+            assert action.type.name == "DELETE_EMPTY"
+            rt.reconcile_once()
+            eventually(
+                lambda: len(driver.list_nodes()) == 1 or None,
+                message="empty node consolidated away over HTTP",
+            )
+            # the surviving node still runs the remaining workload
+            assert driver.get("Pod", "work-0", "default") is not None
+        finally:
+            rt.stop()
+            driver.stop()
+
     def test_two_runtimes_one_leader(self, server):
         rt_a = self._runtime(server, leader_elect=True)
         rt_b = self._runtime(server, leader_elect=True)
