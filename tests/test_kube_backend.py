@@ -338,7 +338,7 @@ class TestRuntimeOverHttp:
             rt.reconcile_once()
             eventually(
                 lambda: all(
-                    (driver.get_node(n.name) or n).metadata.labels.get("karpenter.sh/initialized") == "true"
+                    (driver.get_node(n.name) or n).metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
                     for n in nodes
                 ),
                 message="nodes initialized over HTTP",
