@@ -219,12 +219,14 @@ class FakeCloudProvider(CloudProvider):
         self.insufficient_capacity_pools: set = set()  # {(instance_type, zone, capacity_type)}
         self._lock = threading.Lock()
         self._counter = itertools.count(1)
+        self.live_instances: set = set()  # node names with a live fake instance
 
     def reset(self) -> None:
         self.create_calls = []
         self.delete_calls = []
         self.next_create_error = None
         self.insufficient_capacity_pools = set()
+        self.live_instances = set()
 
     def create(self, node_request: NodeRequest) -> Node:
         with self._lock:
@@ -247,6 +249,8 @@ class FakeCloudProvider(CloudProvider):
 
     def _to_node(self, node_request: NodeRequest, it: InstanceType, offering: Offering, n: int) -> Node:
         name = f"fake-node-{n:05d}"
+        with self._lock:
+            self.live_instances.add(name)
         labels = dict(node_request.template.labels)
         labels.update(node_request.template.requirements.labels())
         # provider-injected well-known labels
@@ -279,6 +283,15 @@ class FakeCloudProvider(CloudProvider):
 
     def delete(self, node: Node) -> None:
         self.delete_calls.append(node)
+        with self._lock:
+            self.live_instances.discard(node.metadata.name)
+
+    def instance_exists(self, node: Node):
+        # only nodes this provider launched are knowable; anything else
+        # (fixture-made nodes) is reported gone, which preserves the
+        # age-based consolidation escape for synthetic test nodes
+        with self._lock:
+            return node.metadata.name in self.live_instances
 
     def get_instance_types(self, provisioner: Provisioner) -> List[InstanceType]:
         return list(self.instance_types_list)

@@ -42,6 +42,12 @@ class MetricsCloudProvider(CloudProvider):
         with self._timed("GetInstanceTypes"):
             return self.inner.get_instance_types(provisioner)
 
+    def instance_exists(self, node: Node):
+        # concrete on the base class, so __getattr__ never fires for it:
+        # delegate explicitly or the inner provider's answer is lost
+        with self._timed("InstanceExists"):
+            return self.inner.instance_exists(node)
+
     def name(self) -> str:
         return self.inner.name()
 

@@ -260,6 +260,10 @@ class CloudBackend:
             self.terminate_calls.append(instance_id)
             self.instances.pop(instance_id, None)
 
+    def instance_exists(self, instance_id: str) -> bool:
+        with self._lock:
+            return instance_id in self.instances
+
     def reset(self) -> None:
         with self._lock:
             self.insufficient_capacity_pools = set()

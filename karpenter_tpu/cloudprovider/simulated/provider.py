@@ -299,8 +299,11 @@ class SimulatedCloudProvider(CloudProvider):
         labels[lbl.LABEL_CAPACITY_TYPE] = instance.capacity_type
         name = instance.instance_id
         labels[lbl.LABEL_HOSTNAME] = name
-        if isinstance(it, SimulatedInstanceType):
-            labels[lbl.LABEL_ARCH] = it.info.architecture
+        # duck-typed: scheduler-side wrappers (kubelet maxPods cap) are not
+        # SimulatedInstanceType instances but forward .info to the adapter
+        info = getattr(it, "info", None)
+        if info is not None:
+            labels[lbl.LABEL_ARCH] = info.architecture
             labels[lbl.LABEL_OS] = lbl.OS_LINUX
         capacity = dict(it.resources()) if it is not None else {}
         allocatable = res.clamp_negative_to_zero(res.subtract(capacity, it.overhead())) if it is not None else {}
@@ -318,3 +321,8 @@ class SimulatedCloudProvider(CloudProvider):
     def delete(self, node: Node) -> None:
         if node.spec.provider_id.startswith("sim:///"):
             self.backend.terminate_instance(node.spec.provider_id.split("///", 1)[1])
+
+    def instance_exists(self, node: Node):
+        if not node.spec.provider_id.startswith("sim:///"):
+            return None  # not ours to answer for
+        return self.backend.instance_exists(node.spec.provider_id.split("///", 1)[1])

@@ -97,6 +97,17 @@ class CloudProvider(abc.ABC):
     @abc.abstractmethod
     def delete(self, node: Node) -> None: ...
 
+    def instance_exists(self, node: Node) -> Optional[bool]:
+        """Liveness of the backing instance: True if it still exists at the
+        cloud, False if it is gone, None if the provider cannot tell.
+
+        Consolidation uses this to distinguish "large slice legitimately
+        booting longer than the replace window" (alive, keep blocking the
+        pass) from "launch that died and will never become capacity" (gone,
+        stop blocking). Optional: the default None keeps the age-based
+        fallback."""
+        return None
+
     @abc.abstractmethod
     def get_instance_types(self, provisioner: Provisioner) -> List[InstanceType]: ...
 

@@ -228,12 +228,13 @@ class ConsolidationController:
         return ConsolidationAction(ActionType.NO_ACTION, reason="no beneficial action")
 
     def _uninitialized_node_exists(self) -> bool:
-        """An owned node still warming up blocks the pass — but only within
-        the same window the replace path waits on its own launches
-        (REPLACE_READY_TIMEOUT). Past that the node is presumed stuck, and a
-        launch that will never become capacity must not wedge consolidation
-        forever (the reference relies on external liveness cleanup it does
-        not have here; see the reaper note above)."""
+        """An owned node still warming up blocks the pass (controller.go:196-203).
+        Past REPLACE_READY_TIMEOUT the call is made on cloud-provider instance
+        liveness, not wall clock alone: an instance that still exists but never
+        registered (a large TPU slice can legitimately boot longer than the
+        replace window) keeps blocking, while a launch whose instance is gone
+        must not wedge consolidation forever. Providers that cannot answer
+        (instance_exists → None) fall back to the age-based escape."""
         blocked = False
 
         def visit(state: StateNode) -> bool:
@@ -242,7 +243,8 @@ class ConsolidationController:
             if not state.owned() or state.initialized() or node.metadata.deletion_timestamp is not None:
                 return True
             if self.clock.now() - node.metadata.creation_timestamp >= self.REPLACE_READY_TIMEOUT:
-                return True  # stuck, not warming
+                if not self.cloud_provider.instance_exists(node):
+                    return True  # instance gone (or unknowable): stuck, not warming
             blocked = True
             return False
 

@@ -28,6 +28,10 @@ from ...utils import pod as podutils
 from ...utils import resources as res
 
 
+# distinguishes "no node prefetch was attempted" from "prefetched, missing"
+_NOT_FETCHED = object()
+
+
 class StateNode:
     def __init__(self, cluster: "Cluster", node: Node):
         self.cluster = cluster
@@ -170,13 +174,23 @@ class Cluster:
 
     def _on_pod_event(self, event: WatchEvent) -> None:
         pod: Pod = event.obj
+        # a binding to a node we haven't seen needs a node fetch; on the HTTP
+        # backend that's a network round trip, so do it BEFORE taking the lock
+        # (holding it would serialize all state access on apiserver latency)
+        prefetched = _NOT_FETCHED
+        bound_to = pod.spec.node_name or None
+        if bound_to is not None and event.type != DELETED and not podutils.is_terminal(pod):
+            with self._lock:
+                known = bound_to in self._nodes
+            if not known:
+                prefetched = self.kube.get_node(bound_to)
         with self._lock:
             if event.type == DELETED or podutils.is_terminal(pod):
                 self._remove_pod(pod)
                 return
-            self._update_pod(pod)
+            self._update_pod(pod, prefetched)
 
-    def _update_pod(self, pod: Pod) -> None:
+    def _update_pod(self, pod: Pod, prefetched_node=_NOT_FETCHED) -> None:
         key = _pod_key(pod)
         old_node = self._bindings.get(key)
         new_node = pod.spec.node_name or None
@@ -197,10 +211,12 @@ class Cluster:
             self._anti_affinity_pods[key] = pod
         state = self._nodes.get(new_node)
         if state is None:
-            # bound to a node we haven't seen: pull it from the API now —
-            # creating the state entry replays this binding too — instead of
-            # waiting on a node event that may never come (cluster.go:448-464)
-            node = self.kube.get_node(new_node)
+            # bound to a node we haven't seen: use the node fetched before the
+            # lock — creating the state entry replays this binding too — rather
+            # than waiting on a node event that may never come (cluster.go:448-464).
+            # Only the rare race where the node entry vanished between the
+            # prefetch check and now falls back to a blocking fetch.
+            node = prefetched_node if prefetched_node is not _NOT_FETCHED else self.kube.get_node(new_node)
             if node is not None:
                 self._update_node(node)
         elif key not in state.pod_requests:

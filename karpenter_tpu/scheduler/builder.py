@@ -83,6 +83,19 @@ class _MaxPodsInstanceType(InstanceType):
     def price(self) -> float:
         return self._inner.price()
 
+    def __getattr__(self, name):
+        # provider-specific adapters expose extra attributes (e.g. the
+        # simulated provider reads .info for arch/os labels); forward so
+        # wrapping never hides the underlying adapter's surface. Private
+        # names never forward: pickle probes them before __init__ has set
+        # _inner, which would recurse here
+        if name.startswith("_"):
+            raise AttributeError(name)
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
 
 # wrapper lists memoized on the wrapped instance-type OBJECTS (providers
 # return a fresh list copy per call but TTL-cache the items), so the dense

@@ -154,6 +154,30 @@ class TestConsolidationGuards:
         action = env.consolidation.process_cluster()
         assert action.type == ActionType.DELETE_EMPTY, "stuck node must stop blocking"
 
+    def test_slow_booting_live_instance_blocks_past_window(self):
+        # past the replace window the escape keys on cloud-provider instance
+        # liveness, not wall clock: a big slice legitimately booting longer
+        # than 270s (instance alive, kubelet not registered) must keep
+        # blocking; only a dead launch stops blocking (ADVICE r3)
+        env = DeprovEnv(provisioners=[consolidatable_provisioner()])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+
+        warming = make_node(labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": 4}, ready=False)
+        warming.metadata.creation_timestamp = env.clock.now()
+        env.kube.create(warming)
+        env.provider.live_instances.add(warming.name)
+
+        env.clock.step(env.consolidation.REPLACE_READY_TIMEOUT + 1)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.NO_ACTION, "live instance still warming must block"
+        assert "uninitialized" in action.reason
+
+        env.provider.live_instances.discard(warming.name)
+        action = env.consolidation.process_cluster()
+        assert action.type == ActionType.DELETE_EMPTY, "dead launch must stop blocking"
+
     def test_replace_maintains_zonal_topology_spread(self):
         # three spread pods across three zones; consolidating one node must
         # not let the spread collapse (suite_test.go:721). The simulation

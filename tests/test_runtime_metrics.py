@@ -60,6 +60,20 @@ class TestRuntime:
         assert duration is not None
         assert duration.count(controller="cloudprovider", method="Create", provider="fake") >= 1
 
+    def test_metrics_decorator_delegates_instance_exists(self):
+        # instance_exists is concrete on the CloudProvider base, so the
+        # decorator's __getattr__ never fires for it — it must delegate
+        # explicitly or consolidation's liveness escape sees None (code
+        # review r4); the runtime hands the DECORATED provider to controllers
+        runtime, _ = make_runtime()
+        runtime.kube.create(make_provisioner())
+        runtime.kube.create(make_pod())
+        runtime.provision_once()
+        node = runtime.kube.list_nodes()[0]
+        assert runtime.cloud_provider.instance_exists(node) is True
+        runtime.cloud_provider.inner.live_instances.discard(node.metadata.name)
+        assert runtime.cloud_provider.instance_exists(node) is False
+
     def test_leader_election_exclusive(self):
         from karpenter_tpu.runtime import LeaderElector
 

@@ -366,3 +366,21 @@ class TestKubeletConfigFlow:
         provider.create(NodeRequest(template=template, instance_type_options=types[:1]))
         payloads = [t.user_data for t in backend.launch_templates.values()]
         assert any("--max-pods=42" in p and "--cluster-dns=10.1.0.10" in p for p in payloads)
+
+    def test_max_pods_wrapped_types_keep_arch_os_labels(self, provider):
+        # the scheduler wraps instance types to cap pod density when
+        # kubeletConfiguration.maxPods is set; the wrapper must not hide the
+        # adapter surface the provider reads for arch/os labels (ADVICE r3)
+        from karpenter_tpu.api.provisioner import KubeletConfiguration
+        from karpenter_tpu.scheduler.builder import apply_kubelet_max_pods
+
+        prov = make_provisioner()
+        prov.spec.kubelet_configuration = KubeletConfiguration(max_pods=17)
+        provider.kube.create(prov)
+        types = apply_kubelet_max_pods(prov, provider.get_instance_types(prov))
+        assert all(t.resources()["pods"] <= 17 for t in types)
+        template = NodeTemplate.from_provisioner(prov)
+        node = provider.create(NodeRequest(template=template, instance_type_options=types[:1]))
+        assert node.metadata.labels[lbl.LABEL_ARCH] in ("amd64", "arm64")
+        assert node.metadata.labels[lbl.LABEL_OS] == lbl.OS_LINUX
+        assert node.status.capacity["pods"] == 17
