@@ -441,6 +441,86 @@ class DenseSolver:
             scores[pos] += float(np.floor((free[positive] / typical[positive]).min()))
         return scores
 
+    def _choose_spread_targets(
+        self, c: np.ndarray, warm: np.ndarray, n: int, s: int, frozen_levels: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Per-domain adds for a spread cohort that maximize
+        (pods placed, warm absorption, evenness) over every final-skew-
+        feasible assignment.
+
+        Feasibility is the kube invariant on FINAL counts: with M the final
+        global minimum over pod-eligible domains, every final level — fillable
+        c[i]+a[i] and frozen (eligible but unreachable, fixed) — must sit in
+        [M, M+s]. Any such assignment is reachable by the reference's per-pod
+        min-count order (topologygroup.go:157-184): always placing into the
+        currently-lowest fillable domain below target keeps transient skew
+        within s. The search walks candidate M values (the loop is bounded by
+        mandatory-fill exceeding n, ~n/D + s steps); per M the allocation is
+        mandatory lifts to M, then warm-capacity preference, then an even
+        water-fill of the remainder under the M+s cap. Returns adds aligned
+        with `c`'s order, or None when no band is feasible (frozen levels
+        more than s apart — the even path's cap semantics handle that)."""
+        D = len(c)
+        have_frozen = frozen_levels.size > 0
+        lo = int(c.max()) - s
+        if have_frozen:
+            lo = max(lo, int(frozen_levels.max()) - s)
+        # M below every current level only shrinks the band's ceiling with the
+        # same lower bounds — dominated by M = floor, so start there
+        floor = min(int(c.min()), int(frozen_levels.min())) if have_frozen else int(c.min())
+        lo = max(lo, floor)
+        hi = int(frozen_levels.min()) if have_frozen else int(c.min()) + n
+        if lo > hi:
+            return None
+        best_score = None
+        best_adds = None
+        for M in range(lo, hi + 1):
+            lower = np.maximum(c, M)
+            mandatory = int((lower - c).sum())
+            if mandatory > n:
+                break  # monotone in M
+            upper = M + s
+            max_total = int((upper - c).sum())
+            placed = min(n, max_total)
+            a = (lower - c).astype(np.int64)
+            budget = placed - mandatory
+            # warm preference: absorb into domains with remaining warm
+            # capacity, lowest current count first (deterministic)
+            if budget > 0:
+                for i in np.lexsort((np.arange(D), c)):
+                    if budget <= 0:
+                        break
+                    t = min(max(int(warm[i]) - int(a[i]), 0), upper - int(c[i]) - int(a[i]), budget)
+                    if t > 0:
+                        a[i] += t
+                        budget -= t
+            # even water-fill of the remainder under the band cap
+            while budget > 0:
+                levels = c + a
+                open_i = np.flatnonzero(levels < upper)
+                if open_i.size == 0:
+                    break
+                lvl_sorted = open_i[np.argsort(levels[open_i], kind="stable")]
+                # raise the lowest tier as one block
+                lowest = levels[lvl_sorted[0]]
+                tier = [int(i) for i in lvl_sorted if levels[i] == lowest]
+                next_stop = min(
+                    int(levels[lvl_sorted[len(tier)]]) if len(tier) < lvl_sorted.size else upper, upper
+                )
+                gap = (next_stop - lowest) * len(tier)
+                take = min(budget, gap)
+                per = take // len(tier)
+                extra = take - per * len(tier)
+                for k, i in enumerate(tier):
+                    a[i] += per + (1 if k < extra else 0)
+                budget -= take
+            absorption = int(np.minimum(a, warm).sum())
+            score = (placed, absorption, M)
+            if best_score is None or score > best_score:
+                best_score = score
+                best_adds = a.copy()
+        return best_adds
+
     def _water_fill(
         self, problem, topology, group, rows: List[int], domains: List[str], allowed: np.ndarray, pin_kind: str, scheduler=None
     ) -> List[_Bucket]:
@@ -472,39 +552,47 @@ class DenseSolver:
             frozen = [i for i, d in enumerate(domains) if not allowed[i] and (pod_req is None or pod_req.has(d))]
             if frozen:
                 cap = counts_all[frozen].min() + group.max_skew
-        # fill lowest-count domains first; among EQUAL counts, prefer domains
-        # whose warm nodes can absorb more of this cohort — the skew math
-        # only depends on the sorted counts, so the tie-break is free, and it
-        # keeps spread fragments off fresh bins when existing capacity exists
-        # in a sibling domain (the host loop gets this by trying existing
-        # nodes first; campaign seed 12 is the regression shape)
-        if len(np.unique(counts)) < len(counts):
-            warm = self._warm_absorbable(scheduler, problem, group, rows, [domains[i] for i in allowed_idx])
-            order = np.lexsort((-warm, counts))
-        else:
-            # no ties: warm scores cannot change the order, skip the scan
-            order = np.argsort(counts, kind="stable")
-        counts_sorted = counts[order]
-        targets = counts_sorted.copy()
-        remaining = n
-        # raise the water level step by step (vectorized over ~few domains)
-        for level_idx in range(1, len(targets) + 1):
-            if remaining <= 0:
-                break
-            if level_idx < len(targets):
-                gap = (counts_sorted[level_idx] - targets[:level_idx]).sum()
-                take = min(remaining, gap)
-            else:
-                take = remaining
-            if take > 0:
-                per = int(take // level_idx)
-                extra = int(take - per * level_idx)
-                targets[:level_idx] += per
-                targets[:extra] += 1
-                remaining -= take
-        if np.isfinite(cap):
-            targets = np.minimum(targets, np.maximum(counts_sorted, cap))
-        adds = (targets - counts_sorted).astype(np.int64)
+        # capacity-aware assignment (scheduler.go:191-195 existing-first, in
+        # closed form): among all final-skew-feasible per-domain targets,
+        # maximize warm absorption — a pod assigned to a domain whose warm
+        # nodes can take it never opens a fresh bin, which is how the host
+        # loop's per-pod existing-nodes-first order spends warm capacity.
+        # Evenness is only the tie-break, not the objective.
+        warm = self._warm_absorbable(scheduler, problem, group, rows, [domains[i] for i in allowed_idx])
+        frozen_levels = counts_all[frozen] if (group.max_skew and frozen) else np.empty(0)
+        adds = None
+        order = np.argsort(counts, kind="stable")
+        if group.max_skew and warm.any():
+            chosen = self._choose_spread_targets(
+                counts.astype(np.int64), warm.astype(np.int64), n, int(group.max_skew), frozen_levels.astype(np.int64)
+            )
+            if chosen is not None:
+                adds = chosen  # aligned with allowed_idx order
+                order = np.arange(len(allowed_idx))
+        if adds is None:
+            # even water-fill (no warm capacity / no skew bound / no feasible
+            # band): lowest-count domains first, frozen-domain cap applied
+            counts_sorted = counts[order]
+            targets = counts_sorted.copy()
+            remaining = n
+            # raise the water level step by step (vectorized over ~few domains)
+            for level_idx in range(1, len(targets) + 1):
+                if remaining <= 0:
+                    break
+                if level_idx < len(targets):
+                    gap = (counts_sorted[level_idx] - targets[:level_idx]).sum()
+                    take = min(remaining, gap)
+                else:
+                    take = remaining
+                if take > 0:
+                    per = int(take // level_idx)
+                    extra = int(take - per * level_idx)
+                    targets[:level_idx] += per
+                    targets[:extra] += 1
+                    remaining -= take
+            if np.isfinite(cap):
+                targets = np.minimum(targets, np.maximum(counts_sorted, cap))
+            adds = (targets - counts_sorted).astype(np.int64)
         buckets = []
         cursor = 0
         for pos, count in zip(order, adds):
@@ -681,6 +769,7 @@ class DenseSolver:
             return n
 
         spread_units: Dict[int, List[_Bucket]] = {}
+        plain_buckets: List[_Bucket] = []
         for bucket in buckets:
             if not bucket.pod_rows or bucket.zone == "__infeasible__":
                 continue
@@ -743,101 +832,211 @@ class DenseSolver:
             group = problem.groups[bucket.group_index]
             if group.kind == GroupKind.SPREAD:
                 spread_units.setdefault(bucket.group_index, []).append(bucket)
-                continue
-            # plain / zone-pinned affinity: class-vectorized greedy fill —
-            # select per view across ALL size classes numerically, then land
-            # the whole selection as ONE cohort so the exact protocol runs
-            # once per (bucket, view) instead of once per size class
-            ctx = ctx_of(bucket.group_index)
+            else:
+                # plain / zone-pinned: least constrained — they fill AFTER the
+                # spread units below (most-constrained-first), because a plain
+                # pod displaced from warm capacity packs into a cheap fresh
+                # bin while a displaced spread fragment opens a near-empty
+                # domain-pinned one (the host loop's per-pod existing-first
+                # order never starves constrained pods this way)
+                plain_buckets.append(bucket)
+
+        # unified warm fill: ONE view-major pass over spread AND plain
+        # buckets with size classes globally sorted by the host queue's FFD
+        # key — the host loop is one FFD order over every pod (queue.py), so
+        # the largest pod anywhere gets first claim on warm capacity,
+        # whatever its constraint kind; any phase ordering (plain-first or
+        # spread-first) strands some other kind's big pod on a fresh bin.
+        #
+        # Spread buckets participate via RESERVATIONS: every spread pod's
+        # planned domain count is recorded UP FRONT (scaffolding only — the
+        # unplaced remainder is unrecorded at the end of the fill, and
+        # _apply_commit records the real bins). The host loop interleaves
+        # opening new nodes with warm placement, so its per-pod skew check
+        # runs against counts that already include the nodes it has opened;
+        # pre-recording the (band-feasible) water-fill plan gives view.add
+        # the same picture, making spread commits order-independent: a warm
+        # placement swaps a planned fresh-bin pod for a warm one in the SAME
+        # domain, so final counts equal the plan no matter how many commit.
+        # Reservations only remove false vetoes; they never admit a
+        # placement whose final state is infeasible.
+        reservation_ledger: Dict[tuple, list] = {}  # (id(tg), domain) -> [tg, domain, count]
+        spread_meta: Dict[int, tuple] = {}  # id(bucket) -> (domain, count_groups)
+        for g, unit in spread_units.items():
+            group = problem.groups[g]
+            ctx = ctx_of(g)
+            # the topology groups that would count these pods, for this key
+            count_groups = [
+                tg for tg in {id(t): t for t in (ctx.owned + ctx.selected)}.values() if tg.key == group.topology_key
+            ]
+            for bucket in unit:
+                domain = bucket.zone if bucket.zone is not None else bucket.capacity_type
+                spread_meta[id(bucket)] = (domain, count_groups)
+                n_rows = len(bucket.pod_rows)
+                for tg in count_groups:
+                    tg.record(domain, count=n_rows)
+                    entry = reservation_ledger.setdefault((id(tg), domain), [tg, domain, 0])
+                    entry[2] += n_rows
+
+        fill_buckets = plain_buckets + [b for unit in spread_units.values() for b in unit]
+        total_fill = sum(len(b.pod_rows) for b in fill_buckets)
+        if 0 < total_fill <= self._FILL_EXACT_MAX_PODS:
+            # exact host-order fill: per pod in the host queue's FFD order,
+            # first view (in index order) the exact protocol accepts — byte
+            # for byte the reference's existing-nodes-first pass
+            # (scheduler.go:191-195) for every non-dedicated bucket. Spread
+            # pods may land in ANY group-allowed domain (the sibling-domain
+            # warm re-home the host loop gets for free): the pod's own
+            # reservation lifts first, so view.add judges "final counts
+            # without me", and a cross-domain success just moves one pod of
+            # the plan from fresh-bin-in-d to warm-in-d'. Above the scale
+            # gate the class-vectorized pass below takes over — there the
+            # per-pod protocol would dominate wall clock while fragments are
+            # a vanishing cost fraction.
+            from ..scheduler.queue import ffd_sort_key
+
+            zone_index = {z: i for i, z in enumerate(problem.zones)}
+            ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
+            fill_pods = [(row, bucket) for bucket in fill_buckets for row in bucket.pod_rows]
+            fill_pods.sort(key=lambda rb: ffd_sort_key(problem.pods[rb[0]]))
+            for row, bucket in fill_pods:
+                group = problem.groups[bucket.group_index]
+                req = problem.requests[row]
+                meta = spread_meta.get(id(bucket))
+                fit_views = np.flatnonzero(usable & (req <= head).all(axis=1))
+                if fit_views.size == 0:
+                    continue
+                if meta is not None:
+                    domain, count_groups = meta
+                    for tg in count_groups:
+                        tg.unrecord(domain)
+                placed = False
+                for vi in fit_views:
+                    vi = int(vi)
+                    if meta is None:
+                        if not view_ok(bucket, group, vi):
+                            continue
+                    else:
+                        # any domain the group allows; exact skew decides
+                        if bucket.zone is not None:
+                            dv = zone_index.get(zone_of[vi])
+                            if dv is None or not problem.group_zone_allowed[bucket.group_index][dv]:
+                                continue
+                        else:
+                            dv = ct_index.get(ct_of[vi])
+                            if dv is None or not problem.group_ct_allowed[bucket.group_index][dv]:
+                                continue
+                        if not self._view_accepts(group, views[vi]):
+                            continue
+                    if commit(vi, row, ctx_of(bucket.group_index)):
+                        placed = True
+                        break
+                if meta is not None:
+                    if placed:
+                        for tg in count_groups:
+                            reservation_ledger[(id(tg), domain)][2] -= 1
+                    else:
+                        for tg in count_groups:
+                            tg.record(domain)
+            for bucket in fill_buckets:
+                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
+            for tg, domain, count in reservation_ledger.values():
+                if count:
+                    tg.unrecord(domain, count=count)
+            return committed, taken
+
+        entries = []  # one per (bucket, size class)
+        for bucket in fill_buckets:
+            group = problem.groups[bucket.group_index]
             rows = bucket.pod_rows
             unique, counts, inverse = dedupe_sizes(problem.requests[rows])
-            U = len(unique)
-            class_rows: List[List[int]] = [[] for _ in range(U)]
+            class_rows: List[List[int]] = [[] for _ in range(len(unique))]
             for local, u in enumerate(inverse):
                 class_rows[int(u)].append(rows[local])
-            cursor = [0] * U
-            remaining = counts.astype(np.int64).copy()
-            # capacity prescreen: only visit views that fit at least one size
-            # class right now (commits only shrink already-visited rows, so
-            # unvisited rows of this one-shot matrix never go stale)
-            cand_views = np.flatnonzero((unique[:, None, :] <= head[None, :, :]).all(axis=2).any(axis=0))
+            for u in range(len(unique)):
+                entries.append(
+                    {
+                        "bucket": bucket,
+                        "group": group,
+                        "size": unique[u],
+                        "rows": class_rows[u],
+                        "cursor": 0,
+                    }
+                )
+        if entries:
+            sizes_mat = np.stack([e["size"] for e in entries])
+            # same FFD key as the host queue sort (cpu, then memory, descending)
+            order_e = np.lexsort((-sizes_mat[:, 1], -sizes_mat[:, 0]))
+            entries = [entries[i] for i in order_e]
+            sizes_mat = sizes_mat[order_e]
+            # capacity prescreen: views that fit at least one class right now
+            # (commits only shrink already-visited rows, so unvisited rows of
+            # this one-shot matrix never go stale)
+            cand_views = np.flatnonzero((sizes_mat[:, None, :] <= head[None, :, :]).all(axis=2).any(axis=0))
+            total_remaining = sum(len(e["rows"]) for e in entries)
             for vi in cand_views:
-                if remaining.sum() == 0:
+                if total_remaining == 0:
                     break
-                if not view_ok(bucket, group, vi):
-                    continue
                 free = head[vi].copy()
-                selection: List[int] = []
-                take: List[int] = [0] * U
-                for u in range(U):
-                    if remaining[u] == 0:
+                selections: Dict[int, List[int]] = {}  # bucket id -> rows
+                picked: List[tuple] = []  # (entry, k)
+                for e in entries:
+                    rem = len(e["rows"]) - e["cursor"]
+                    if rem == 0:
                         continue
-                    size = unique[u]
+                    size = e["size"]
                     # every size class has pods >= 1 (pod_requests adds it),
                     # so at least one positive component always exists
                     positive = size > 1e-12
-                    k = int(min(np.floor(free[positive] / size[positive]).min(), remaining[u]))
+                    k = int(min(np.floor(free[positive] / size[positive]).min(), rem))
                     if k <= 0:
                         continue
-                    selection.extend(class_rows[u][cursor[u] : cursor[u] + k])
-                    take[u] = k
+                    if not view_ok(e["bucket"], e["group"], vi):
+                        continue
+                    selections.setdefault(id(e["bucket"]), []).extend(e["rows"][e["cursor"] : e["cursor"] + k])
+                    picked.append((e, k))
                     free = free - size * k
-                if not selection:
+                if not picked:
                     continue
-                placed = commit_run(vi, selection, ctx)
-                left = placed
-                for u in range(U):
-                    t = min(take[u], left)
-                    cursor[u] += t
-                    remaining[u] -= t
-                    left -= t
-                # placed < len(selection) means the exact check vetoed this
-                # view mid-run; move on to the next view (same as the old
-                # per-class bail)
-            bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
+                # land each bucket's selection as one cohort; a veto mid-run
+                # only loses that bucket's tail (same as the old per-class
+                # bail) — view.add's exact resource check protects `free`'s
+                # optimism across buckets. For a spread bucket the selection's
+                # reservations lift just before the adds (the pods are moving
+                # from planned-fresh to warm within the SAME domain) and the
+                # unplaced tail re-reserves after.
+                placed_of: Dict[int, int] = {}
+                for e, k in picked:
+                    bid = id(e["bucket"])
+                    if bid not in placed_of:
+                        sel = selections[bid]
+                        meta = spread_meta.get(bid)
+                        if meta is not None:
+                            domain, count_groups = meta
+                            for tg in count_groups:
+                                tg.unrecord(domain, count=len(sel))
+                        n_placed = commit_run(vi, sel, ctx_of(e["bucket"].group_index))
+                        if meta is not None:
+                            leftover = len(sel) - n_placed
+                            for tg in count_groups:
+                                if leftover:
+                                    tg.record(domain, count=leftover)
+                                reservation_ledger[(id(tg), domain)][2] -= n_placed
+                        placed_of[bid] = n_placed
+                for e, k in picked:
+                    bid = id(e["bucket"])
+                    t = min(k, placed_of[bid])
+                    e["cursor"] += t
+                    placed_of[bid] -= t
+                    total_remaining -= t
+            for bucket in fill_buckets:
+                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
 
-        # spread groups: one pod at a time, lowest-count zone first. A commit
-        # veto here is the topology min-count rule firing because a domain
-        # with NO existing capacity holds the min — zone-global, not a
-        # property of the view — and the blocker's count cannot move until
-        # the new-bin solve records its cohorts, so the whole domain blocks
-        # for the rest of this fill (its remaining pods take new bins; the
-        # next batch sees equalized counts and can fill further).
-        for g, unit in spread_units.items():
-            group = problem.groups[g]
-            states = []  # per bucket/domain: descending-size row queue, count, viable views
-            for bucket in unit:
-                domain = bucket.zone if bucket.zone is not None else bucket.capacity_type
-                count = int(
-                    self._existing_counts(scheduler.topology, group, group.topology_key, [domain])[0]
-                )
-                order = np.lexsort(tuple(-problem.requests[bucket.pod_rows][:, c] for c in (1, 0)))
-                queue = [bucket.pod_rows[i] for i in order]
-                viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
-                states.append({"bucket": bucket, "queue": queue, "count": count, "views": np.asarray(viable, dtype=np.int64), "blocked": False})
-            while True:
-                live = [s for s in states if len(s["queue"]) and len(s["views"]) and not s["blocked"]]
-                if not live:
-                    break
-                state = min(live, key=lambda s: s["count"])
-                row = state["queue"][0]
-                req = problem.requests[row]
-                placed = False
-                # head is maintained by commit, so this slice is always fresh
-                hits = np.flatnonzero((req <= head[state["views"]]).all(axis=1))
-                if hits.size:
-                    vi = int(state["views"][int(hits[0])])
-                    if commit(vi, row, ctx_of(g)):
-                        placed = True
-                    else:
-                        state["blocked"] = True  # skew veto: domain-wide, retry never helps
-                if placed:
-                    state["queue"].pop(0)
-                    state["count"] += 1
-                elif not state["blocked"]:
-                    state["queue"].pop(0)  # no capacity for this pod; new-bin it
-            for state in states:
-                state["bucket"].pod_rows = [r for r in state["bucket"].pod_rows if not taken[r]]
+        # retract the reservations of the pods that stayed planned-fresh;
+        # _apply_commit records their real bins
+        for tg, domain, count in reservation_ledger.values():
+            if count:
+                tg.unrecord(domain, count=count)
 
         return committed, taken
 
@@ -1110,6 +1309,10 @@ class DenseSolver:
         )
 
     _FRAGMENT_MAX_PODS = 3
+    # warm fills up to this many pods run the exact per-pod host-order pass
+    # (cost parity with the reference's existing-first rule); larger fills
+    # use the class-vectorized pass where per-pod protocol would dominate
+    _FILL_EXACT_MAX_PODS = 2048
 
     def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray, caps_eff: np.ndarray, reroute_fragments: bool = False) -> dict:
         """Pure assembly + audit of the per-bucket packings: global bin ids,
@@ -1122,13 +1325,17 @@ class DenseSolver:
         pack is one bin of <=3 pods is handed to the exact host loop instead
         of opening a near-empty fresh node — the host loop mixes such pods
         onto existing capacity (or shares one node across cohorts), which
-        bucketed packing cannot. Deliberately narrow: only single-bin packs
-        (bin ordering and spill-donor assumptions stay intact), never
+        bucketed packing cannot. SPREAD fragments reroute too: the host loop
+        runs the exact per-pod skew protocol (topologygroup.go:157-184)
+        against counts that include every dense-committed bin, so wherever it
+        re-places the fragment — warm capacity in a sibling domain, a shared
+        node, or a fresh bin in the planned domain — the final skew stays
+        legal, and it is precisely the mixed-cohort node sharing the host
+        path gets on warm clusters. Deliberately narrow: only single-bin
+        packs (bin ordering and spill-donor assumptions stay intact), never
         dedicated/single_bin semantics (one-pod bins ARE their contract),
-        never water-filled SPREAD buckets (their skew is correct only if the
-        whole per-domain assignment commits), and bounded by a per-solve
-        budget so a batch whose NATURAL pattern is tiny bins cannot stampede
-        into the O(pods x open-nodes) host loop."""
+        and bounded by a per-solve budget so a batch whose NATURAL pattern is
+        tiny bins cannot stampede into the O(pods x open-nodes) host loop."""
         bin_of_row = np.full((problem.P,), -1, np.int64)
         bin_bucket_list: List[int] = []
         next_bin = 0
@@ -1143,7 +1350,6 @@ class DenseSolver:
                 and len(rows) <= self._FRAGMENT_MAX_PODS
                 and not buckets[b].dedicated
                 and not buckets[b].single_bin
-                and problem.groups[buckets[b].group_index].kind != GroupKind.SPREAD
             ):
                 reroute_budget -= len(rows)
                 ids_local = np.full_like(ids_local, -1)  # host loop owns them
@@ -1224,42 +1430,52 @@ class DenseSolver:
         through the exact host loop.
 
         The per-bucket dense pack cannot share one node between two
-        constraint groups, so each bucket's remainder bin may open a node
-        whose pods would have fit spare capacity on another bucket's bin —
-        the one structural cost gap vs the ILP optimum (measured by
+        constraint groups, so each bucket's bins may open nodes whose pods
+        the host loop would have mixed onto shared capacity — the one
+        structural cost gap vs the ILP optimum (measured by
         tests/test_cost_regret.py). A donor's pods are not committed as
         their own bin; _apply_commit re-adds each one directly onto the
         nominated receiver's VirtualNode through the exact add protocol
-        (node.py:add — the same per-pod checks the host loop would run,
-        without its O(pods x open-nodes) scan); pods the protocol vetoes
-        fall back to the host loop.
+        (node.py:add — the same per-pod checks the host loop would run),
+        and the add itself re-filters the receiver's instance-type options,
+        so absorbing a donor can UPGRADE the receiver to a larger type;
+        pods the protocol vetoes fall back to the host loop.
 
-        Cost-neutral spare: free capacity under the bin's cheapest surviving
-        type, so absorbing a spilled pod can never raise that bin's launch
-        price. Only PLAIN buckets participate (topology-pinned buckets need
-        domain bookkeeping the host relaxation ladder owns). Donors are
-        considered smallest-first; a receiver is claimed by at most one
-        donor, must itself be committable (non-empty audit mask), and once
-        claimed stays dense-committed (it can be neither a later donor nor
-        a later receiver) — no mutual-spill cycles, no double-claimed
-        spare. Bounded: donor bins over
-        _SPILL_BIN_PODS pods or passes over _SPILL_TOTAL_PODS total are
-        skipped — at 10k-pod scale bins hold hundreds of pods each and the
-        remainder is a <1% cost effect, while at MILP-verifiable scale the
-        pass is what closes the gap to <=3%.
+        At small scale (<= _SPILL_DENSE_BINS bins) selection is
+        agglomerative net-saving: every committable bin of <=
+        _SPILL_BIN_PODS pods is a candidate donor (smallest first), and a
+        merge happens with the receiver maximizing
+        cheapest(donor) + cheapest(receiver) - cheapest(combined) when that
+        saving is positive — combined feasibility evaluated over the full
+        type axis, which is exactly how the host loop's FFD ends up with a
+        few large shared nodes on a cold cluster where bucketed packing
+        would open one small bin per cohort. Receivers accumulate (usage
+        and surviving masks update per merge) but, once claimed, stay
+        dense-committed — never donors later, so no cycles. At large scale
+        the scan cost of the type axis is not worth the <1% remainder:
+        only whole-bin cost-neutral spill of plain remainder bins runs
+        (free capacity under the receiver's cheapest type, so the merge
+        can never raise its price).
+
+        Bounded: donor bins over _SPILL_BIN_PODS pods or passes over
+        _SPILL_TOTAL_PODS total pods are skipped.
         """
         num_bins = sol["num_bins"]
         if num_bins < 2:
             return {}
         bin_bucket = sol["bin_bucket"]
         bin_rows = sol["bin_rows"]
-        usage = sol["usage"]
-        mask_all = sol["mask_all"]
+        usage = sol["usage"].copy()  # mutated as receivers accumulate
+        masks = sol["mask_all"].copy()
 
-        price_masked = np.where(mask_all, problem.prices[None, :], np.inf)
-        cheapest_t = np.argmin(price_masked, axis=1)
-        caps_eff = sol["caps_eff"]
-        spare = caps_eff[cheapest_t] + res.tolerance(caps_eff[cheapest_t]) - usage  # [num_bins, R]
+        prices = problem.prices
+        cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+
+        def cheapest(mask_row) -> float:
+            hit = np.where(mask_row, prices, np.inf)
+            return float(hit.min())
+
+        cheapest_price = np.array([cheapest(masks[b]) for b in range(num_bins)])
 
         bucket_of = [buckets[int(b)] for b in bin_bucket]
         plain = np.asarray(
@@ -1271,40 +1487,36 @@ class DenseSolver:
             ]
         )
         dedicated = np.asarray([bk.dedicated for bk in bucket_of])
+        group_of = np.asarray([bk.group_index for bk in bucket_of])
+        zone_index = {z: i for i, z in enumerate(problem.zones)}
+        ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
         # remainder = last bin of each bucket's pack (patterns emit in order,
         # the partial pattern last)
         last_of_bucket: Dict[int, int] = {}
         for bid in range(num_bins):
             last_of_bucket[int(bin_bucket[bid])] = bid
 
-        # Donor candidates: (a) small remainder bins of PLAIN buckets, and
-        # (b) at small scale, EVERY dedicated bin (anti-affinity / hostname-
-        # spread pack one pod per fresh host, so each unshared bin is a
-        # whole node of cost — the dominant dense-vs-FFD gap; the host loop
-        # shares them onto other buckets' nodes, and the exact re-add in
-        # _apply_commit expresses the same sharing). single_bin components
-        # stay whole. The scale gate: per-donor exact re-adds and the
-        # per-candidate receiver scans are O(num_bins) each, and above a few
-        # hundred bins the remainder effect is <1% of cost while the pass
-        # would dominate wall-clock — there, only whole-bin plain spill runs.
         small = num_bins <= self._SPILL_DENSE_BINS
-        candidates = [
-            bid
-            for bid in last_of_bucket.values()
-            if plain[bid] and mask_all[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
-        ]
         if small:
-            candidates.extend(bid for bid in range(num_bins) if dedicated[bid] and mask_all[bid].any())
+            candidates = [
+                bid
+                for bid in range(num_bins)
+                if masks[bid].any()
+                and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
+                and not bucket_of[bid].single_bin
+            ]
+        else:
+            candidates = [
+                bid
+                for bid in last_of_bucket.values()
+                if plain[bid] and masks[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
+            ]
         candidates.sort(key=lambda bid: len(bin_rows[bid]))
 
-        receiver_ok = np.asarray(
-            [mask_all[r].any() and not dedicated[r] for r in range(num_bins)]
-        )
-        group_of = np.asarray([bk.group_index for bk in bucket_of])
+        receiver_ok = np.asarray([masks[r].any() and not dedicated[r] for r in range(num_bins)])
         donors: Dict[int, tuple] = {}  # donor bin -> (receiver bin, full?)
         donor_groups_of: Dict[int, set] = {}  # receiver -> groups nominated onto it
         claimed: set = set()  # receivers stay committed: never donors later
-        spare = spare.copy()  # claimed spare is decremented per receiver
         budget = self._SPILL_TOTAL_PODS
         for bid in candidates:
             rows = bin_rows[bid]
@@ -1313,11 +1525,21 @@ class DenseSolver:
             g = bucket_of[bid].group_index
             reqs_d = problem.requests[rows]
             need = reqs_d.sum(axis=0)
-            # vectorized receiver scan: compat with the receiver's cheapest
-            # type, not a donor itself, different group for dedicated donors
-            # (same-group bins would be vetoed by the zero-count rule anyway)
-            ok = receiver_ok & problem.compat[g, cheapest_t]
+            # receiver prescreen: committable, not a donor, not this bin,
+            # and any pinned domain must be one the donor's group allows
+            # (the exact re-add would veto the rest — skip the wasted adds)
+            ok = receiver_ok.copy()
             ok[bid] = False
+            for r in np.nonzero(ok)[0]:
+                bk = bucket_of[int(r)]
+                if bk.zone is not None and bk.zone != "__infeasible__":
+                    zi = zone_index.get(bk.zone)
+                    if zi is None or not problem.group_zone_allowed[g][zi]:
+                        ok[r] = False
+                if bk.capacity_type is not None:
+                    ci = ct_index.get(bk.capacity_type)
+                    if ci is None or not problem.group_ct_allowed[g][ci]:
+                        ok[r] = False
             if dedicated[bid]:
                 ok &= group_of != g
                 # a receiver already holding a donor of this group would veto
@@ -1325,32 +1547,49 @@ class DenseSolver:
                 for r, groups in donor_groups_of.items():
                     if g in groups:
                         ok[r] = False
-            # prefer a receiver that swallows the WHOLE donor bin (direct
-            # re-add in _apply_commit — no host-loop involvement); otherwise
-            # any receiver that fits at least one donor pod marks a partial
-            # spill: the donor's pods take the exact host loop, which fills
-            # the committed receiver first and opens a fresh node for the
-            # rest (the original spill design)
-            full_choice = np.nonzero(ok & np.all(need[None, :] <= spare, axis=1))[0]
-            if full_choice.size:
-                receiver, full = int(full_choice[0]), True
-            elif small:
-                # partial spill routes the donor through the host loop, an
-                # O(pods x open-nodes) cost only worth paying at small scale
-                partial = ok & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
-                part_choice = np.nonzero(partial)[0]
-                if part_choice.size == 0:
-                    continue
-                receiver, full = int(part_choice[0]), False
+            receiver = None
+            full = True
+            if small:
+                # net-saving merge over the full type axis (upgrades allowed)
+                cand = np.nonzero(ok)[0]
+                if cand.size:
+                    comb_fit = ((usage[cand] + need)[:, None, :] <= cap_tol_eff[None, :, :]).all(axis=2)
+                    comb_mask = masks[cand] & problem.compat[g][None, :] & comb_fit
+                    comb_price = np.where(comb_mask, prices[None, :], np.inf).min(axis=1)
+                    saving = cheapest_price[bid] + cheapest_price[cand] - comb_price
+                    best = int(np.argmax(saving))
+                    if np.isfinite(comb_price[best]) and saving[best] > 1e-9:
+                        receiver = int(cand[best])
+                        usage[receiver] = usage[receiver] + need
+                        masks[receiver] = comb_mask[best]
+                        cheapest_price[receiver] = float(comb_price[best])
+                if receiver is None:
+                    # cost-neutral partial spill: the donor's pods take the
+                    # exact host loop, which fills the committed receiver
+                    # first and opens a fresh node only for the rest
+                    cheapest_t = np.array([int(np.argmin(np.where(masks[b], prices, np.inf))) for b in range(num_bins)])
+                    spare = cap_tol_eff[cheapest_t] - usage
+                    partial = ok & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
+                    part_choice = np.nonzero(partial)[0]
+                    if part_choice.size == 0:
+                        continue
+                    receiver, full = int(part_choice[0]), False
+                    usage[receiver] = cap_tol_eff[cheapest_t[receiver]]  # consumed: unknown subset lands on it
             else:
-                continue
+                # cost-neutral whole-bin spill only (no type upgrades): free
+                # capacity under the receiver's cheapest surviving type
+                cheapest_t = np.array([int(np.argmin(np.where(masks[b], prices, np.inf))) for b in range(num_bins)])
+                spare = cap_tol_eff[cheapest_t] - usage
+                ok &= problem.compat[g, cheapest_t]
+                full_choice = np.nonzero(ok & np.all(need[None, :] <= spare, axis=1))[0]
+                if full_choice.size == 0:
+                    continue
+                receiver = int(full_choice[0])
+                usage[receiver] = usage[receiver] + need
             donors[bid] = (receiver, full)
             claimed.add(receiver)
             donor_groups_of.setdefault(receiver, set()).add(g)
             receiver_ok[bid] = False  # a donor can no longer receive
-            # conservatively: a full receiver's spare shrinks by the donor;
-            # a partial receiver is consumed (unknown subset lands on it)
-            spare[receiver] = spare[receiver] - need if full else np.zeros_like(need)
             budget -= len(rows)
         return donors
 

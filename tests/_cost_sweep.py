@@ -1,0 +1,56 @@
+"""Ad-hoc diagnostic: per-seed dense-vs-host new-node cost across campaign
+seeds. Not collected by pytest (leading underscore); run directly:
+
+    JAX_PLATFORMS=cpu python tests/_cost_sweep.py [n_seeds] [scale]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from tests.test_differential_campaign import (
+    _provisioners,
+    _random_states,
+    _random_workload,
+    _rename,
+    _solve,
+)
+from tests.helpers import make_provisioner
+
+
+def run(n_seeds: int, scale: int = 1):
+    bad = []
+    for seed in range(n_seeds):
+        rng = np.random.default_rng(1000 + seed)
+        provider = FakeCloudProvider(instance_types(int(rng.integers(20, 120))))
+        pods_d = _rename(_random_workload(rng, scale * int(rng.integers(40, 140))), seed)
+        states_d = _random_states(rng)
+        rng2 = np.random.default_rng(1000 + seed)
+        provider2 = FakeCloudProvider(instance_types(int(rng2.integers(20, 120))))
+        pods_h = _rename(_random_workload(rng2, scale * int(rng2.integers(40, 140))), seed)
+        states_h = _random_states(rng2)
+        dres, _ = _solve(pods_d, states_d, provider, dense=True)
+        hres, _ = _solve(pods_h, states_h, provider2, dense=False)
+        dcost = sum(n.instance_type_options[0].price() for n in dres.new_nodes if n.pods)
+        hcost = sum(n.instance_type_options[0].price() for n in hres.new_nodes if n.pods)
+        cheapest = min(it.price() for it in provider.get_instance_types(make_provisioner()))
+        if hcost > 0 and dcost > hcost + cheapest + 1e-6:
+            bad.append((seed, dcost, hcost, cheapest))
+            print(f"seed {seed:3d}: dense {dcost:8.3f} host {hcost:8.3f} ratio {dcost / hcost:5.2f} cheapest {cheapest:.3f}")
+    print(f"\n{len(bad)} / {n_seeds} seeds exceed host + cheapest")
+    if bad:
+        worst = max(bad, key=lambda t: t[1] / t[2])
+        print(f"worst: seed {worst[0]} ratio {worst[1] / worst[2]:.2f}")
+    return bad
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    scale = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    run(n, scale)
