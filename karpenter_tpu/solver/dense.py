@@ -1457,6 +1457,23 @@ class DenseSolver:
         (free capacity under the receiver's cheapest type, so the merge
         can never raise its price).
 
+        Selection must be conservative: a nominated pod the exact re-add
+        vetoes leaks to the host loop, which breaks the dense-carries-the-
+        batch invariant AND re-prices the pod at host-FFD fidelity. Three
+        prescreens make vetoes structurally impossible for the cases the
+        estimator prices: (a) a topology-pinned donor (zone/ct water-fill
+        or affinity pin) only merges with SIBLING bins of its own bucket —
+        same group, same domain — so recorded domain counts equal the
+        water-fill plan and the skew/affinity checks see exactly what they
+        audited; (b) every cross-group nomination requires the donor
+        group's requirement set to be compatible with the receiver bucket's
+        effective node requirements (template ∩ group ∩ pins — the same
+        algebra node.add will enforce); (c) the partial path (donor demoted
+        to the host loop wholesale) stays restricted to remainder/dedicated
+        bins whose group is type-compatible with the receiver's cheapest
+        type — the shape it was designed for, where the demoted tail is a
+        few pods, never a full pattern bin.
+
         Bounded: donor bins over _SPILL_BIN_PODS pods or passes over
         _SPILL_TOTAL_PODS total pods are skipped.
         """
@@ -1512,8 +1529,51 @@ class DenseSolver:
                 if plain[bid] and masks[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
             ]
         candidates.sort(key=lambda bid: len(bin_rows[bid]))
+        remainder_bins = set(last_of_bucket.values())
 
-        receiver_ok = np.asarray([masks[r].any() and not dedicated[r] for r in range(num_bins)])
+        # requirement-algebra prescreen (b): donor group reqs vs the receiver
+        # bin's effective node requirements — the SAME algebra bucket_proto
+        # runs at commit (one shared helper, _bucket_proto_reqs), plus the
+        # requirements of donor groups already nominated onto that receiver
+        # (node.add tightens the node per accepted pod, so a later donor
+        # must be compatible with the accumulated set, not just the base)
+        eff_reqs_cache: Dict[int, Optional[Requirements]] = {}
+
+        def bucket_eff_reqs(bkey: int) -> Optional[Requirements]:
+            if bkey not in eff_reqs_cache:
+                eff_reqs_cache[bkey] = self._bucket_proto_reqs(problem, buckets[bkey])
+            return eff_reqs_cache[bkey]
+
+        recv_acc: Dict[int, Requirements] = {}  # receiver bin -> accumulated reqs
+
+        def reqs_compatible(g: int, rbid: int) -> bool:
+            donor_reqs = problem.groups[g].requirements
+            if donor_reqs is None:
+                return True
+            eff = recv_acc.get(rbid)
+            if eff is None:
+                eff = bucket_eff_reqs(int(bin_bucket[rbid]))
+            return eff is not None and eff.compatible(donor_reqs) is None
+
+        def accumulate(g: int, rbid: int) -> None:
+            donor_reqs = problem.groups[g].requirements
+            if donor_reqs is None:
+                return
+            eff = recv_acc.get(rbid)
+            if eff is None:
+                eff = bucket_eff_reqs(int(bin_bucket[rbid])).copy()
+            eff.add(*donor_reqs.values())
+            recv_acc[rbid] = eff
+
+        # a receiver whose bucket the commit will route to the host loop
+        # (proto None) can land no donors — the record_of_bid guard would
+        # demote them wholesale
+        receiver_ok = np.asarray(
+            [
+                masks[r].any() and not dedicated[r] and bucket_eff_reqs(int(bin_bucket[r])) is not None
+                for r in range(num_bins)
+            ]
+        )
         donors: Dict[int, tuple] = {}  # donor bin -> (receiver bin, full?)
         donor_groups_of: Dict[int, set] = {}  # receiver -> groups nominated onto it
         claimed: set = set()  # receivers stay committed: never donors later
@@ -1522,24 +1582,42 @@ class DenseSolver:
             rows = bin_rows[bid]
             if len(rows) > budget or bid in claimed:
                 continue
-            g = bucket_of[bid].group_index
+            dbucket = bucket_of[bid]
+            g = dbucket.group_index
             reqs_d = problem.requests[rows]
             need = reqs_d.sum(axis=0)
-            # receiver prescreen: committable, not a donor, not this bin,
-            # and any pinned domain must be one the donor's group allows
-            # (the exact re-add would veto the rest — skip the wasted adds)
             ok = receiver_ok.copy()
             ok[bid] = False
+            pinned = dbucket.zone is not None or dbucket.capacity_type is not None
+            if pinned:
+                # prescreen (a): a water-fill/affinity-pinned donor only
+                # merges with sibling bins — same group, same domain — so
+                # the committed domain counts equal the audited plan
+                ok &= (
+                    (group_of == g)
+                    & np.asarray([bk.zone == dbucket.zone for bk in bucket_of])
+                    & np.asarray([bk.capacity_type == dbucket.capacity_type for bk in bucket_of])
+                )
+            else:
+                # unpinned donor onto a pinned receiver: the pin must be a
+                # domain the donor's group allows (the exact re-add would
+                # veto the rest — skip the wasted adds)
+                for r in np.nonzero(ok)[0]:
+                    bk = bucket_of[int(r)]
+                    if bk.zone is not None and bk.zone != "__infeasible__":
+                        zi = zone_index.get(bk.zone)
+                        if zi is None or not problem.group_zone_allowed[g][zi]:
+                            ok[r] = False
+                    if bk.capacity_type is not None:
+                        ci = ct_index.get(bk.capacity_type)
+                        if ci is None or not problem.group_ct_allowed[g][ci]:
+                            ok[r] = False
+            # prescreen (b): every receiver must pass the requirement algebra
+            # the add protocol will enforce (same-group receivers too — an
+            # earlier cross-group donor may have tightened the node)
             for r in np.nonzero(ok)[0]:
-                bk = bucket_of[int(r)]
-                if bk.zone is not None and bk.zone != "__infeasible__":
-                    zi = zone_index.get(bk.zone)
-                    if zi is None or not problem.group_zone_allowed[g][zi]:
-                        ok[r] = False
-                if bk.capacity_type is not None:
-                    ci = ct_index.get(bk.capacity_type)
-                    if ci is None or not problem.group_ct_allowed[g][ci]:
-                        ok[r] = False
+                if (group_of[int(r)] != g or int(r) in recv_acc) and not reqs_compatible(g, int(r)):
+                    ok[r] = False
             if dedicated[bid]:
                 ok &= group_of != g
                 # a receiver already holding a donor of this group would veto
@@ -1563,18 +1641,25 @@ class DenseSolver:
                         usage[receiver] = usage[receiver] + need
                         masks[receiver] = comb_mask[best]
                         cheapest_price[receiver] = float(comb_price[best])
-                if receiver is None:
-                    # cost-neutral partial spill: the donor's pods take the
-                    # exact host loop, which fills the committed receiver
-                    # first and opens a fresh node only for the rest
+                if receiver is None and not pinned and (bid in remainder_bins or dedicated[bid]):
+                    # prescreen (c) — cost-neutral partial spill, remainder/
+                    # dedicated bins only: the donor's pods take the exact
+                    # host loop, which fills the committed receiver first and
+                    # opens a fresh node only for the rest
                     cheapest_t = np.array([int(np.argmin(np.where(masks[b], prices, np.inf))) for b in range(num_bins)])
                     spare = cap_tol_eff[cheapest_t] - usage
-                    partial = ok & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
+                    partial = (
+                        ok
+                        & problem.compat[g, cheapest_t]
+                        & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
+                    )
                     part_choice = np.nonzero(partial)[0]
                     if part_choice.size == 0:
                         continue
                     receiver, full = int(part_choice[0]), False
                     usage[receiver] = cap_tol_eff[cheapest_t[receiver]]  # consumed: unknown subset lands on it
+                if receiver is None:
+                    continue
             else:
                 # cost-neutral whole-bin spill only (no type upgrades): free
                 # capacity under the receiver's cheapest surviving type
@@ -1589,6 +1674,7 @@ class DenseSolver:
             donors[bid] = (receiver, full)
             claimed.add(receiver)
             donor_groups_of.setdefault(receiver, set()).add(g)
+            accumulate(g, receiver)
             receiver_ok[bid] = False  # a donor can no longer receive
             budget -= len(rows)
         return donors
@@ -1600,6 +1686,29 @@ class DenseSolver:
     # and a cheap mutation half (_apply_commit — registers hostnames, appends
     # nodes, records topology counts) that runs once the device result is
     # confirmed.
+
+    def _bucket_proto_reqs(self, problem: DenseProblem, bucket: _Bucket) -> Optional[Requirements]:
+        """Effective node requirements for a bucket's bins: template ∩ group
+        ∩ zone/ct pins — the requirement set every node opened for this
+        bucket starts from, shared by commit preparation (bucket_proto) and
+        the spill-donor prescreen. None means the bucket's pods are routed
+        to the exact host loop at commit: any hostname-keyed pod requirement
+        (IN a specific host, but also DoesNotExist/Gt/Lt, which compatible()
+        can't veto) is incompatible with the per-bin placeholder-hostname
+        protocol, as is a group requirement the template cannot satisfy."""
+        group = problem.groups[bucket.group_index]
+        reqs = Requirements(*problem.template_of_group(group).requirements.values())
+        if group.requirements is not None:
+            if group.requirements.has(lbl.LABEL_HOSTNAME):
+                return None
+            if reqs.compatible(group.requirements) is not None:
+                return None
+            reqs.add(*group.requirements.values())
+        if bucket.zone is not None and bucket.zone != "__infeasible__":
+            reqs.add(Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, bucket.zone))
+        if bucket.capacity_type is not None:
+            reqs.add(Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, bucket.capacity_type))
+        return reqs
 
     def _prepare_commit(
         self, scheduler, problem: DenseProblem, buckets: List[_Bucket], sol, taken: Optional[np.ndarray] = None
@@ -1658,30 +1767,9 @@ class DenseSolver:
         proto_cache: Dict[int, Optional[Requirements]] = {}
 
         def bucket_proto(bkey: int) -> Optional[Requirements]:
-            if bkey in proto_cache:
-                return proto_cache[bkey]
-            bucket = buckets[bkey]
-            group = problem.groups[bucket.group_index]
-            reqs = Requirements(*problem.template_of_group(group).requirements.values())
-            proto: Optional[Requirements] = reqs
-            if group.requirements is not None:
-                # any hostname-keyed pod requirement (IN a specific host, but
-                # also DoesNotExist/Gt/Lt, which compatible() can't veto) is
-                # incompatible with the per-bin placeholder-hostname protocol
-                # — the exact host loop owns those pods
-                if group.requirements.has(lbl.LABEL_HOSTNAME):
-                    proto = None
-                elif reqs.compatible(group.requirements) is not None:
-                    proto = None
-                else:
-                    reqs.add(*group.requirements.values())
-            if proto is not None:
-                if bucket.zone is not None and bucket.zone != "__infeasible__":
-                    reqs.add(Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, bucket.zone))
-                if bucket.capacity_type is not None:
-                    reqs.add(Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, bucket.capacity_type))
-            proto_cache[bkey] = proto
-            return proto
+            if bkey not in proto_cache:
+                proto_cache[bkey] = self._bucket_proto_reqs(problem, buckets[bkey])
+            return proto_cache[bkey]
 
         committed = 0
         record_of_bid: Dict[int, int] = {}  # receiver bin -> index into records
