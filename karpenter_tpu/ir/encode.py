@@ -444,6 +444,7 @@ def encode_problem(
     capacity_types: Optional[Sequence[str]] = None,
     catalog: Optional[CatalogEncoding] = None,
     catalog_key_hint: Optional[tuple] = None,
+    cohort_label_keys: Optional[frozenset] = None,
 ) -> DenseProblem:
     """Encode a batch against the weight-ordered node templates.
 
@@ -454,6 +455,16 @@ def encode_problem(
     of every template's instance-type universe; a group's compat row is zero
     outside its chosen template's segment, so the device argmin can never
     pick a cross-template type.
+
+    `cohort_label_keys` (when given) is the set of label KEYS that any
+    selector in play — batch pods' spread/affinity/anti selectors plus the
+    scheduler topology's existing cohort selectors — could match. Pod labels
+    outside this set cannot influence placement (no selector counts them),
+    so they are dropped from the GROUPING key: identically-constrained
+    cohorts that differ only in unmatched labels collapse into one group and
+    pack as one FFD stream, the same cross-cohort node sharing the host
+    loop's single global queue produces. The per-pod signature cache is
+    unaffected (filtering happens on the cached value).
     """
     templates = list(templates)
     if catalog is None:
@@ -520,6 +531,10 @@ def encode_problem(
         if req_vec is None:
             host_pods.append(pod)
             continue
+        if cohort_label_keys is not None and sig[1]:
+            filtered = tuple(kv for kv in sig[1] if kv[0] in cohort_label_keys)
+            if filtered != sig[1]:
+                sig = (sig[0], filtered) + sig[2:]
         group = group_by_sig.get(sig)
         if group is None:
             kind, key, max_skew, sel_sig = classify_group(pod)

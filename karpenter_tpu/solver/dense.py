@@ -111,6 +111,11 @@ class _Bucket:
     capacity_type: Optional[str] = None  # pinned capacity type
     dedicated: bool = False
     single_bin: bool = False
+    # zone/ct spread group whose water-fill is deferred until after the warm
+    # fill (exact-fill scale only): pods first take warm capacity per-pod in
+    # global FFD order under the host loop's transient-count skew rule, then
+    # the remainder is water-filled over domains with accurate counts
+    deferred_spread: bool = False
     pod_rows: List[int] = field(default_factory=list)  # rows into problem arrays
 
 
@@ -264,19 +269,57 @@ class DenseSolver:
             capacity_types=capacity_types,
             catalog=catalog,
             catalog_key_hint=ckey,
+            cohort_label_keys=self._cohort_label_keys(scheduler, pods),
         )
         leftover = list(problem.host_pods)
         if problem.P == 0:
             self.stats.pods_to_host += len(leftover)
             return leftover
 
-        buckets = self._build_buckets(problem, scheduler.topology, scheduler)
+        defer_spread = bool(scheduler.existing_nodes) and problem.P <= self._FILL_EXACT_MAX_PODS
+        buckets = self._build_buckets(problem, scheduler.topology, scheduler, defer_spread=defer_spread)
         t_encoded = time.perf_counter()
         existing_committed = 0
         taken = None
         if scheduler.existing_nodes:
-            existing_committed, taken = self._fill_existing(scheduler, problem, buckets)
+            existing_committed, taken, placed_extras = self._fill_existing(
+                scheduler, problem, buckets, extra_pods=leftover
+            )
+            if placed_extras:
+                leftover = [p for p in leftover if id(p) not in placed_extras]
             buckets = [b for b in buckets if b.pod_rows]
+        if any(b.deferred_spread for b in buckets):
+            # the warm fill consumed what it could; assign domains to the
+            # remainder with counts that now include every warm placement.
+            # The freeness memo predates the fill's commits — drop it so the
+            # domain scoring sees post-fill capacity.
+            self._view_free_memo.clear()
+            expanded: List[_Bucket] = []
+            for b in buckets:
+                if not b.deferred_spread:
+                    expanded.append(b)
+                    continue
+                group = problem.groups[b.group_index]
+                if group.kind == GroupKind.AFFINITY:
+                    # colocation: warm placements (if any) bootstrapped the
+                    # domain, so the pick now collapses to that zone
+                    zone = self._pick_affinity_zone(problem, scheduler.topology, group, b.pod_rows, scheduler)
+                    expanded.append(
+                        _Bucket(group_index=b.group_index, pod_rows=b.pod_rows, zone=zone if zone is not None else "__infeasible__")
+                    )
+                elif group.topology_key == lbl.LABEL_TOPOLOGY_ZONE:
+                    expanded.extend(
+                        self._water_fill(
+                            problem, scheduler.topology, group, b.pod_rows, problem.zones, problem.group_zone_allowed[b.group_index], "zone", scheduler
+                        )
+                    )
+                else:
+                    expanded.extend(
+                        self._water_fill(
+                            problem, scheduler.topology, group, b.pod_rows, problem.capacity_types, problem.group_ct_allowed[b.group_index], "ct", scheduler
+                        )
+                    )
+            buckets = [b for b in expanded if b.pod_rows]
         t1 = time.perf_counter()
         if buckets:
             prep = self._device_solve(scheduler, problem, buckets, taken)
@@ -299,9 +342,48 @@ class DenseSolver:
         self.stats.pods_to_host += len(leftover)
         return leftover
 
+    @staticmethod
+    def _cohort_label_keys(scheduler, pods: Sequence[Pod]) -> frozenset:
+        """Label KEYS any selector in play could match: batch pods' spread /
+        affinity / anti-affinity selectors (required and preferred) plus the
+        scheduler topology's existing cohort selectors (owned and inverse).
+        Labels outside this set cannot affect placement, so encode_problem
+        drops them from the grouping key (see its docstring). Key-level
+        granularity is a safe over-approximation of per-namespace selector
+        matching."""
+        keys: set = set()
+
+        def add_selector(sel) -> None:
+            if sel is None:
+                return
+            keys.update(sel.match_labels.keys())
+            keys.update(e.key for e in sel.match_expressions)
+
+        for pod in pods:
+            spec = pod.spec
+            for c in spec.topology_spread_constraints:
+                add_selector(c.label_selector)
+            a = spec.affinity
+            if a is not None:
+                if a.pod_affinity is not None:
+                    for t in a.pod_affinity.required:
+                        add_selector(t.label_selector)
+                    for wt in a.pod_affinity.preferred:
+                        add_selector(wt.pod_affinity_term.label_selector)
+                if a.pod_anti_affinity is not None:
+                    for t in a.pod_anti_affinity.required:
+                        add_selector(t.label_selector)
+                    for wt in a.pod_anti_affinity.preferred:
+                        add_selector(wt.pod_affinity_term.label_selector)
+        for group in scheduler.topology.topologies.values():
+            add_selector(group.selector)
+        for group in scheduler.topology.inverse_topologies.values():
+            add_selector(group.selector)
+        return frozenset(keys)
+
     # -- step 2: domain assignment / bucket construction ---------------------
 
-    def _build_buckets(self, problem: DenseProblem, topology, scheduler=None) -> List[_Bucket]:
+    def _build_buckets(self, problem: DenseProblem, topology, scheduler=None, defer_spread: bool = False) -> List[_Bucket]:
         buckets: List[_Bucket] = []
         rows_by_group: Dict[int, List[int]] = {}
         for row, gid in enumerate(problem.group_ids):
@@ -319,6 +401,13 @@ class DenseSolver:
                 if group.topology_key == lbl.LABEL_HOSTNAME:
                     # every hostname is a fresh domain: one pod per node
                     buckets.append(_Bucket(group_index=g, dedicated=True, pod_rows=rows))
+                elif defer_spread:
+                    # warm clusters at exact-fill scale: water-fill AFTER the
+                    # warm fill (see _Bucket.deferred_spread) — planning the
+                    # per-domain split before knowing which pods land warm
+                    # makes the fill's skew checks judge counts the host
+                    # loop's transient order never sees
+                    buckets.append(_Bucket(group_index=g, deferred_spread=True, pod_rows=rows))
                 elif group.topology_key == lbl.LABEL_TOPOLOGY_ZONE:
                     buckets.extend(
                         self._water_fill(problem, topology, group, rows, problem.zones, problem.group_zone_allowed[g], "zone", scheduler)
@@ -345,6 +434,14 @@ class DenseSolver:
                         buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
                     else:
                         buckets.append(_Bucket(group_index=g, single_bin=True, pod_rows=rows))
+                elif defer_spread:
+                    # zonal self-affinity at exact-fill scale: the host loop
+                    # bootstraps the cohort's zone from the first pod's first
+                    # accepting view — pre-pinning from an estimate diverges
+                    # from that choice and cascades. Defer: warm fill per-pod
+                    # (the exact add enforces bootstrap-then-colocate), pin
+                    # the remainder afterwards.
+                    buckets.append(_Bucket(group_index=g, deferred_spread=True, pod_rows=rows))
                 else:
                     zone = self._pick_affinity_zone(problem, topology, group, rows, scheduler)
                     if zone is None:
@@ -675,7 +772,7 @@ class DenseSolver:
             return False
         return view.requirements.compatible(group.requirements) is None
 
-    def _fill_existing(self, scheduler, problem: DenseProblem, buckets: List[_Bucket]):
+    def _fill_existing(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], extra_pods: Sequence[Pod] = ()):
         """Fill existing-node capacity before opening new bins.
 
         Mirrors the host loop's existing-nodes-first rule
@@ -691,11 +788,26 @@ class DenseSolver:
           hostname affinity) skip existing fill: their per-host zero-count
           checks need the exact host protocol.
 
+        `extra_pods` are the IR-inexpressible pods (problem.host_pods) bound
+        for the exact host loop. They join this fill at their global FFD
+        position, attempted against each view through the same exact
+        view.add the host loop's existing-first pass would run with the
+        pod's full unrelaxed constraint set — so their claim on warm
+        capacity is decided by the one global FFD order, not by which phase
+        processes them. Without this, every dense commit lands before ANY
+        host-routed pod, and a warm slot the host loop's interleaved order
+        would have given to a host pod goes to a dense pod instead — the
+        host pod then opens a fresh (often upgraded) node the host oracle
+        never pays for (campaign seed 12 is the canonical shape). A veto
+        leaves the pod for the host loop, which still owns relaxation.
+
         Every placement commits through ExistingNodeView.add, so capacity
         modeling here only *proposes*; a rejected add leaves the pod in its
-        bucket for the new-bin solve. Returns (count committed, taken [P]).
+        bucket for the new-bin solve. Returns (count committed, taken [P],
+        ids of extra_pods placed).
         """
         from ..scheduler.errors import IncompatibleError
+        from ..scheduler.queue import ffd_sort_key
         from .pack_counts import dedupe_sizes
 
         views = scheduler.existing_nodes
@@ -768,19 +880,86 @@ class DenseSolver:
                 head[vi] -= problem.requests[rows[:n]].sum(axis=0)
             return n
 
+        placed_extras: set = set()
+
+        def try_extra(pod: Pod) -> bool:
+            """One host-routed pod's existing-first attempt at its FFD
+            position: first view (in the host loop's order) the exact add
+            protocol accepts, full unrelaxed constraints."""
+            nonlocal committed
+            vec = resource_vector(res.pod_requests(pod))
+            if vec is None:
+                return False  # resources outside the axis: host loop owns it
+            fit_views = np.flatnonzero(usable & (vec <= head).all(axis=1))
+            if fit_views.size == 0:
+                return False
+            ctx = scheduler.topology.cohort_context(pod, inverse_index=shared_inverse)
+            for vi in fit_views:
+                vi = int(vi)
+                try:
+                    views[vi].add(pod, ctx=ctx)
+                except IncompatibleError:
+                    continue
+                committed += 1
+                head[vi] -= vec
+                placed_extras.add(id(pod))
+                return True
+            return False
+
         spread_units: Dict[int, List[_Bucket]] = {}
         plain_buckets: List[_Bucket] = []
+        special_buckets: List[_Bucket] = []  # dedicated / single_bin
+        deferred_buckets: List[_Bucket] = []  # spread, water-fill deferred
+        host_route_buckets: List[_Bucket] = []  # __infeasible__: host loop owns them
         for bucket in buckets:
-            if not bucket.pod_rows or bucket.zone == "__infeasible__":
+            if not bucket.pod_rows:
+                continue
+            if bucket.zone == "__infeasible__":
+                # these pods are bound for the exact host loop (inexpressible
+                # domain shape), but the host loop runs AFTER every dense
+                # commit — without a warm attempt at their global FFD
+                # position they lose warm slots the host oracle gives them,
+                # shifting the entire downstream packing. The exact add
+                # re-checks everything, so per-pod attempts here are safe
+                # for any constraint shape.
+                host_route_buckets.append(bucket)
                 continue
             if bucket.dedicated or bucket.single_bin:
                 # Per-host zero-count constraints (anti-affinity, hostname
-                # spread, hostname affinity). Fill existing capacity through
-                # the exact view.add protocol — it enforces the per-host
-                # count rules — then leave the remainder IN the bucket for
-                # the dense new-bin pack (fresh hostnames are zero-count by
-                # construction), instead of routing hundreds of pods through
-                # the O(pods x views) host loop.
+                # spread, hostname affinity): at exact-fill scale they join
+                # the unified FFD pass below (the view.add protocol enforces
+                # the per-host count rules); above it, a bulk phase places
+                # them before the class-vectorized fill.
+                special_buckets.append(bucket)
+                continue
+            if bucket.deferred_spread:
+                # per-pod warm attempts under the host loop's transient-count
+                # skew rule; only exists at exact-fill scale (presolve gates
+                # deferral on P <= _FILL_EXACT_MAX_PODS)
+                deferred_buckets.append(bucket)
+                continue
+            group = problem.groups[bucket.group_index]
+            if group.kind == GroupKind.SPREAD:
+                spread_units.setdefault(bucket.group_index, []).append(bucket)
+            else:
+                plain_buckets.append(bucket)
+
+        fill_buckets = plain_buckets + deferred_buckets + [b for unit in spread_units.values() for b in unit]
+        total_fill = (
+            sum(len(b.pod_rows) for b in fill_buckets)
+            + sum(len(b.pod_rows) for b in special_buckets)
+            + sum(len(b.pod_rows) for b in host_route_buckets)
+        )
+        exact_fill = (total_fill > 0 or extra_pods) and total_fill <= self._FILL_EXACT_MAX_PODS
+
+        if not exact_fill:
+            # bulk special-bucket phase (above the exact-fill scale gate):
+            # fill existing capacity through the exact view.add protocol,
+            # then leave the remainder IN the bucket for the dense new-bin
+            # pack (fresh hostnames are zero-count by construction), instead
+            # of routing hundreds of pods through the O(pods x views) host
+            # loop.
+            for bucket in special_buckets:
                 group = problem.groups[bucket.group_index]
                 ctx = ctx_of(bucket.group_index)
                 rows = bucket.pod_rows
@@ -828,18 +1007,6 @@ class DenseSolver:
                             if used.all():
                                 break
                 bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
-                continue
-            group = problem.groups[bucket.group_index]
-            if group.kind == GroupKind.SPREAD:
-                spread_units.setdefault(bucket.group_index, []).append(bucket)
-            else:
-                # plain / zone-pinned: least constrained — they fill AFTER the
-                # spread units below (most-constrained-first), because a plain
-                # pod displaced from warm capacity packs into a cheap fresh
-                # bin while a displaced spread fragment opens a near-empty
-                # domain-pinned one (the host loop's per-pod existing-first
-                # order never starves constrained pods this way)
-                plain_buckets.append(bucket)
 
         # unified warm fill: ONE view-major pass over spread AND plain
         # buckets with size classes globally sorted by the host queue's FFD
@@ -878,9 +1045,7 @@ class DenseSolver:
                     entry = reservation_ledger.setdefault((id(tg), domain), [tg, domain, 0])
                     entry[2] += n_rows
 
-        fill_buckets = plain_buckets + [b for unit in spread_units.values() for b in unit]
-        total_fill = sum(len(b.pod_rows) for b in fill_buckets)
-        if 0 < total_fill <= self._FILL_EXACT_MAX_PODS:
+        if exact_fill:
             # exact host-order fill: per pod in the host queue's FFD order,
             # first view (in index order) the exact protocol accepts — byte
             # for byte the reference's existing-nodes-first pass
@@ -893,18 +1058,81 @@ class DenseSolver:
             # gate the class-vectorized pass below takes over — there the
             # per-pod protocol would dominate wall clock while fragments are
             # a vanishing cost fraction.
-            from ..scheduler.queue import ffd_sort_key
-
             zone_index = {z: i for i, z in enumerate(problem.zones)}
             ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
-            fill_pods = [(row, bucket) for bucket in fill_buckets for row in bucket.pod_rows]
-            fill_pods.sort(key=lambda rb: ffd_sort_key(problem.pods[rb[0]]))
+            singlebin_tried: set = set()
+            fill_pods = [
+                (row, bucket) for bucket in fill_buckets + special_buckets + host_route_buckets for row in bucket.pod_rows
+            ]
+            fill_pods.extend((pod, None) for pod in extra_pods)
+            fill_pods.sort(key=lambda rb: ffd_sort_key(problem.pods[rb[0]] if rb[1] is not None else rb[0]))
             for row, bucket in fill_pods:
+                if bucket is None:  # host-routed pod at its FFD position
+                    try_extra(row)
+                    continue
                 group = problem.groups[bucket.group_index]
                 req = problem.requests[row]
                 meta = spread_meta.get(id(bucket))
                 fit_views = np.flatnonzero(usable & (req <= head).all(axis=1))
                 if fit_views.size == 0:
+                    continue
+                if bucket.zone == "__infeasible__":
+                    # host-routed rows: raw exact adds, view order — no
+                    # group-level prescreen (hostname-keyed requirements make
+                    # _view_accepts meaningless here; the add is authority)
+                    for vi in fit_views:
+                        if commit(int(vi), row, ctx_of(bucket.group_index)):
+                            break
+                    continue
+                if bucket.single_bin:
+                    # bootstrap hostname-affinity component: all-or-nothing
+                    # swallow at the component's first FFD position (greedy
+                    # per-pod adds cannot backtrack a half-placed component;
+                    # the whole-component contract schedules the cohort on a
+                    # fresh host where per-pod order would strand its tail)
+                    if id(bucket) in singlebin_tried:
+                        continue
+                    singlebin_tried.add(id(bucket))
+                    rows = bucket.pod_rows
+                    order_sb = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
+                    queue_sb = [rows[i] for i in order_sb]
+                    total_sb = problem.requests[rows].sum(axis=0)
+                    ctx = ctx_of(bucket.group_index)
+                    for vi in fit_views:
+                        vi = int(vi)
+                        if not view_ok(bucket, group, vi) or not np.all(total_sb <= head[vi]):
+                            continue
+                        if commit(vi, queue_sb[0], ctx):
+                            for r in queue_sb[1:]:
+                                if not commit(vi, r, ctx):
+                                    # rare (ports/volume veto mid-component):
+                                    # the host loop owns the remainder — it
+                                    # sees the recorded affinity domain and
+                                    # applies the exact bootstrap rules
+                                    bucket.zone = "__infeasible__"
+                                    break
+                            break  # component is bound to this host now
+                    continue
+                if bucket.deferred_spread:
+                    # any group-allowed domain; the exact add judges the
+                    # transient counts exactly as the host loop would at this
+                    # queue position
+                    gi = bucket.group_index
+                    zone_spread = group.topology_key == lbl.LABEL_TOPOLOGY_ZONE
+                    for vi in fit_views:
+                        vi = int(vi)
+                        if zone_spread:
+                            dv = zone_index.get(zone_of[vi])
+                            if dv is None or not problem.group_zone_allowed[gi][dv]:
+                                continue
+                        else:
+                            dv = ct_index.get(ct_of[vi])
+                            if dv is None or not problem.group_ct_allowed[gi][dv]:
+                                continue
+                        if not self._view_accepts(group, views[vi]):
+                            continue
+                        if commit(vi, row, ctx_of(gi)):
+                            break
                     continue
                 if meta is not None:
                     domain, count_groups = meta
@@ -938,12 +1166,12 @@ class DenseSolver:
                     else:
                         for tg in count_groups:
                             tg.record(domain)
-            for bucket in fill_buckets:
+            for bucket in fill_buckets + special_buckets + host_route_buckets:
                 bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
             for tg, domain, count in reservation_ledger.values():
                 if count:
                     tg.unrecord(domain, count=count)
-            return committed, taken
+            return committed, taken, placed_extras
 
         entries = []  # one per (bucket, size class)
         for bucket in fill_buckets:
@@ -1032,13 +1260,20 @@ class DenseSolver:
             for bucket in fill_buckets:
                 bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
 
+        # above the exact-fill scale gate the class-vectorized pass owns the
+        # bucket pods; host-routed extras still get their warm attempts
+        # (bounded: O(extras x views), and extras are the IR-inexpressible
+        # tail of the batch, not the batch)
+        for pod in sorted(extra_pods, key=ffd_sort_key):
+            try_extra(pod)
+
         # retract the reservations of the pods that stayed planned-fresh;
         # _apply_commit records their real bins
         for tg, domain, count in reservation_ledger.values():
             if count:
                 tg.unrecord(domain, count=count)
 
-        return committed, taken
+        return committed, taken, placed_extras
 
     def _pallas_enabled(self) -> bool:
         import os
@@ -1216,11 +1451,22 @@ class DenseSolver:
 
         # speculate under the in-flight round trip
         prev_tstar, prev_feasible, prev_key = _preview_type_cost(bucket_stats, caps_eff.astype(np.float32), problem.prices.astype(np.float32), allowed)
+        # small batches refine the per-bucket pack over several candidate
+        # types (_best_pack) — the one-type-per-bucket argmin wastes the
+        # last bin on mixed-size streams where the host loop's FFD ladder
+        # downsizes adaptively; at scale the last-bin effect vanishes and
+        # the single argmin pack keeps wall-clock flat
+        refine = problem.P <= self._FILL_EXACT_MAX_PODS
         local: List[tuple] = []
         for b, bucket in enumerate(buckets):
             rows = np.asarray(bucket.pod_rows, dtype=np.int64)
             reqs = problem.requests[rows]
-            pack = self._pack_bucket(bucket, reqs, caps_eff[prev_tstar[b]]) if prev_feasible[b] else None
+            if not prev_feasible[b]:
+                pack = None
+            elif refine:
+                pack = self._best_pack(problem, bucket, reqs, caps_eff, int(prev_tstar[b]))
+            else:
+                pack = self._pack_bucket(bucket, reqs, caps_eff[prev_tstar[b]])
             local.append((rows, reqs, pack))
 
         # speculative assembly + audit + full commit preparation (node
@@ -1249,9 +1495,18 @@ class DenseSolver:
         for b, bucket in enumerate(buckets):
             if bool(feasible[b]) != bool(prev_feasible[b]):
                 rows, reqs, _ = local[b]
-                pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]]) if feasible[b] else None
+                if not feasible[b]:
+                    pack = None
+                elif refine:
+                    pack = self._best_pack(problem, bucket, reqs, caps_eff, int(tstar[b]))
+                else:
+                    pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]])
                 local[b] = (rows, reqs, pack)
                 changed = True
+            elif refine:
+                # the refined pack already optimized over the type axis; a
+                # device argmin tie carries no new information for it
+                continue
             elif feasible[b] and tstar[b] != prev_tstar[b]:
                 # TPU f32 division rounds differently by ~1 ulp, and
                 # price-proportional catalogs make the cost key near-constant
@@ -1386,6 +1641,73 @@ class DenseSolver:
         sol.update(usage=usage, bin_rows=bin_rows, mask_all=mask_all)
         return sol
 
+    def _best_pack(
+        self, problem: DenseProblem, bucket: _Bucket, reqs: np.ndarray, caps_eff: np.ndarray, tstar: int
+    ) -> Optional[Tuple[np.ndarray, int]]:
+        """Small-batch pack refinement: run the bucket's pack under up to 8
+        cheapest capacity-distinct candidate types (plus the argmin choice)
+        and keep the pack whose bins PRICE cheapest — each bin priced at its
+        cheapest feasible type, which is exactly how the commit prices nodes
+        (options = every audited type, node cost = min price). The per-type
+        argmin alone prefers few large bins, which strands the last bin's
+        slack on mixed-size streams; pricing whole candidate packs captures
+        the split-the-remainder-onto-a-smaller-type move the host loop's
+        adaptive FFD makes for free. Ties prefer fewer bins (fewer nodes,
+        less daemon overhead), then the argmin type's own pack."""
+        g = bucket.group_index
+        compat_row = problem.compat[g]
+        cand = np.nonzero(compat_row)[0]
+        if cand.size == 0:
+            return None
+        max_req = reqs.max(axis=0)
+        fits_pod = (max_req[None, :] <= caps_eff[cand] + 1e-9).all(axis=1)
+        cand = cand[fits_pod]
+        if cand.size == 0:
+            return None
+        cand = cand[np.argsort(problem.prices[cand], kind="stable")]
+        picks: List[int] = []
+        seen_caps: set = set()
+        for t in cand:
+            key = caps_eff[int(t)].tobytes()
+            if key in seen_caps:
+                continue
+            seen_caps.add(key)
+            picks.append(int(t))
+            if len(picks) >= 8:
+                break
+        if int(tstar) not in picks and compat_row[int(tstar)]:
+            picks.append(int(tstar))
+        cap_tol = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+        prices = problem.prices
+        best_key = None
+        best_pack = None
+        for t in picks:
+            pack = self._pack_bucket(bucket, reqs, caps_eff[t])
+            ids, nbins = pack
+            unplaced = int((ids < 0).sum())
+            cost = 0.0
+            feasible = True
+            for bid in range(nbins):
+                sel = ids == bid
+                if not sel.any():
+                    continue
+                u = reqs[sel].sum(axis=0)
+                m = reqs[sel].max(axis=0)
+                fit = compat_row & (u[None, :] <= cap_tol + 1e-9).all(axis=1) & (m[None, :] <= cap_tol + 1e-9).all(axis=1)
+                if not fit.any():
+                    feasible = False
+                    break
+                cost += float(prices[fit].min())
+            if not feasible:
+                continue
+            key = (unplaced, round(cost, 9), nbins)
+            if best_key is None or key < best_key:
+                best_key = key
+                best_pack = pack
+        if best_pack is None:
+            return self._pack_bucket(bucket, reqs, caps_eff[int(tstar)])
+        return best_pack
+
     def _pack_bucket(self, bucket: _Bucket, reqs: np.ndarray, cap: np.ndarray) -> Tuple[np.ndarray, int]:
         """Pack one bucket's pods into bins of capacity `cap`.
 
@@ -1442,48 +1764,53 @@ class DenseSolver:
         pods the protocol vetoes fall back to the host loop.
 
         At small scale (<= _SPILL_DENSE_BINS bins) selection is
-        agglomerative net-saving: every committable bin of <=
-        _SPILL_BIN_PODS pods is a candidate donor (smallest first), and a
-        merge happens with the receiver maximizing
+        agglomerative net-saving CLUSTERING, run to fixpoint: every bin
+        starts as its own cluster; each pass, clusters of <= _SPILL_BIN_PODS
+        pods (smallest first) merge into the live cluster maximizing
         cheapest(donor) + cheapest(receiver) - cheapest(combined) when that
         saving is positive — combined feasibility evaluated over the full
-        type axis, which is exactly how the host loop's FFD ends up with a
-        few large shared nodes on a cold cluster where bucketed packing
-        would open one small bin per cohort. Receivers accumulate (usage
-        and surviving masks update per merge) but, once claimed, stay
-        dense-committed — never donors later, so no cycles. At large scale
-        the scan cost of the type axis is not worth the <1% remainder:
-        only whole-bin cost-neutral spill of plain remainder bins runs
-        (free capacity under the receiver's cheapest type, so the merge
-        can never raise its price).
+        type axis. Passes repeat until no merge fires, so two previously
+        merged clusters can keep coalescing — which is exactly how the host
+        loop's FFD ends up with a few LARGE shared nodes on a cold cluster
+        where bucketed packing would open one small bin per cohort, and a
+        single-round merge would stop at medium bins. Every non-
+        representative bin of a final cluster maps to the representative in
+        the returned donor dict. At large scale the scan cost of the type
+        axis is not worth the <1% remainder: only whole-bin cost-neutral
+        spill of plain remainder bins runs (free capacity under the
+        receiver's cheapest type, so the merge can never raise its price).
 
         Selection must be conservative: a nominated pod the exact re-add
         vetoes leaks to the host loop, which breaks the dense-carries-the-
         batch invariant AND re-prices the pod at host-FFD fidelity. Three
         prescreens make vetoes structurally impossible for the cases the
-        estimator prices: (a) a topology-pinned donor (zone/ct water-fill
-        or affinity pin) only merges with SIBLING bins of its own bucket —
-        same group, same domain — so recorded domain counts equal the
-        water-fill plan and the skew/affinity checks see exactly what they
-        audited; (b) every cross-group nomination requires the donor
-        group's requirement set to be compatible with the receiver bucket's
-        effective node requirements (template ∩ group ∩ pins — the same
-        algebra node.add will enforce); (c) the partial path (donor demoted
-        to the host loop wholesale) stays restricted to remainder/dedicated
-        bins whose group is type-compatible with the receiver's cheapest
-        type — the shape it was designed for, where the demoted tail is a
-        few pods, never a full pattern bin.
+        estimator prices: (a) a topology-pinned cluster (zone/ct water-fill
+        or affinity pin) only merges where the committed domain counts stay
+        on plan — the receiver must carry the SAME pin on every axis the
+        donor pins, and a pin on an axis the donor leaves free must be a
+        domain every donor group allows; (b) every donor group's
+        requirement set must be compatible with the receiver cluster's
+        accumulated effective requirements (template ∩ group ∩ pins ∩
+        previously merged groups — the same algebra node.add will enforce);
+        (c) the partial path (donor demoted to the host loop wholesale)
+        stays restricted to unmerged remainder/dedicated single bins whose
+        group is type-compatible with the receiver's cheapest type — the
+        shape it was designed for, where the demoted tail is a few pods,
+        never a full pattern bin. Dedicated (anti-affinity / hostname-
+        spread) pods additionally require the receiver cluster to hold no
+        pod of the same group (the per-host zero-count rule).
 
-        Bounded: donor bins over _SPILL_BIN_PODS pods or passes over
-        _SPILL_TOTAL_PODS total pods are skipped.
+        Bounded: donor clusters over _SPILL_BIN_PODS pods stay dense, and
+        total donated pods are capped at _SPILL_TOTAL_PODS (each donated
+        pod is one exact re-add at apply time).
         """
         num_bins = sol["num_bins"]
         if num_bins < 2:
             return {}
         bin_bucket = sol["bin_bucket"]
         bin_rows = sol["bin_rows"]
-        usage = sol["usage"].copy()  # mutated as receivers accumulate
-        masks = sol["mask_all"].copy()
+        usage_all = sol["usage"]
+        masks_all = sol["mask_all"]
 
         prices = problem.prices
         cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
@@ -1492,17 +1819,7 @@ class DenseSolver:
             hit = np.where(mask_row, prices, np.inf)
             return float(hit.min())
 
-        cheapest_price = np.array([cheapest(masks[b]) for b in range(num_bins)])
-
         bucket_of = [buckets[int(b)] for b in bin_bucket]
-        plain = np.asarray(
-            [
-                problem.groups[bk.group_index].kind == GroupKind.PLAIN
-                and bk.zone is None
-                and bk.capacity_type is None
-                for bk in bucket_of
-            ]
-        )
         dedicated = np.asarray([bk.dedicated for bk in bucket_of])
         group_of = np.asarray([bk.group_index for bk in bucket_of])
         zone_index = {z: i for i, z in enumerate(problem.zones)}
@@ -1512,31 +1829,8 @@ class DenseSolver:
         last_of_bucket: Dict[int, int] = {}
         for bid in range(num_bins):
             last_of_bucket[int(bin_bucket[bid])] = bid
-
-        small = num_bins <= self._SPILL_DENSE_BINS
-        if small:
-            candidates = [
-                bid
-                for bid in range(num_bins)
-                if masks[bid].any()
-                and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
-                and not bucket_of[bid].single_bin
-            ]
-        else:
-            candidates = [
-                bid
-                for bid in last_of_bucket.values()
-                if plain[bid] and masks[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
-            ]
-        candidates.sort(key=lambda bid: len(bin_rows[bid]))
         remainder_bins = set(last_of_bucket.values())
 
-        # requirement-algebra prescreen (b): donor group reqs vs the receiver
-        # bin's effective node requirements — the SAME algebra bucket_proto
-        # runs at commit (one shared helper, _bucket_proto_reqs), plus the
-        # requirements of donor groups already nominated onto that receiver
-        # (node.add tightens the node per accepted pod, so a later donor
-        # must be compatible with the accumulated set, not just the base)
         eff_reqs_cache: Dict[int, Optional[Requirements]] = {}
 
         def bucket_eff_reqs(bkey: int) -> Optional[Requirements]:
@@ -1544,139 +1838,220 @@ class DenseSolver:
                 eff_reqs_cache[bkey] = self._bucket_proto_reqs(problem, buckets[bkey])
             return eff_reqs_cache[bkey]
 
-        recv_acc: Dict[int, Requirements] = {}  # receiver bin -> accumulated reqs
-
-        def reqs_compatible(g: int, rbid: int) -> bool:
-            donor_reqs = problem.groups[g].requirements
-            if donor_reqs is None:
-                return True
-            eff = recv_acc.get(rbid)
-            if eff is None:
-                eff = bucket_eff_reqs(int(bin_bucket[rbid]))
-            return eff is not None and eff.compatible(donor_reqs) is None
-
-        def accumulate(g: int, rbid: int) -> None:
-            donor_reqs = problem.groups[g].requirements
-            if donor_reqs is None:
-                return
-            eff = recv_acc.get(rbid)
-            if eff is None:
-                eff = bucket_eff_reqs(int(bin_bucket[rbid])).copy()
-            eff.add(*donor_reqs.values())
-            recv_acc[rbid] = eff
-
-        # a receiver whose bucket the commit will route to the host loop
-        # (proto None) can land no donors — the record_of_bid guard would
-        # demote them wholesale
-        receiver_ok = np.asarray(
-            [
-                masks[r].any() and not dedicated[r] and bucket_eff_reqs(int(bin_bucket[r])) is not None
-                for r in range(num_bins)
+        if num_bins > self._SPILL_DENSE_BINS:
+            # large scale: cost-neutral whole-bin spill of plain remainder
+            # bins only (no type upgrades): free capacity under the
+            # receiver's cheapest surviving type
+            plain = np.asarray(
+                [
+                    problem.groups[bk.group_index].kind == GroupKind.PLAIN
+                    and bk.zone is None
+                    and bk.capacity_type is None
+                    for bk in bucket_of
+                ]
+            )
+            candidates = [
+                bid
+                for bid in remainder_bins
+                if plain[bid] and masks_all[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
             ]
-        )
-        donors: Dict[int, tuple] = {}  # donor bin -> (receiver bin, full?)
-        donor_groups_of: Dict[int, set] = {}  # receiver -> groups nominated onto it
-        claimed: set = set()  # receivers stay committed: never donors later
-        budget = self._SPILL_TOTAL_PODS
-        for bid in candidates:
-            rows = bin_rows[bid]
-            if len(rows) > budget or bid in claimed:
-                continue
-            dbucket = bucket_of[bid]
-            g = dbucket.group_index
-            reqs_d = problem.requests[rows]
-            need = reqs_d.sum(axis=0)
-            ok = receiver_ok.copy()
-            ok[bid] = False
-            pinned = dbucket.zone is not None or dbucket.capacity_type is not None
-            if pinned:
-                # prescreen (a): a water-fill/affinity-pinned donor only
-                # merges with sibling bins — same group, same domain — so
-                # the committed domain counts equal the audited plan
-                ok &= (
-                    (group_of == g)
-                    & np.asarray([bk.zone == dbucket.zone for bk in bucket_of])
-                    & np.asarray([bk.capacity_type == dbucket.capacity_type for bk in bucket_of])
-                )
-            else:
-                # unpinned donor onto a pinned receiver: the pin must be a
-                # domain the donor's group allows (the exact re-add would
-                # veto the rest — skip the wasted adds)
+            candidates.sort(key=lambda bid: len(bin_rows[bid]))
+            usage = usage_all.copy()
+            receiver_ok = np.asarray(
+                [
+                    masks_all[r].any() and not dedicated[r] and bucket_eff_reqs(int(bin_bucket[r])) is not None
+                    for r in range(num_bins)
+                ]
+            )
+            donors: Dict[int, tuple] = {}
+            claimed: set = set()
+            budget = self._SPILL_TOTAL_PODS
+            cheapest_t = np.array([int(np.argmin(np.where(masks_all[b], prices, np.inf))) if masks_all[b].any() else 0 for b in range(num_bins)])
+            for bid in candidates:
+                rows = bin_rows[bid]
+                if len(rows) > budget or bid in claimed:
+                    continue
+                g = bucket_of[bid].group_index
+                donor_reqs = problem.groups[g].requirements
+                need = problem.requests[rows].sum(axis=0)
+                ok = receiver_ok.copy()
+                ok[bid] = False
+                ok &= problem.compat[g, cheapest_t]
                 for r in np.nonzero(ok)[0]:
                     bk = bucket_of[int(r)]
                     if bk.zone is not None and bk.zone != "__infeasible__":
                         zi = zone_index.get(bk.zone)
                         if zi is None or not problem.group_zone_allowed[g][zi]:
                             ok[r] = False
+                            continue
                     if bk.capacity_type is not None:
                         ci = ct_index.get(bk.capacity_type)
                         if ci is None or not problem.group_ct_allowed[g][ci]:
                             ok[r] = False
-            # prescreen (b): every receiver must pass the requirement algebra
-            # the add protocol will enforce (same-group receivers too — an
-            # earlier cross-group donor may have tightened the node)
-            for r in np.nonzero(ok)[0]:
-                if (group_of[int(r)] != g or int(r) in recv_acc) and not reqs_compatible(g, int(r)):
-                    ok[r] = False
-            if dedicated[bid]:
-                ok &= group_of != g
-                # a receiver already holding a donor of this group would veto
-                # the second pod at apply (zero-count per host) — exclude it
-                for r, groups in donor_groups_of.items():
-                    if g in groups:
-                        ok[r] = False
-            receiver = None
-            full = True
-            if small:
-                # net-saving merge over the full type axis (upgrades allowed)
-                cand = np.nonzero(ok)[0]
-                if cand.size:
-                    comb_fit = ((usage[cand] + need)[:, None, :] <= cap_tol_eff[None, :, :]).all(axis=2)
-                    comb_mask = masks[cand] & problem.compat[g][None, :] & comb_fit
-                    comb_price = np.where(comb_mask, prices[None, :], np.inf).min(axis=1)
-                    saving = cheapest_price[bid] + cheapest_price[cand] - comb_price
-                    best = int(np.argmax(saving))
-                    if np.isfinite(comb_price[best]) and saving[best] > 1e-9:
-                        receiver = int(cand[best])
-                        usage[receiver] = usage[receiver] + need
-                        masks[receiver] = comb_mask[best]
-                        cheapest_price[receiver] = float(comb_price[best])
-                if receiver is None and not pinned and (bid in remainder_bins or dedicated[bid]):
-                    # prescreen (c) — cost-neutral partial spill, remainder/
-                    # dedicated bins only: the donor's pods take the exact
-                    # host loop, which fills the committed receiver first and
-                    # opens a fresh node only for the rest
-                    cheapest_t = np.array([int(np.argmin(np.where(masks[b], prices, np.inf))) for b in range(num_bins)])
-                    spare = cap_tol_eff[cheapest_t] - usage
-                    partial = (
-                        ok
-                        & problem.compat[g, cheapest_t]
-                        & np.any(np.all(reqs_d[:, None, :] <= spare[None, :, :], axis=2), axis=0)
-                    )
-                    part_choice = np.nonzero(partial)[0]
-                    if part_choice.size == 0:
-                        continue
-                    receiver, full = int(part_choice[0]), False
-                    usage[receiver] = cap_tol_eff[cheapest_t[receiver]]  # consumed: unknown subset lands on it
-                if receiver is None:
-                    continue
-            else:
-                # cost-neutral whole-bin spill only (no type upgrades): free
-                # capacity under the receiver's cheapest surviving type
-                cheapest_t = np.array([int(np.argmin(np.where(masks[b], prices, np.inf))) for b in range(num_bins)])
+                            continue
+                    # prescreen (b): the exact re-add enforces the donor
+                    # group's requirements against the receiver's proto
+                    if donor_reqs is not None:
+                        eff = bucket_eff_reqs(int(bin_bucket[int(r)]))
+                        if eff is None or eff.compatible(donor_reqs) is not None:
+                            ok[r] = False
                 spare = cap_tol_eff[cheapest_t] - usage
-                ok &= problem.compat[g, cheapest_t]
                 full_choice = np.nonzero(ok & np.all(need[None, :] <= spare, axis=1))[0]
                 if full_choice.size == 0:
                     continue
                 receiver = int(full_choice[0])
                 usage[receiver] = usage[receiver] + need
-            donors[bid] = (receiver, full)
-            claimed.add(receiver)
-            donor_groups_of.setdefault(receiver, set()).add(g)
-            accumulate(g, receiver)
-            receiver_ok[bid] = False  # a donor can no longer receive
-            budget -= len(rows)
+                donors[bid] = (receiver, True)
+                claimed.add(receiver)
+                receiver_ok[bid] = False
+                budget -= len(rows)
+            return donors
+
+        # -- small scale: agglomerative clustering to fixpoint ---------------
+        class _Cluster:
+            __slots__ = ("rep", "bins", "pods", "usage", "mask", "price", "zone", "ct", "groups", "ded", "acc", "can_receive", "can_donate")
+
+        clusters: Dict[int, _Cluster] = {}
+        for bid in range(num_bins):
+            bk = bucket_of[bid]
+            c = _Cluster()
+            c.rep = bid
+            c.bins = [bid]
+            c.pods = len(bin_rows[bid])
+            c.usage = usage_all[bid].copy()
+            c.mask = masks_all[bid].copy()
+            c.price = cheapest(c.mask) if c.mask.any() else np.inf
+            c.zone = bk.zone
+            c.ct = bk.capacity_type
+            c.groups = {bk.group_index}
+            c.ded = {bk.group_index} if bk.dedicated else set()
+            c.acc = None  # lazy: rep bucket proto + merged donor group reqs
+            c.can_receive = bool(c.mask.any()) and not bk.dedicated and bucket_eff_reqs(int(bin_bucket[bid])) is not None
+            c.can_donate = bool(c.mask.any()) and c.pods > 0 and not bk.single_bin
+            clusters[bid] = c
+
+        def cluster_acc(c: _Cluster) -> Optional[Requirements]:
+            if c.acc is None:
+                base = bucket_eff_reqs(int(bin_bucket[c.rep]))
+                c.acc = base.copy() if base is not None else None
+            return c.acc
+
+        def groups_admitted(d: _Cluster, r: _Cluster) -> bool:
+            """Prescreens (a)+(b) + the dedicated zero-count rule for merging
+            donor cluster d into receiver cluster r."""
+            if d.zone is not None and r.zone != d.zone:
+                return False
+            if d.ct is not None and r.ct != d.ct:
+                return False
+            if (d.ded & r.groups) or (r.ded & d.groups):
+                return False
+            acc = cluster_acc(r)
+            if acc is None:
+                return False
+            for g in d.groups:
+                if d.zone is None and r.zone is not None:
+                    zi = zone_index.get(r.zone)
+                    if zi is None or not problem.group_zone_allowed[g][zi]:
+                        return False
+                if d.ct is None and r.ct is not None:
+                    ci = ct_index.get(r.ct)
+                    if ci is None or not problem.group_ct_allowed[g][ci]:
+                        return False
+                greqs = problem.groups[g].requirements
+                if greqs is not None and acc.compatible(greqs) is not None:
+                    return False
+            return True
+
+        donors: Dict[int, tuple] = {}
+        budget = self._SPILL_TOTAL_PODS
+
+        def merge(d: _Cluster, r: _Cluster, comb_mask: np.ndarray, comb_price: float) -> None:
+            nonlocal budget
+            budget -= d.pods
+            for bid in d.bins:
+                donors[bid] = (r.rep, True)
+            r.bins.extend(d.bins)
+            r.pods += d.pods
+            r.usage = r.usage + d.usage
+            r.mask = comb_mask
+            r.price = comb_price
+            r.groups |= d.groups
+            r.ded |= d.ded
+            acc = cluster_acc(r)
+            for g in d.groups:
+                greqs = problem.groups[g].requirements
+                if greqs is not None:
+                    acc.add(*greqs.values())
+            del clusters[d.rep]
+
+        # fixpoint with a pass cap: merges converge in 2-3 passes on real
+        # shapes; the cap bounds the worst case (one merge per pass) at
+        # O(cap x bins^2) type-axis scans instead of O(bins^3)
+        changed = True
+        passes = 0
+        while changed and passes < 8:
+            changed = False
+            passes += 1
+            for rep in sorted(clusters, key=lambda k: (clusters[k].pods, k)):
+                d = clusters.get(rep)
+                if d is None or not d.can_donate or d.pods > min(self._SPILL_BIN_PODS, budget):
+                    continue
+                # donor cluster compat across its groups, AND-combined once
+                d_compat = None
+                for g in d.groups:
+                    row = problem.compat[g]
+                    d_compat = row if d_compat is None else (d_compat & row)
+                best = None  # (saving, receiver, comb_mask, comb_price)
+                for r in clusters.values():
+                    if r is d or not r.can_receive or not groups_admitted(d, r):
+                        continue
+                    comb_fit = ((r.usage + d.usage)[None, :] <= cap_tol_eff).all(axis=1)
+                    comb_mask = r.mask & d_compat & comb_fit
+                    if not comb_mask.any():
+                        continue
+                    comb_price = float(np.where(comb_mask, prices, np.inf).min())
+                    saving = d.price + r.price - comb_price
+                    if saving > 1e-9 and (best is None or saving > best[0]):
+                        best = (saving, r, comb_mask, comb_price)
+                if best is not None:
+                    merge(d, best[1], best[2], best[3])
+                    changed = True
+
+        # prescreen (c): cost-neutral partial spill for unmerged remainder/
+        # dedicated single bins — the donor's pods take the exact host loop,
+        # which fills the committed receivers first and opens a fresh node
+        # only for the rest
+        for rep in sorted(clusters, key=lambda k: (clusters[k].pods, k)):
+            d = clusters.get(rep)
+            if (
+                d is None
+                or len(d.bins) > 1  # merged clusters stay dense
+                or d.zone is not None
+                or d.ct is not None
+                or not d.mask.any()
+                or bucket_of[rep].single_bin  # all-or-nothing component contract
+                or not (rep in remainder_bins or dedicated[rep])
+                or not (0 < d.pods <= min(self._SPILL_BIN_PODS, budget))
+            ):
+                continue
+            g = bucket_of[rep].group_index
+            reqs_d = problem.requests[bin_rows[rep]]
+            for r in clusters.values():
+                if r is d or not r.can_receive or not groups_admitted(d, r):
+                    continue
+                t = int(np.argmin(np.where(r.mask, prices, np.inf)))
+                if not problem.compat[g, t]:
+                    continue
+                spare = cap_tol_eff[t] - r.usage
+                if not np.any(np.all(reqs_d <= spare[None, :], axis=1)):
+                    continue
+                donors[rep] = (r.rep, False)
+                r.usage = cap_tol_eff[t].copy()  # consumed: unknown subset lands on it
+                r.groups |= d.groups
+                r.ded |= d.ded
+                budget -= d.pods
+                del clusters[rep]
+                break
         return donors
 
     # -- steps 4+5: verify & commit ------------------------------------------

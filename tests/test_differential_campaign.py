@@ -283,26 +283,21 @@ def test_randomized_differential_campaign(seed):
     _assert_invariants(dense_results, pods_dense)
     _assert_invariants(host_results, pods_host)
 
-    # cost tripwire on the new-node remainder. Not parity: bucketed packing
-    # structurally keeps spread-cohort fragments on their own (water-filled,
-    # zone-pinned) bins where the host loop's per-pod transient-skew order
-    # can interleave them onto warm nodes — so the allowance scales with the
-    # number of spread cohorts (each can fragment into a small bin or two;
-    # soak seed 12 is the worst observed shape). The bound still catches
-    # gross regressions (the pre-round-3 behavior was >5x on these mixes).
+    # cost bound on the new-node remainder. The warm fill is host-exact (one
+    # global FFD pass over every pod kind, exact view.add per placement —
+    # dense.py _fill_existing), so the residual gap vs the host oracle is
+    # only the new-bin phase: pods the IR cannot express (host ports,
+    # cross-selecting spread groups) re-pack as a SUBSET stream through the
+    # host loop, and FFD on a subset can land a size class on a pricier
+    # type than FFD on the full stream. Measured over 300 seeds x1 and 40
+    # seeds x8 scale, the worst excess is 4x the cheapest node; the bound
+    # allows 5 for margin. In aggregate the dense path prices ~0.6% BELOW
+    # the host oracle (tests/test_cost_parity.py asserts both).
     dense_cost = sum(n.instance_type_options[0].price() for n in dense_results.new_nodes if n.pods)
     host_cost = sum(n.instance_type_options[0].price() for n in host_results.new_nodes if n.pods)
     if host_cost > 0:
         cheapest = min(it.price() for it in provider.get_instance_types(make_provisioner()))
-        spread_cohorts = len(
-            {
-                (c.topology_key, tuple(sorted(c.label_selector.match_labels.items())))
-                for p in pods_dense
-                for c in p.spec.topology_spread_constraints
-                if c.topology_key == LABEL_TOPOLOGY_ZONE and c.label_selector is not None
-            }
-        )
-        allowance = (2 + spread_cohorts) * cheapest
-        assert dense_cost <= host_cost * 2 + allowance + 1e-6, (
-            f"seed {seed}: dense cost {dense_cost} vs host {host_cost} (+{allowance} allowance)"
+        assert dense_cost <= host_cost + 5 * cheapest + 1e-6, (
+            f"seed {seed}: dense cost {dense_cost} vs host {host_cost} "
+            f"(+{5 * cheapest} allowance, {(dense_cost - host_cost) / cheapest:.1f} cheapest-units over)"
         )
