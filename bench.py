@@ -368,6 +368,22 @@ def main() -> None:
     del pods, state_nodes
     gc.collect()
 
+    # --- scaled whole-cluster repack: 16k pods / 2.4k existing nodes ---
+    # (round-3 ask: the consolidation flagship's scaling story, measured.
+    # The warm fill is the same exact single-pass protocol as the 2k
+    # config — certificate fast paths, no scale switch.)
+    log("config repack_16k_x_2400")
+    provider = FakeCloudProvider(instance_types(100))
+    pods = build_workload(16_000, seed=5)
+    state_nodes = build_repack_state(2400)
+    ms, _ = run_config(
+        "repack_16k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
+        state_nodes=state_nodes, trials=SIDE_TRIALS,
+    )
+    configs["repack_16k_x_2400"] = round(ms, 1)
+    del pods, state_nodes
+    gc.collect()
+
     # --- spot/OD mixed pricing, weighted multi-provisioner / 500 types ---
     log("config spot_od_multiprov_x_500")
     provider = FakeCloudProvider(build_spot_od_types(500))

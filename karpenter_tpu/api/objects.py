@@ -278,7 +278,7 @@ class Pod:
     # keyed on resource_version. deepcopy MUST NOT carry them: copies exist
     # to be mutated (relaxation, volume-topology injection) and a stale memo
     # on a mutated copy silently reverts the mutation for every consumer.
-    _COPY_EXCLUDED = ("_reqs_cache", "_encode_cache")
+    _COPY_EXCLUDED = ("_reqs_cache", "_encode_cache", "_podreq_cache")
 
     def __deepcopy__(self, memo):
         import copy as _copy

@@ -186,6 +186,19 @@ class TopologyGroup:
         self._tie_rotation += 1
         return Requirement(self.key, OP_IN, choice)
 
+    def admits_pinned(self, domain: str, pod_domains: Requirement, self_selecting: bool) -> bool:
+        """The spread skew rule for a node pinned to `domain` — the same
+        arithmetic _next_domain_spread evaluates for a pinned node, exposed
+        so cohort fast paths (existingnode.add_cohort) can re-check the one
+        genuinely per-pod spread condition without rebuilding requirement
+        objects. Must stay byte-equivalent to the pinned branch above."""
+        if domain not in self.domains or not pod_domains.has(domain):
+            return False
+        count = self.domains[domain]
+        if self_selecting:
+            count += 1
+        return count - self._domain_min_count(pod_domains) <= self.max_skew
+
     def _domain_min_count(self, domains: Requirement) -> int:
         # hostname topologies can always mint a fresh (zero-count) domain
         if self.key == lbl.LABEL_HOSTNAME:

@@ -73,6 +73,24 @@ class Requirements:
 
     # -- collection protocol ------------------------------------------------
 
+    def same_as(self, other: "Requirements") -> bool:
+        """Content equality over every key's full constraint state — the
+        requirements-epoch guard of ExistingNodeView's cohort certificates
+        (existingnode.py) relies on this detecting ANY semantic change."""
+        if len(self._by_key) != len(other._by_key):
+            return False
+        for key, r in self._by_key.items():
+            o = other._by_key.get(key)
+            if (
+                o is None
+                or r.complement != o.complement
+                or r.values != o.values
+                or r.greater_than != o.greater_than
+                or r.less_than != o.less_than
+            ):
+                return False
+        return True
+
     def add(self, *requirements: Requirement) -> None:
         for requirement in requirements:
             existing = self._by_key.get(requirement.key)

@@ -276,7 +276,7 @@ class DenseSolver:
             self.stats.pods_to_host += len(leftover)
             return leftover
 
-        defer_spread = bool(scheduler.existing_nodes) and problem.P <= self._FILL_EXACT_MAX_PODS
+        defer_spread = bool(scheduler.existing_nodes)
         buckets = self._build_buckets(problem, scheduler.topology, scheduler, defer_spread=defer_spread)
         t_encoded = time.perf_counter()
         existing_committed = 0
@@ -776,17 +776,15 @@ class DenseSolver:
         """Fill existing-node capacity before opening new bins.
 
         Mirrors the host loop's existing-nodes-first rule
-        (scheduler.go:191-195, existingnode.go:97) at bucket granularity:
-
-        - plain / zone-pinned buckets fill greedily largest-first over
-          deduplicated size classes (same FFD order as the host queue);
-        - spread groups interleave one pod at a time across their zone
-          buckets, lowest-current-count first, because the exact topology
-          check inside view.add enforces the per-pod min-count domain rule
-          (topologygroup.go:157-184) — bulk-filling one zone would trip it;
-        - dedicated / single-bin buckets (hostname spread, anti-affinity,
-          hostname affinity) skip existing fill: their per-host zero-count
-          checks need the exact host protocol.
+        (scheduler.go:191-195, existingnode.go:97): ONE pass in the host
+        queue's FFD order over every pod kind — plain/pinned buckets,
+        domain-deferred spread and affinity cohorts, dedicated (per-host
+        zero-count) pods, single-bin components, host-routed rows, and the
+        IR-inexpressible extras — each placement through the exact
+        ExistingNodeView protocol. Consecutive same-bucket same-size items
+        batch into add_cohort runs whose per-pod residue is integer/capacity
+        arithmetic (existingnode.py), which keeps the exact pass flat at
+        10k+ pods with no scale switch.
 
         `extra_pods` are the IR-inexpressible pods (problem.host_pods) bound
         for the exact host loop. They join this fill at their global FFD
@@ -807,10 +805,12 @@ class DenseSolver:
         ids of extra_pods placed).
         """
         from ..scheduler.errors import IncompatibleError
+        from ..scheduler.existingnode import ExistingNodeView
         from ..scheduler.queue import ffd_sort_key
-        from .pack_counts import dedupe_sizes
 
         views = scheduler.existing_nodes
+        zone_index = {z: i for i, z in enumerate(problem.zones)}
+        ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
         taken = np.zeros((problem.P,), dtype=bool)
         zone_of: List[Optional[str]] = []
         ct_of: List[Optional[str]] = []
@@ -868,11 +868,53 @@ class DenseSolver:
             head[vi] -= problem.requests[row]
             return True
 
-        def commit_run(vi: int, rows: List[int], ctx=None) -> int:
-            """Commit a same-group run through the cohort fast path;
-            returns how many landed (a prefix of rows)."""
+        # Two certificate tiers amortize the full add() protocol:
+        # - per-BUCKET (certify_bucket): cohorts with no node requirements —
+        #   the common shape — get exact verdicts on ANY view from set/
+        #   integer lookups, so they never pay a full add (except an
+        #   affinity bootstrap round, which the full protocol must own);
+        # - per-(bucket, view) (certify): cohorts WITH requirements pay one
+        #   full add per pair, then the per-pod residue, guarded by the
+        #   view's requirement-content epoch.
+        bucket_certs: Dict[int, object] = {}
+        cert_cache: Dict[tuple, object] = {}
+        _UNSET = object()
+
+        def bucket_cert_of(bucket: _Bucket, rep_row: int, ctx):
+            gid = id(bucket)
+            cert = bucket_certs.get(gid, _UNSET)
+            if cert is _UNSET:
+                cert = ExistingNodeView.certify_bucket(problem.pods[rep_row], ctx)
+                bucket_certs[gid] = cert
+            if cert is not None and cert.affinity_groups:
+                # bootstrap round: no populated domain anywhere means the
+                # full protocol must make (and record) the domain choice
+                for g in cert.affinity_groups:
+                    if not any(g.domains.values()):
+                        return None
+            return cert
+
+        def commit_run(vi: int, rows: List[int], bucket: _Bucket, ctx=None) -> int:
+            """Commit a same-bucket same-size run through the certified
+            cohort fast paths; returns how many landed (a prefix of rows)."""
             nonlocal committed
-            n = views[vi].add_cohort([problem.pods[r] for r in rows], ctx=ctx)
+            view = views[vi]
+            bcert = bucket_cert_of(bucket, rows[0], ctx)
+            if bcert is not None:
+                n = view.add_certified_view_run([problem.pods[r] for r in rows], bcert)
+            else:
+                key = (id(bucket), vi)
+                cert = cert_cache.get(key)
+                if cert is not None and cert.epoch == view.req_epoch:
+                    n = view.add_certified_run([problem.pods[r] for r in rows], cert)
+                else:
+                    n = view.add_cohort([problem.pods[r] for r in rows], ctx=ctx)
+                    if n:
+                        cert = view.certify(problem.pods[rows[0]], ctx)
+                        if cert is not None:
+                            cert_cache[key] = cert
+                        else:
+                            cert_cache.pop(key, None)
             for r in rows[:n]:
                 taken[r] = True
             committed += n
@@ -906,10 +948,9 @@ class DenseSolver:
                 return True
             return False
 
-        spread_units: Dict[int, List[_Bucket]] = {}
         plain_buckets: List[_Bucket] = []
         special_buckets: List[_Bucket] = []  # dedicated / single_bin
-        deferred_buckets: List[_Bucket] = []  # spread, water-fill deferred
+        deferred_buckets: List[_Bucket] = []  # spread/affinity, domain deferred
         host_route_buckets: List[_Bucket] = []  # __infeasible__: host loop owns them
         for bucket in buckets:
             if not bucket.pod_rows:
@@ -923,357 +964,160 @@ class DenseSolver:
                 # re-checks everything, so per-pod attempts here are safe
                 # for any constraint shape.
                 host_route_buckets.append(bucket)
-                continue
-            if bucket.dedicated or bucket.single_bin:
-                # Per-host zero-count constraints (anti-affinity, hostname
-                # spread, hostname affinity): at exact-fill scale they join
-                # the unified FFD pass below (the view.add protocol enforces
-                # the per-host count rules); above it, a bulk phase places
-                # them before the class-vectorized fill.
+            elif bucket.dedicated or bucket.single_bin:
                 special_buckets.append(bucket)
-                continue
-            if bucket.deferred_spread:
-                # per-pod warm attempts under the host loop's transient-count
-                # skew rule; only exists at exact-fill scale (presolve gates
-                # deferral on P <= _FILL_EXACT_MAX_PODS)
+            elif bucket.deferred_spread:
                 deferred_buckets.append(bucket)
-                continue
-            group = problem.groups[bucket.group_index]
-            if group.kind == GroupKind.SPREAD:
-                spread_units.setdefault(bucket.group_index, []).append(bucket)
             else:
                 plain_buckets.append(bucket)
 
-        fill_buckets = plain_buckets + deferred_buckets + [b for unit in spread_units.values() for b in unit]
-        total_fill = (
-            sum(len(b.pod_rows) for b in fill_buckets)
-            + sum(len(b.pod_rows) for b in special_buckets)
-            + sum(len(b.pod_rows) for b in host_route_buckets)
-        )
-        exact_fill = (total_fill > 0 or extra_pods) and total_fill <= self._FILL_EXACT_MAX_PODS
+        # ONE unified pass in the host queue's FFD order over every pod kind
+        # — bucketed (plain/pinned), domain-deferred spread and affinity,
+        # dedicated, single-bin components, host-routed rows, and the
+        # IR-inexpressible extras — so the claim on warm capacity is decided
+        # by the one global FFD order the host loop uses, at any batch size.
+        # Consecutive same-bucket same-size items batch into add_cohort runs
+        # (existingnode.py): the first pod of a run pays the full protocol,
+        # the rest pay only the genuinely per-pod checks, which is what
+        # keeps this exact pass flat at 10k+ pods (the former
+        # _FILL_EXACT_MAX_PODS switch to a class-vectorized approximation
+        # is gone — one algorithm, one semantics, every scale).
+        all_buckets = plain_buckets + special_buckets + deferred_buckets + host_route_buckets
+        items: List[tuple] = [
+            (problem.pods[r], r, bucket) for bucket in all_buckets for r in bucket.pod_rows
+        ]
+        items.extend((pod, None, None) for pod in extra_pods)
+        items.sort(key=lambda t: ffd_sort_key(t[0]))
 
-        if not exact_fill:
-            # bulk special-bucket phase (above the exact-fill scale gate):
-            # fill existing capacity through the exact view.add protocol,
-            # then leave the remainder IN the bucket for the dense new-bin
-            # pack (fresh hostnames are zero-count by construction), instead
-            # of routing hundreds of pods through the O(pods x views) host
-            # loop.
-            for bucket in special_buckets:
-                group = problem.groups[bucket.group_index]
-                ctx = ctx_of(bucket.group_index)
-                rows = bucket.pod_rows
-                order = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
-                queue = [rows[i] for i in order]
-                viable = [vi for vi in range(len(views)) if view_ok(bucket, group, vi)]
-                if bucket.single_bin:
-                    # whole component shares one host: only a view whose free
-                    # capacity swallows the entire component is safe (greedy
-                    # adds cannot backtrack a half-placed component)
-                    total = problem.requests[rows].sum(axis=0)
-                    for vi in viable:
-                        if not np.all(total <= head[vi]):
-                            continue
-                        if commit(vi, queue[0], ctx):
-                            for row in queue[1:]:
-                                if not commit(vi, row, ctx):
-                                    # rare (ports/volume veto mid-component):
-                                    # the host loop owns the remainder — it
-                                    # sees the recorded affinity domain and
-                                    # applies the exact bootstrap rules
-                                    bucket.zone = "__infeasible__"
-                                    break
-                            break  # component is bound to this host now
-                else:
-                    # dedicated: at most one pod per host; for each view take
-                    # the first (largest-first) pod that fits, so a small
-                    # view still serves a small pod. A commit veto on a
-                    # capacity-checked pod is group-level for these buckets
-                    # (taints/requirements/zero-count on this host), so give
-                    # the view up rather than retrying every pod on it.
-                    # Fit is one [Q, V] matrix: a commit consumes its view
-                    # for this group, so other rows never go stale.
-                    if viable and queue:
-                        qreq = problem.requests[queue]
-                        fit = (qreq[:, None, :] <= head[viable][None, :, :]).all(axis=2)
-                        used = np.zeros(len(queue), dtype=bool)
-                        for j, vi in enumerate(viable):
-                            hits = np.flatnonzero(fit[:, j] & ~used)
-                            if hits.size == 0:
-                                continue
-                            qi = int(hits[0])
-                            if commit(vi, queue[qi], ctx):
-                                used[qi] = True
-                            if used.all():
-                                break
-                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
-
-        # unified warm fill: ONE view-major pass over spread AND plain
-        # buckets with size classes globally sorted by the host queue's FFD
-        # key — the host loop is one FFD order over every pod (queue.py), so
-        # the largest pod anywhere gets first claim on warm capacity,
-        # whatever its constraint kind; any phase ordering (plain-first or
-        # spread-first) strands some other kind's big pod on a fresh bin.
-        #
-        # Spread buckets participate via RESERVATIONS: every spread pod's
-        # planned domain count is recorded UP FRONT (scaffolding only — the
-        # unplaced remainder is unrecorded at the end of the fill, and
-        # _apply_commit records the real bins). The host loop interleaves
-        # opening new nodes with warm placement, so its per-pod skew check
-        # runs against counts that already include the nodes it has opened;
-        # pre-recording the (band-feasible) water-fill plan gives view.add
-        # the same picture, making spread commits order-independent: a warm
-        # placement swaps a planned fresh-bin pod for a warm one in the SAME
-        # domain, so final counts equal the plan no matter how many commit.
-        # Reservations only remove false vetoes; they never admit a
-        # placement whose final state is infeasible.
-        reservation_ledger: Dict[tuple, list] = {}  # (id(tg), domain) -> [tg, domain, count]
-        spread_meta: Dict[int, tuple] = {}  # id(bucket) -> (domain, count_groups)
-        for g, unit in spread_units.items():
-            group = problem.groups[g]
-            ctx = ctx_of(g)
-            # the topology groups that would count these pods, for this key
-            count_groups = [
-                tg for tg in {id(t): t for t in (ctx.owned + ctx.selected)}.values() if tg.key == group.topology_key
-            ]
-            for bucket in unit:
-                domain = bucket.zone if bucket.zone is not None else bucket.capacity_type
-                spread_meta[id(bucket)] = (domain, count_groups)
-                n_rows = len(bucket.pod_rows)
-                for tg in count_groups:
-                    tg.record(domain, count=n_rows)
-                    entry = reservation_ledger.setdefault((id(tg), domain), [tg, domain, 0])
-                    entry[2] += n_rows
-
-        if exact_fill:
-            # exact host-order fill: per pod in the host queue's FFD order,
-            # first view (in index order) the exact protocol accepts — byte
-            # for byte the reference's existing-nodes-first pass
-            # (scheduler.go:191-195) for every non-dedicated bucket. Spread
-            # pods may land in ANY group-allowed domain (the sibling-domain
-            # warm re-home the host loop gets for free): the pod's own
-            # reservation lifts first, so view.add judges "final counts
-            # without me", and a cross-domain success just moves one pod of
-            # the plan from fresh-bin-in-d to warm-in-d'. Above the scale
-            # gate the class-vectorized pass below takes over — there the
-            # per-pod protocol would dominate wall clock while fragments are
-            # a vanishing cost fraction.
-            zone_index = {z: i for i, z in enumerate(problem.zones)}
-            ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
-            singlebin_tried: set = set()
-            fill_pods = [
-                (row, bucket) for bucket in fill_buckets + special_buckets + host_route_buckets for row in bucket.pod_rows
-            ]
-            fill_pods.extend((pod, None) for pod in extra_pods)
-            fill_pods.sort(key=lambda rb: ffd_sort_key(problem.pods[rb[0]] if rb[1] is not None else rb[0]))
-            for row, bucket in fill_pods:
-                if bucket is None:  # host-routed pod at its FFD position
-                    try_extra(row)
-                    continue
-                group = problem.groups[bucket.group_index]
-                req = problem.requests[row]
-                meta = spread_meta.get(id(bucket))
-                fit_views = np.flatnonzero(usable & (req <= head).all(axis=1))
-                if fit_views.size == 0:
-                    continue
-                if bucket.zone == "__infeasible__":
-                    # host-routed rows: raw exact adds, view order — no
-                    # group-level prescreen (hostname-keyed requirements make
-                    # _view_accepts meaningless here; the add is authority)
-                    for vi in fit_views:
-                        if commit(int(vi), row, ctx_of(bucket.group_index)):
-                            break
-                    continue
-                if bucket.single_bin:
-                    # bootstrap hostname-affinity component: all-or-nothing
-                    # swallow at the component's first FFD position (greedy
-                    # per-pod adds cannot backtrack a half-placed component;
-                    # the whole-component contract schedules the cohort on a
-                    # fresh host where per-pod order would strand its tail)
-                    if id(bucket) in singlebin_tried:
-                        continue
-                    singlebin_tried.add(id(bucket))
-                    rows = bucket.pod_rows
-                    order_sb = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
-                    queue_sb = [rows[i] for i in order_sb]
-                    total_sb = problem.requests[rows].sum(axis=0)
-                    ctx = ctx_of(bucket.group_index)
-                    for vi in fit_views:
-                        vi = int(vi)
-                        if not view_ok(bucket, group, vi) or not np.all(total_sb <= head[vi]):
-                            continue
-                        if commit(vi, queue_sb[0], ctx):
-                            for r in queue_sb[1:]:
-                                if not commit(vi, r, ctx):
-                                    # rare (ports/volume veto mid-component):
-                                    # the host loop owns the remainder — it
-                                    # sees the recorded affinity domain and
-                                    # applies the exact bootstrap rules
-                                    bucket.zone = "__infeasible__"
-                                    break
-                            break  # component is bound to this host now
-                    continue
-                if bucket.deferred_spread:
-                    # any group-allowed domain; the exact add judges the
-                    # transient counts exactly as the host loop would at this
-                    # queue position
-                    gi = bucket.group_index
-                    zone_spread = group.topology_key == lbl.LABEL_TOPOLOGY_ZONE
-                    for vi in fit_views:
-                        vi = int(vi)
-                        if zone_spread:
-                            dv = zone_index.get(zone_of[vi])
-                            if dv is None or not problem.group_zone_allowed[gi][dv]:
-                                continue
-                        else:
-                            dv = ct_index.get(ct_of[vi])
-                            if dv is None or not problem.group_ct_allowed[gi][dv]:
-                                continue
-                        if not self._view_accepts(group, views[vi]):
-                            continue
-                        if commit(vi, row, ctx_of(gi)):
-                            break
-                    continue
-                if meta is not None:
-                    domain, count_groups = meta
-                    for tg in count_groups:
-                        tg.unrecord(domain)
-                placed = False
-                for vi in fit_views:
-                    vi = int(vi)
-                    if meta is None:
-                        if not view_ok(bucket, group, vi):
-                            continue
-                    else:
-                        # any domain the group allows; exact skew decides
-                        if bucket.zone is not None:
-                            dv = zone_index.get(zone_of[vi])
-                            if dv is None or not problem.group_zone_allowed[bucket.group_index][dv]:
-                                continue
-                        else:
-                            dv = ct_index.get(ct_of[vi])
-                            if dv is None or not problem.group_ct_allowed[bucket.group_index][dv]:
-                                continue
-                        if not self._view_accepts(group, views[vi]):
-                            continue
-                    if commit(vi, row, ctx_of(bucket.group_index)):
-                        placed = True
-                        break
-                if meta is not None:
-                    if placed:
-                        for tg in count_groups:
-                            reservation_ledger[(id(tg), domain)][2] -= 1
-                    else:
-                        for tg in count_groups:
-                            tg.record(domain)
-            for bucket in fill_buckets + special_buckets + host_route_buckets:
-                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
-            for tg, domain, count in reservation_ledger.values():
-                if count:
-                    tg.unrecord(domain, count=count)
-            return committed, taken, placed_extras
-
-        entries = []  # one per (bucket, size class)
-        for bucket in fill_buckets:
+        singlebin_tried: set = set()
+        N = len(items)
+        i = 0
+        while i < N:
+            pod_obj, row, bucket = items[i]
+            if bucket is None:  # host-routed extra at its FFD position
+                try_extra(pod_obj)
+                i += 1
+                continue
             group = problem.groups[bucket.group_index]
-            rows = bucket.pod_rows
-            unique, counts, inverse = dedupe_sizes(problem.requests[rows])
-            class_rows: List[List[int]] = [[] for _ in range(len(unique))]
-            for local, u in enumerate(inverse):
-                class_rows[int(u)].append(rows[local])
-            for u in range(len(unique)):
-                entries.append(
-                    {
-                        "bucket": bucket,
-                        "group": group,
-                        "size": unique[u],
-                        "rows": class_rows[u],
-                        "cursor": 0,
-                    }
-                )
-        if entries:
-            sizes_mat = np.stack([e["size"] for e in entries])
-            # same FFD key as the host queue sort (cpu, then memory, descending)
-            order_e = np.lexsort((-sizes_mat[:, 1], -sizes_mat[:, 0]))
-            entries = [entries[i] for i in order_e]
-            sizes_mat = sizes_mat[order_e]
-            # capacity prescreen: views that fit at least one class right now
-            # (commits only shrink already-visited rows, so unvisited rows of
-            # this one-shot matrix never go stale)
-            cand_views = np.flatnonzero((sizes_mat[:, None, :] <= head[None, :, :]).all(axis=2).any(axis=0))
-            total_remaining = sum(len(e["rows"]) for e in entries)
-            for vi in cand_views:
-                if total_remaining == 0:
-                    break
-                free = head[vi].copy()
-                selections: Dict[int, List[int]] = {}  # bucket id -> rows
-                picked: List[tuple] = []  # (entry, k)
-                for e in entries:
-                    rem = len(e["rows"]) - e["cursor"]
-                    if rem == 0:
-                        continue
-                    size = e["size"]
-                    # every size class has pods >= 1 (pod_requests adds it),
-                    # so at least one positive component always exists
-                    positive = size > 1e-12
-                    k = int(min(np.floor(free[positive] / size[positive]).min(), rem))
-                    if k <= 0:
-                        continue
-                    if not view_ok(e["bucket"], e["group"], vi):
-                        continue
-                    selections.setdefault(id(e["bucket"]), []).extend(e["rows"][e["cursor"] : e["cursor"] + k])
-                    picked.append((e, k))
-                    free = free - size * k
-                if not picked:
+            req = problem.requests[row]
+            if bucket.zone == "__infeasible__":
+                # host-routed rows: raw exact adds, view order — no
+                # group-level prescreen (hostname-keyed requirements make
+                # _view_accepts meaningless here; the add is authority)
+                for vi in np.flatnonzero(usable & (req <= head).all(axis=1)):
+                    if commit(int(vi), row, ctx_of(bucket.group_index)):
+                        break
+                i += 1
+                continue
+            if bucket.single_bin:
+                # bootstrap hostname-affinity component: all-or-nothing
+                # swallow at the component's first FFD position (greedy
+                # per-pod adds cannot backtrack a half-placed component;
+                # the whole-component contract schedules the cohort on a
+                # fresh host where per-pod order would strand its tail)
+                i += 1
+                if id(bucket) in singlebin_tried:
                     continue
-                # land each bucket's selection as one cohort; a veto mid-run
-                # only loses that bucket's tail (same as the old per-class
-                # bail) — view.add's exact resource check protects `free`'s
-                # optimism across buckets. For a spread bucket the selection's
-                # reservations lift just before the adds (the pods are moving
-                # from planned-fresh to warm within the SAME domain) and the
-                # unplaced tail re-reserves after.
-                placed_of: Dict[int, int] = {}
-                for e, k in picked:
-                    bid = id(e["bucket"])
-                    if bid not in placed_of:
-                        sel = selections[bid]
-                        meta = spread_meta.get(bid)
-                        if meta is not None:
-                            domain, count_groups = meta
-                            for tg in count_groups:
-                                tg.unrecord(domain, count=len(sel))
-                        n_placed = commit_run(vi, sel, ctx_of(e["bucket"].group_index))
-                        if meta is not None:
-                            leftover = len(sel) - n_placed
-                            for tg in count_groups:
-                                if leftover:
-                                    tg.record(domain, count=leftover)
-                                reservation_ledger[(id(tg), domain)][2] -= n_placed
-                        placed_of[bid] = n_placed
-                for e, k in picked:
-                    bid = id(e["bucket"])
-                    t = min(k, placed_of[bid])
-                    e["cursor"] += t
-                    placed_of[bid] -= t
-                    total_remaining -= t
-            for bucket in fill_buckets:
-                bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
+                singlebin_tried.add(id(bucket))
+                rows_sb = bucket.pod_rows
+                order_sb = np.lexsort(tuple(-problem.requests[rows_sb][:, c] for c in (1, 0)))
+                queue_sb = [rows_sb[k] for k in order_sb]
+                total_sb = problem.requests[rows_sb].sum(axis=0)
+                ctx = ctx_of(bucket.group_index)
+                for vi in np.flatnonzero(usable & (total_sb <= head).all(axis=1)):
+                    vi = int(vi)
+                    if not view_ok(bucket, group, vi):
+                        continue
+                    if commit(vi, queue_sb[0], ctx):
+                        for r in queue_sb[1:]:
+                            if not commit(vi, r, ctx):
+                                # rare (ports/volume veto mid-component):
+                                # the host loop owns the remainder — it
+                                # sees the recorded affinity domain and
+                                # applies the exact bootstrap rules
+                                bucket.zone = "__infeasible__"
+                                break
+                        break  # component is bound to this host now
+                continue
+            if bucket.dedicated:
+                # at most one pod per host: per-pod, first accepting view
+                # (the zero-count rule is per-host, so a veto moves to the
+                # next view, never ends the scan). Certified cohorts reduce
+                # each attempt to set/integer lookups — without this, N
+                # anti-affinity pods cost N full protocol runs each scanning
+                # every registered hostname.
+                ctx = ctx_of(bucket.group_index)
+                dcert = bucket_cert_of(bucket, row, ctx)
+                for vi in np.flatnonzero(usable & (req <= head).all(axis=1)):
+                    vi = int(vi)
+                    if not view_ok(bucket, group, vi):
+                        continue
+                    if dcert is not None:
+                        if views[vi].add_certified_view(problem.pods[row], dcert):
+                            taken[row] = True
+                            committed += 1
+                            head[vi] -= req
+                            break
+                    elif commit(vi, row, ctx):
+                        break
+                i += 1
+                continue
 
-        # above the exact-fill scale gate the class-vectorized pass owns the
-        # bucket pods; host-routed extras still get their warm attempts
-        # (bounded: O(extras x views), and extras are the IR-inexpressible
-        # tail of the batch, not the batch)
-        for pod in sorted(extra_pods, key=ffd_sort_key):
-            try_extra(pod)
+            # plain / pinned / deferred: maximal same-bucket same-size run
+            j = i + 1
+            while j < N and items[j][2] is bucket and np.array_equal(problem.requests[items[j][1]], req):
+                j += 1
+            run = [items[k][1] for k in range(i, j)]
+            i = j
+            gi = bucket.group_index
+            ctx = ctx_of(gi)
+            if not bucket.deferred_spread:
+                # rejections are persistent for identical pods on a plain
+                # run (capacity and port state only shrink, acceptance memo
+                # is static), so one forward scan over fit views is exact
+                for vi in np.flatnonzero(usable & (req <= head).all(axis=1)):
+                    vi = int(vi)
+                    if not view_ok(bucket, group, vi):
+                        continue
+                    n = commit_run(vi, run, bucket, ctx)
+                    if n:
+                        run = run[n:]
+                        if not run:
+                            break
+                continue
+            # deferred spread/affinity: any group-allowed domain; the exact
+            # add judges transient counts exactly as the host loop would at
+            # this queue position. Skew admission is NOT monotone (another
+            # domain's placements can raise the global min), so after each
+            # placed sub-run the scan restarts from view 0 — the same views
+            # the next pod would probe per-pod.
+            zone_keyed = group.topology_key == lbl.LABEL_TOPOLOGY_ZONE
+            while run:
+                placed_any = False
+                for vi in np.flatnonzero(usable & (req <= head).all(axis=1)):
+                    vi = int(vi)
+                    if zone_keyed:
+                        dv = zone_index.get(zone_of[vi])
+                        if dv is None or not problem.group_zone_allowed[gi][dv]:
+                            continue
+                    else:
+                        dv = ct_index.get(ct_of[vi])
+                        if dv is None or not problem.group_ct_allowed[gi][dv]:
+                            continue
+                    if not self._view_accepts(group, views[vi]):
+                        continue
+                    n = commit_run(vi, run, bucket, ctx)
+                    if n:
+                        run = run[n:]
+                        placed_any = True
+                        break
+                if not placed_any:
+                    break
 
-        # retract the reservations of the pods that stayed planned-fresh;
-        # _apply_commit records their real bins
-        for tg, domain, count in reservation_ledger.values():
-            if count:
-                tg.unrecord(domain, count=count)
-
+        for bucket in all_buckets:
+            bucket.pod_rows = [r for r in bucket.pod_rows if not taken[r]]
         return committed, taken, placed_extras
+
 
     def _pallas_enabled(self) -> bool:
         import os
@@ -1456,7 +1300,7 @@ class DenseSolver:
         # last bin on mixed-size streams where the host loop's FFD ladder
         # downsizes adaptively; at scale the last-bin effect vanishes and
         # the single argmin pack keeps wall-clock flat
-        refine = problem.P <= self._FILL_EXACT_MAX_PODS
+        refine = problem.P <= self._PACK_REFINE_MAX_PODS
         local: List[tuple] = []
         for b, bucket in enumerate(buckets):
             rows = np.asarray(bucket.pod_rows, dtype=np.int64)
@@ -1564,10 +1408,10 @@ class DenseSolver:
         )
 
     _FRAGMENT_MAX_PODS = 3
-    # warm fills up to this many pods run the exact per-pod host-order pass
-    # (cost parity with the reference's existing-first rule); larger fills
-    # use the class-vectorized pass where per-pod protocol would dominate
-    _FILL_EXACT_MAX_PODS = 2048
+    # batches up to this many pods refine the per-bucket pack over several
+    # candidate types (_best_pack) — a cost polish whose last-bin effect
+    # vanishes at scale while its K-packs-per-bucket cost would not
+    _PACK_REFINE_MAX_PODS = 2048
 
     def _assemble(self, problem: DenseProblem, buckets: List[_Bucket], local: List[tuple], bucket_extra: np.ndarray, caps_eff: np.ndarray, reroute_fragments: bool = False) -> dict:
         """Pure assembly + audit of the per-bucket packings: global bin ids,

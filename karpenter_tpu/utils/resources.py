@@ -122,6 +122,15 @@ def _effective_requests(container) -> ResourceList:
 
 
 def pod_requests(pod) -> ResourceList:
+    """Effective scheduling requests of a pod (containers + init peak +
+    overhead + the implicit 1 pod). Memoized per (pod, resource_version) —
+    the scheduler and the dense fill call this many times per pod per solve
+    — so the returned mapping is SHARED and must be treated as immutable
+    (every consumer merges/subtracts into fresh dicts)."""
+    version = pod.metadata.resource_version
+    cached = getattr(pod, "_podreq_cache", None)
+    if cached is not None and cached[0] == version:
+        return cached[1]
     running: ResourceList = {}
     for container in pod.spec.containers:
         running = merge(running, _effective_requests(container))
@@ -132,6 +141,10 @@ def pod_requests(pod) -> ResourceList:
     out[PODS] = out.get(PODS, 0.0) + 1.0
     if pod.spec.overhead:
         out = merge(out, pod.spec.overhead)
+    try:
+        pod._podreq_cache = (version, out)
+    except AttributeError:
+        pass  # slotted/frozen pod objects skip the memo
     return out
 
 

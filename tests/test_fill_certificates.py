@@ -1,0 +1,65 @@
+"""Certificate fast paths must be byte-equivalent to the full add protocol.
+
+The warm fill commits through three tiers (solver/dense.py _fill_existing):
+full ExistingNodeView.add, per-(bucket, view) CohortCert residues, and
+per-bucket BucketCert set/integer verdicts (existingnode.py). The fast
+tiers claim EXACT equivalence with the full protocol for the shapes they
+certify — this suite enforces that claim differentially: the same randomized
+warm-cluster instance solved with certificates force-disabled (every commit
+a full add) must produce the identical placement map, node by node, pod by
+pod, and the identical leftover set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.scheduler.existingnode import ExistingNodeView
+
+from tests.test_differential_campaign import (
+    _random_states,
+    _random_workload,
+    _rename,
+    _solve,
+)
+
+
+def _placement_map(results):
+    placed = {}
+    for vi, view in enumerate(results.existing_nodes):
+        for pod in view.pods:
+            placed[pod.name] = ("view", vi)
+    for node in results.new_nodes:
+        key = tuple(sorted(p.name for p in node.pods))
+        for pod in node.pods:
+            placed[pod.name] = ("new", key)
+    return placed
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_certified_fill_matches_full_protocol(seed, monkeypatch):
+    def run(disable_certs: bool):
+        if disable_certs:
+            monkeypatch.setattr(ExistingNodeView, "certify_bucket", staticmethod(lambda rep, ctx: None))
+            monkeypatch.setattr(ExistingNodeView, "certify", lambda self, rep, ctx: None)
+        else:
+            monkeypatch.undo()
+        rng = np.random.default_rng(4000 + seed)
+        provider = FakeCloudProvider(instance_types(int(rng.integers(20, 120))))
+        pods = _rename(_random_workload(rng, int(rng.integers(60, 160))), f"cert{seed}")
+        states = _random_states(rng)
+        results, solver = _solve(pods, states, provider, dense=True)
+        return _placement_map(results), solver.stats.pods_committed, solver.stats.pods_to_host
+
+    certified, committed_c, to_host_c = run(disable_certs=False)
+    full, committed_f, to_host_f = run(disable_certs=True)
+    assert committed_c == committed_f and to_host_c == to_host_f, (
+        f"seed {seed}: certified ({committed_c} committed / {to_host_c} host) != "
+        f"full protocol ({committed_f} / {to_host_f})"
+    )
+    assert certified == full, (
+        f"seed {seed}: placements diverge on "
+        f"{ {k: (certified.get(k), full.get(k)) for k in set(certified) | set(full) if certified.get(k) != full.get(k)} }"
+    )
