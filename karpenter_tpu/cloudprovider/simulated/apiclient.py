@@ -1,0 +1,257 @@
+"""CloudAPIClient: the provider's remote-transport cloud client.
+
+Duck-types `CloudBackend`, so `SimulatedCloudProvider(backend=client)` runs
+the whole provider stack — catalog, pricing, launch templates, fleet
+batching, ICE negative caching — with every cloud interaction crossing a
+socket. This is the client half of the production seam (api.py documents
+the protocol), mirroring the reference's remote-API client obligations
+(pkg/cloudprovider/aws/cloudprovider.go:86-101, instance.go:133-208,335-345):
+
+  - bearer-token auth and a connectivity dry-run (`verify()`, the session
+    GetCallerIdentity analog) so a misconfigured endpoint fails at startup,
+    not mid-provisioning;
+  - retry with exponential backoff + decorrelated jitter on 429 (honoring
+    Retry-After), 5xx, and transport errors, bounded by max_attempts;
+  - pagination for the instance-type catalog;
+  - a typed error taxonomy: structured error bodies map back to
+    InsufficientCapacityError (with per-pool extraction) and
+    LaunchTemplateNotFoundError (with template ids) — the same exceptions
+    the in-process backend raises, so provider error handling is
+    transport-agnostic;
+  - idempotent CreateFleet: every logical launch carries a client token;
+    a retry after a lost response replays the SAME token and the service
+    returns the original instance — a mid-call timeout can never
+    double-launch (EC2 ClientToken semantics).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import quote, urlparse
+
+from ...logsetup import get_logger
+from ...utils.clock import Clock
+from .backend import (
+    FleetInstance,
+    FleetRequest,
+    InstanceTypeInfo,
+    InsufficientCapacityError,
+    LaunchTemplate,
+    LaunchTemplateNotFoundError,
+    SecurityGroup,
+    Subnet,
+)
+
+log = get_logger("cloudapi")
+
+MAX_ATTEMPTS = 6
+BACKOFF_BASE = 0.05
+BACKOFF_CAP = 2.0
+PAGE_SIZE = 50
+
+
+class CloudAPIError(RuntimeError):
+    """Transport or service failure that exhausted the retry budget."""
+
+    def __init__(self, message: str, status: Optional[int] = None, code: Optional[str] = None):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+
+
+class AuthError(CloudAPIError):
+    """401: bad or missing bearer token — never retried."""
+
+
+class CloudAPIClient:
+    def __init__(
+        self,
+        base_url: str,
+        token: str = "sim-cloud-token",
+        clock=None,
+        max_attempts: int = MAX_ATTEMPTS,
+        backoff_base: float = BACKOFF_BASE,
+        timeout: float = 10.0,
+        sleep=None,
+    ):
+        parsed = urlparse(base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or 80
+        self._token = token
+        self.clock = clock or Clock()
+        self.max_attempts = max_attempts
+        self.backoff_base = backoff_base
+        self.timeout = timeout
+        # backoff sleeps through the clock (FakeClock advances virtually) so
+        # fake-clocked suites never burn real wall time on retries; an
+        # explicit `sleep` hook wins (tests capture the schedule)
+        self._sleep = sleep if sleep is not None else self.clock.sleep
+        self._rng = random.Random(0x5EED)
+        self.retries = 0  # observable: total retried attempts
+
+    # -- transport -----------------------------------------------------------
+
+    def _once(self, method: str, path: str, body: Optional[dict]) -> Tuple[int, dict, Dict[str, str]]:
+        conn = http.client.HTTPConnection(self._host, self._port, timeout=self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Authorization": f"Bearer {self._token}", "Content-Type": "application/json"}
+            conn.request(method, path, body=payload, headers=headers)
+            response = conn.getresponse()
+            raw = response.read()
+            parsed = json.loads(raw) if raw else {}
+            return response.status, parsed, dict(response.getheaders())
+        finally:
+            conn.close()
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        """One logical API call: retries transport errors, 429 (honoring
+        Retry-After), and 5xx with exponential backoff + decorrelated
+        jitter; maps structured errors to the typed taxonomy."""
+        last_error: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                self.retries += 1
+            try:
+                status, parsed, headers = self._once(method, path, body)
+            except OSError as err:  # connection refused/reset, timeout
+                last_error = err
+                self._backoff(attempt, None)
+                continue
+            if status == 429:
+                last_error = CloudAPIError("throttled", status=429, code="throttled")
+                self._backoff(attempt, headers.get("Retry-After"))
+                continue
+            if status >= 500:
+                message = (parsed.get("error") or {}).get("message", "internal error")
+                last_error = CloudAPIError(message, status=status, code="internal")
+                self._backoff(attempt, None)
+                continue
+            if status == 401:
+                raise AuthError("unauthorized: check the cloud API bearer token", status=401, code="unauthorized")
+            error = parsed.get("error")
+            if error is not None:
+                code = error.get("code")
+                if code == "insufficient_capacity":
+                    raise InsufficientCapacityError([tuple(p) for p in error.get("pools", [])])
+                if code == "launch_template_not_found":
+                    raise LaunchTemplateNotFoundError(error.get("template_ids", []))
+                if code == "not_found":
+                    raise _RemoteNotFound(error.get("message", path))
+                raise CloudAPIError(error.get("message", code or "error"), status=status, code=code)
+            return parsed
+        raise CloudAPIError(
+            f"{method} {path} failed after {self.max_attempts} attempts: {last_error}",
+            status=getattr(last_error, "status", None),
+            code=getattr(last_error, "code", None) or "exhausted",
+        )
+
+    def _backoff(self, attempt: int, retry_after: Optional[str]) -> None:
+        if retry_after is not None:
+            try:
+                hint = float(retry_after)
+            except ValueError:
+                hint = 0.0
+            delay = max(hint, self.backoff_base)
+        else:
+            # decorrelated jitter, capped (aws-sdk backoff idiom)
+            delay = min(BACKOFF_CAP, self.backoff_base * (2**attempt)) * (0.5 + self._rng.random() / 2)
+        self._sleep(delay)
+
+    # -- connectivity dry-run -----------------------------------------------
+
+    def verify(self) -> None:
+        """Startup connectivity + auth dry-run (cloudprovider.go:86-101):
+        one cheap authenticated call; raises AuthError / CloudAPIError."""
+        self._call("GET", "/v1/subnets")
+
+    # -- CloudBackend surface -----------------------------------------------
+
+    def describe_instance_types(self) -> List[InstanceTypeInfo]:
+        items: List[dict] = []
+        token: Optional[int] = 0
+        while token is not None:
+            page = self._call("GET", f"/v1/instance-types?max-results={PAGE_SIZE}&page-token={token}")
+            items.extend(page.get("items", []))
+            token = page.get("next_token")
+        return [InstanceTypeInfo(**item) for item in items]
+
+    def _selector_query(self, tag_selector: Optional[Dict[str, str]]) -> str:
+        if not tag_selector:
+            return ""
+        return "?" + "&".join(f"tag.{quote(k)}={quote(v)}" for k, v in sorted(tag_selector.items()))
+
+    def describe_subnets(self, tag_selector: Optional[Dict[str, str]] = None) -> List[Subnet]:
+        page = self._call("GET", "/v1/subnets" + self._selector_query(tag_selector))
+        return [Subnet(**item) for item in page.get("items", [])]
+
+    def describe_security_groups(self, tag_selector: Optional[Dict[str, str]] = None) -> List[SecurityGroup]:
+        page = self._call("GET", "/v1/security-groups" + self._selector_query(tag_selector))
+        return [SecurityGroup(**item) for item in page.get("items", [])]
+
+    def describe_prices(self) -> Tuple[Dict[str, float], Dict[Tuple[str, str], float]]:
+        page = self._call("GET", "/v1/prices")
+        od = dict(page.get("on_demand", {}))
+        spot = {(q["type"], q["zone"]): q["price"] for q in page.get("spot", [])}
+        return od, spot
+
+    def get_on_demand_price(self, type_name: str) -> Optional[float]:
+        od, _ = self.describe_prices()
+        return od.get(type_name)
+
+    def get_spot_price(self, type_name: str, zone: str) -> Optional[float]:
+        _, spot = self.describe_prices()
+        return spot.get((type_name, zone))
+
+    def ensure_launch_template(self, name: str, image_id: str, security_group_ids: Sequence[str], user_data: str) -> LaunchTemplate:
+        body = {
+            "name": name,
+            "image_id": image_id,
+            "security_group_ids": list(security_group_ids),
+            "user_data": user_data,
+        }
+        data = self._call("POST", "/v1/launch-templates", body)
+        data["security_group_ids"] = tuple(data.get("security_group_ids", ()))
+        return LaunchTemplate(**data)
+
+    def delete_launch_template(self, name: str) -> None:
+        self._call("DELETE", f"/v1/launch-templates/{quote(name)}")
+
+    def create_fleet(self, request: FleetRequest) -> FleetInstance:
+        body = {
+            "idempotency_token": uuid.uuid4().hex,
+            "capacity_type": request.capacity_type,
+            "specs": [
+                {
+                    "instance_type": s.instance_type,
+                    "zone": s.zone,
+                    "capacity_type": s.capacity_type,
+                    "launch_template_id": s.launch_template_id,
+                    "subnet_id": s.subnet_id,
+                }
+                for s in request.specs
+            ],
+        }
+        data = self._call("POST", "/v1/fleet", body)
+        return FleetInstance(**data)
+
+    def terminate_instance(self, instance_id: str) -> None:
+        try:
+            self._call("DELETE", f"/v1/instances/{quote(instance_id)}")
+        except _RemoteNotFound:
+            pass  # already gone: terminate is idempotent, like the backend
+
+    def instance_exists(self, instance_id: str) -> bool:
+        try:
+            self._call("GET", f"/v1/instances/{quote(instance_id)}")
+            return True
+        except _RemoteNotFound:
+            return False
+
+
+class _RemoteNotFound(RuntimeError):
+    pass

@@ -187,6 +187,13 @@ class CloudBackend:
     def get_spot_price(self, type_name: str, zone: str) -> Optional[float]:
         return self.spot_prices.get((type_name, zone))
 
+    def describe_prices(self) -> Tuple[Dict[str, float], Dict[Tuple[str, str], float]]:
+        """Bulk price books (on-demand, spot) — one call per pricing refresh
+        instead of one per (type, zone), which is what keeps the HTTP
+        transport (api.py) from turning every refresh into a call storm."""
+        with self._lock:
+            return dict(self.od_prices), dict(self.spot_prices)
+
     # -- launch templates -------------------------------------------------------
 
     def ensure_launch_template(self, name: str, image_id: str, security_group_ids: Sequence[str], user_data: str) -> LaunchTemplate:

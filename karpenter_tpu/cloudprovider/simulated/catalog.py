@@ -37,22 +37,18 @@ class PricingProvider:
         self.refreshes = 0
         self.refresh()
 
-    def refresh(self) -> None:
-        od: Dict[str, float] = {}
-        spot: Dict[Tuple[str, str], float] = {}
-        subnets = self.backend.describe_subnets()
-        for info in self.backend.describe_instance_types():
-            price = self.backend.get_on_demand_price(info.name)
-            if price is not None:
-                od[info.name] = price
-            for subnet in subnets:
-                quote = self.backend.get_spot_price(info.name, subnet.zone)
-                if quote is not None:
-                    spot[(info.name, subnet.zone)] = quote
+    def refresh(self) -> bool:
+        """Re-pull both price books; returns True when either changed (the
+        caller invalidates the catalog so new prices reach offerings). One
+        bulk call per refresh (describe_prices) — over the HTTP transport,
+        per-(type, zone) quote calls would be a call storm."""
+        od, spot = self.backend.describe_prices()
         with self._lock:
-            self._od = od
-            self._spot = spot
+            changed = od != self._od or spot != self._spot
+            self._od = dict(od)
+            self._spot = dict(spot)
             self.refreshes += 1
+        return changed
 
     def on_demand_price(self, type_name: str, info: Optional[InstanceTypeInfo] = None) -> float:
         with self._lock:

@@ -180,6 +180,17 @@ class SimulatedCloudProvider(CloudProvider):
     def name(self) -> str:
         return "simulated"
 
+    def refresh_pricing(self) -> bool:
+        """One pricing-refresh tick (the synchronous core of the reference's
+        async OD/spot updaters, pricing.go:76-393): re-pull the price books
+        and, when they changed, invalidate the catalog so the next
+        GetInstanceTypes prices offerings from the new books. Called by the
+        runtime's leader-only refresh loop (runtime.py)."""
+        changed = self.pricing.refresh()
+        if changed:
+            self.catalog.invalidate()
+        return changed
+
     # -- provider config -------------------------------------------------------
 
     def _node_class(self, provisioner: Optional[Provisioner]) -> NodeClass:

@@ -149,6 +149,10 @@ class Runtime:
         self._spawn(self._lifecycle_loop, "node-lifecycle")
         self._spawn(self._consolidation_loop, "consolidation")
         self._spawn(self._metrics_loop, "metrics-scraper")
+        # leader-only by construction: start() blocks on leadership above,
+        # so followers never reach this spawn — the election gating of the
+        # reference's OD/spot price updaters (pricing.go:76-393)
+        self._spawn(self._pricing_loop, "pricing-refresh")
 
     def stop(self) -> None:
         self._stop.set()
@@ -180,6 +184,24 @@ class Runtime:
             self.pod_metrics.scrape()
             self.provisioner_metrics.scrape()
             self.node_metrics.scrape()
+
+    def _pricing_loop(self) -> None:
+        while not self._stop.wait(timeout=self.options.pricing_refresh_period):
+            self.refresh_pricing_once()
+
+    def refresh_pricing_once(self) -> bool:
+        """One pricing-refresh tick against providers that support it (the
+        metrics decorator forwards refresh_pricing to the inner provider;
+        providers without price books are a no-op). Returns True when the
+        books changed and the catalog was invalidated."""
+        refresh = getattr(self.cloud_provider, "refresh_pricing", None)
+        if refresh is None:
+            return False
+        try:
+            return bool(refresh())
+        except Exception as err:  # noqa: BLE001 - refresh must never kill the loop
+            log.warning("pricing refresh failed (will retry next period): %s", err)
+            return False
 
     # -- synchronous drive (tests / simulations) --------------------------------
 

@@ -34,6 +34,9 @@ class Options:
     dense_min_batch: int = DENSE_MIN_BATCH_DEFAULT
     cluster_name: str = ""
     log_level: str = "info"
+    # period of the leader-only pricing refresh loop (pricing.go:76-393 runs
+    # OD and spot updaters on election; one TTL here covers both books)
+    pricing_refresh_period: float = 300.0
     solver_service_address: str = ""  # host:port of the gRPC solver sidecar (empty = in-process)
     solver_service_timeout: float = 30.0
     # URL of a Kubernetes apiserver (http://host:port). Empty = the in-memory
@@ -51,6 +54,8 @@ class Options:
             errs.append("kube client qps must be positive")
         if self.batch_idle_duration <= 0 or self.batch_max_duration < self.batch_idle_duration:
             errs.append("batch durations must satisfy 0 < idle <= max")
+        if self.pricing_refresh_period <= 0:
+            errs.append("pricing refresh period must be positive")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -87,6 +92,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--log-level", default=_env("LOG_LEVEL", defaults.log_level))
     parser.add_argument("--solver-service-address", default=_env("SOLVER_SERVICE_ADDRESS", defaults.solver_service_address))
     parser.add_argument("--solver-service-timeout", type=float, default=_env("SOLVER_SERVICE_TIMEOUT", defaults.solver_service_timeout))
+    parser.add_argument("--pricing-refresh-period", type=float, default=_env("PRICING_REFRESH_PERIOD", defaults.pricing_refresh_period))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))

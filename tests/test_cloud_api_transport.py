@@ -1,0 +1,263 @@
+"""Cloud-API transport tier: auth, pagination, retry/backoff, typed errors,
+and idempotent CreateFleet under connection loss.
+
+The client obligations mirrored from the reference's remote-API provider
+(instance.go:133-208,335-345; cloudprovider.go:86-101): a misbehaving cloud
+endpoint must degrade into retries, typed errors, and at-most-once launches
+— never into silent double-launches or stringly error handling.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.cloudprovider.simulated import (
+    AuthError,
+    CloudAPIClient,
+    CloudAPIError,
+    CloudAPIService,
+    CloudBackend,
+    SimulatedCloudProvider,
+)
+from karpenter_tpu.cloudprovider.simulated.backend import (
+    FleetInstanceSpec,
+    FleetRequest,
+    InsufficientCapacityError,
+    LaunchTemplateNotFoundError,
+)
+from karpenter_tpu.cloudprovider.types import NodeRequest
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests.helpers import make_provisioner
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def backend(clock):
+    return CloudBackend(clock=clock)
+
+
+@pytest.fixture
+def service(backend):
+    svc = CloudAPIService(backend=backend).start()
+    yield svc
+    svc.stop()
+
+
+@pytest.fixture
+def client(service, clock):
+    # no real sleeping in tests: collect the backoff schedule instead
+    delays = []
+    c = CloudAPIClient(service.url, clock=clock, sleep=delays.append)
+    c.test_delays = delays
+    return c
+
+
+def _fleet_request(backend):
+    template = backend.launch_templates.get("t") or backend.ensure_launch_template("t", "img-1", ["sg-default"], "")
+    return FleetRequest(
+        specs=[
+            FleetInstanceSpec(
+                instance_type="general-2x4",
+                zone="zone-a",
+                capacity_type="on-demand",
+                launch_template_id=template.template_id,
+                subnet_id="subnet-zone-a",
+            )
+        ],
+        capacity_type="on-demand",
+    )
+
+
+class TestAuthAndDryRun:
+    def test_verify_dry_run_succeeds(self, client):
+        client.verify()
+
+    def test_bad_token_is_typed_and_unretried(self, service, clock):
+        delays = []
+        bad = CloudAPIClient(service.url, token="wrong", clock=clock, sleep=delays.append)
+        with pytest.raises(AuthError):
+            bad.verify()
+        assert delays == [], "auth failures must not burn the retry budget"
+
+
+class TestPagination:
+    def test_catalog_spans_pages(self, client, backend):
+        # default backend catalog is ~40 types; page size is 50 — grow it so
+        # the client must walk multiple pages
+        from karpenter_tpu.cloudprovider.simulated.backend import InstanceTypeInfo
+
+        backend.catalog = backend.catalog + [
+            InstanceTypeInfo(name=f"padded-{i}", cpu=2.0, memory_bytes=2**31, pods=20.0) for i in range(150)
+        ]
+        names = {t.name for t in client.describe_instance_types()}
+        assert {t.name for t in backend.catalog} == names
+
+
+class TestRetryBackoff:
+    def test_throttle_storm_backs_off_then_succeeds(self, service, client):
+        service.throttle_next(3)
+        subnets = client.describe_subnets()
+        assert len(subnets) == 3
+        assert client.retries == 3
+        assert len(client.test_delays) == 3
+
+    def test_5xx_backoff_grows_exponentially(self, service, client):
+        service.fail_next(4)
+        client.describe_subnets()
+        delays = client.test_delays
+        assert len(delays) == 4
+        # jittered exponential: each cap doubles, so the later delays must
+        # dominate the earlier ones even at minimum jitter
+        assert delays[3] > delays[0]
+
+    def test_retry_budget_exhausts_into_typed_error(self, service, client):
+        service.fail_next(100)
+        with pytest.raises(CloudAPIError) as err:
+            client.describe_subnets()
+        assert err.value.code in ("internal", "exhausted")
+
+
+class TestTypedErrorTaxonomy:
+    def test_insufficient_capacity_pools_extracted(self, service, backend, client):
+        backend.insufficient_capacity_pools.add(("general-2x4", "zone-a", "on-demand"))
+        with pytest.raises(InsufficientCapacityError) as err:
+            client.create_fleet(_fleet_request(backend))
+        assert ("general-2x4", "zone-a", "on-demand") in err.value.pools
+
+    def test_stale_launch_template_ids_extracted(self, backend, client):
+        request = _fleet_request(backend)
+        backend.delete_launch_template("t")
+        with pytest.raises(LaunchTemplateNotFoundError) as err:
+            client.create_fleet(request)
+        assert err.value.template_ids == {request.specs[0].launch_template_id}
+
+
+class TestIdempotentCreateFleet:
+    def test_dropped_response_retry_launches_exactly_once(self, service, backend, client):
+        """Mid-CreateFleet connection loss: the service processes the launch
+        but the response never arrives; the client's retry replays the same
+        idempotency token and must receive the ORIGINAL instance."""
+        service.drop_next(1)
+        instance = client.create_fleet(_fleet_request(backend))
+        assert client.retries >= 1
+        assert len(backend.instances) == 1, "a lost response must never double-launch"
+        assert instance.instance_id in backend.instances
+
+    def test_concurrent_same_token_launches_once(self, service, backend, client):
+        """A retry racing the still-executing original (the server stalled
+        past the client timeout): the in-flight token record makes the
+        second request WAIT for the first outcome and replay it."""
+        import json
+        import threading
+        import urllib.request
+
+        request = _fleet_request(backend)
+        gate = threading.Event()
+        original = backend.create_fleet
+
+        def slow_create(req):
+            gate.wait(timeout=5)
+            return original(req)
+
+        backend.create_fleet = slow_create
+        body = json.dumps(
+            {
+                "idempotency_token": "tok-race",
+                "capacity_type": "on-demand",
+                "specs": [
+                    {
+                        "instance_type": s.instance_type,
+                        "zone": s.zone,
+                        "capacity_type": s.capacity_type,
+                        "launch_template_id": s.launch_template_id,
+                        "subnet_id": s.subnet_id,
+                    }
+                    for s in request.specs
+                ],
+            }
+        ).encode()
+        results = []
+
+        def post():
+            req = urllib.request.Request(
+                service.url + "/v1/fleet",
+                data=body,
+                headers={"Authorization": f"Bearer {service.token}", "Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as response:
+                results.append(json.loads(response.read()))
+
+        threads = [threading.Thread(target=post) for _ in range(2)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+        backend.create_fleet = original
+        assert len(results) == 2
+        assert results[0] == results[1], "both racers must see the one launch"
+        assert len(backend.instances) == 1
+
+    def test_distinct_calls_launch_distinct_instances(self, backend, client):
+        a = client.create_fleet(_fleet_request(backend))
+        b = client.create_fleet(_fleet_request(backend))
+        assert a.instance_id != b.instance_id
+        assert len(backend.instances) == 2
+
+
+class TestProviderOverSockets:
+    def test_provisioning_and_consolidation_rounds(self, service, backend, client, clock):
+        """Full controller rounds — provisioning launches through the socket
+        transport; consolidation's liveness probe and node deletion cross it
+        too (runtime-level, the IceEnv shape of test_provider_catalog)."""
+        from karpenter_tpu.runtime import Runtime
+        from karpenter_tpu.utils.options import Options
+        from tests.helpers import make_pod
+
+        kube = KubeCluster(clock=clock)
+        provider = SimulatedCloudProvider(backend=client, kube=kube, clock=clock)
+        runtime = Runtime(
+            kube=kube,
+            cloud_provider=provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False),
+        )
+        kube.create(make_provisioner(consolidation_enabled=True))
+        pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+        kube.create(pod)
+        runtime.provision_once()
+        nodes = kube.list_nodes()
+        assert len(nodes) == 1 and len(backend.instances) == 1
+        # instance liveness consulted through the socket transport
+        assert provider.instance_exists(nodes[0]) is True
+        # the pod goes away; the emptiness/consolidation path terminates the
+        # instance through the same transport
+        kube.delete(pod)
+        provider.delete(nodes[0])
+        assert len(backend.instances) == 0
+        assert provider.instance_exists(nodes[0]) is False
+
+    def test_end_to_end_create_with_faults(self, service, backend, client, clock):
+        """The full provider path — catalog, launch templates, fleet — over
+        the socket transport, with a throttle storm injected mid-flight."""
+        kube = KubeCluster(clock=clock)
+        provider = SimulatedCloudProvider(backend=client, kube=kube, clock=clock)
+        provisioner = make_provisioner()
+        provider.default_provisioner(provisioner)
+        types = provider.get_instance_types(provisioner)
+        assert types
+        template = NodeTemplate.from_provisioner(provisioner)
+        service.throttle_next(2)
+        node = provider.create(NodeRequest(template=template, instance_type_options=types[:5]))
+        assert node.spec.provider_id.startswith("sim:///")
+        assert len(backend.instances) == 1
+        assert provider.instance_exists(node) is True
+        provider.delete(node)
+        assert provider.instance_exists(node) is False

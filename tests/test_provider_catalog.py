@@ -39,19 +39,32 @@ CAPACITY_TYPES = ("spot", "on-demand")
 
 class IceEnv:
     """Provisioning rounds against the simulated provider with ICE injection —
-    the instancetypes_test reconciliation-attempt harness."""
+    the instancetypes_test reconciliation-attempt harness. With
+    transport="http" every cloud interaction crosses the socket boundary
+    (CloudAPIService + CloudAPIClient)."""
 
-    def __init__(self):
+    def __init__(self, transport: str = "inprocess"):
         self.clock = FakeClock()
         self.kube = KubeCluster(clock=self.clock)
         self.backend = CloudBackend(clock=self.clock)
-        self.provider = SimulatedCloudProvider(backend=self.backend, kube=self.kube, clock=self.clock)
+        self.service = None
+        cloud = self.backend
+        if transport == "http":
+            from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+            self.service = CloudAPIService(backend=self.backend).start()
+            cloud = CloudAPIClient(self.service.url, clock=self.clock)
+        self.provider = SimulatedCloudProvider(backend=cloud, kube=self.kube, clock=self.clock)
         self.runtime = Runtime(
             kube=self.kube,
             cloud_provider=self.provider,
             options=Options(leader_elect=False, dense_solver_enabled=False),
         )
         self.kube.create(make_provisioner())
+
+    def close(self):
+        if self.service is not None:
+            self.service.stop()
 
     def ice(self, type_name: str, zones=ZONES, capacity_types=CAPACITY_TYPES):
         for zone in zones:
@@ -66,8 +79,10 @@ class IceEnv:
 
 
 class TestInsufficientCapacityFallback:
-    def test_launches_different_type_on_second_attempt(self):
-        env = IceEnv()
+    @pytest.mark.parametrize("transport", ["inprocess", "http"])
+    def test_launches_different_type_on_second_attempt(self, transport, request):
+        env = IceEnv(transport)
+        request.addfinalizer(env.close)
         cheapest = env.cheapest_type().name()
         env.ice(cheapest)
         env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))

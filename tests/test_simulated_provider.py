@@ -30,9 +30,20 @@ def backend(clock):
     return CloudBackend(clock=clock)
 
 
-@pytest.fixture
-def provider(backend, clock):
+@pytest.fixture(params=["inprocess", "http"])
+def provider(request, backend, clock):
+    """The whole suite runs twice: once against the in-process backend and
+    once with the provider talking to its cloud exclusively through sockets
+    (CloudAPIService + CloudAPIClient) — tests keep manipulating `backend`
+    directly, which is the service's server-side state."""
     kube = KubeCluster(clock=clock)
+    if request.param == "http":
+        from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+        service = CloudAPIService(backend=backend).start()
+        request.addfinalizer(service.stop)
+        client = CloudAPIClient(service.url, clock=clock)
+        return SimulatedCloudProvider(backend=client, kube=kube, clock=clock)
     return SimulatedCloudProvider(backend=backend, kube=kube, clock=clock)
 
 
