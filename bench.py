@@ -399,10 +399,13 @@ def main() -> None:
     log("sweep 400 types x {1,50,100,500,1000,2000,5000} pods")
     sweep: dict = {}
     provider = FakeCloudProvider(instance_types(SWEEP_TYPES))
-    # production routing: tiny batches take the exact host loop (faster AND
-    # cheaper below the ~350-pod crossover measured in solver/dense.py),
-    # larger ones the dense device path — this is what a deployed Runtime does
-    sweep_solver = DenseSolver()
+    # production routing: tiny batches take the exact host loop, larger ones
+    # the dense device path, with the crossover MEASURED against this
+    # machine's actual dispatch round trip — what a deployed Runtime does
+    # (Options.dense_min_batch=0 auto-measurement)
+    from karpenter_tpu.solver.dense import measure_dense_crossover
+
+    sweep_solver = DenseSolver(min_batch=measure_dense_crossover())
     provisioners = [make_provisioner()]
     for count in SWEEP_PODS:
         pods = build_workload(count, seed=13)

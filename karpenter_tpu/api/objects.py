@@ -46,6 +46,7 @@ class OwnerReference:
     kind: str = ""
     name: str = ""
     uid: str = ""
+    api_version: str = ""
     controller: bool = False
     block_owner_deletion: bool = False
 

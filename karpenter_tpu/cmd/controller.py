@@ -78,11 +78,20 @@ def main(argv=None) -> int:
     # must still answer kubelet probes (controllers.go:167-181)
     from ..observability import ObservabilityServer
 
+    extra_routes = None
+    if options.enable_profiling:
+        # live pprof-analog endpoints on the metrics port
+        # (controllers.go:183-202): on-demand host profile + XLA trace of
+        # the RUNNING process, no restart needed
+        from ..profiling import LiveProfiler
+
+        extra_routes = LiveProfiler().routes()
     obs = ObservabilityServer(
         healthy=runtime.healthy,
         ready=lambda: runtime.ready() and runtime.healthy(),
         health_port=options.health_probe_port,
         metrics_port=options.metrics_port,
+        extra_routes=extra_routes,
     )
     obs.start()
     runtime.start()

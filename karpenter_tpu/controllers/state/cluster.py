@@ -107,6 +107,7 @@ class Cluster:
         self._consolidation_epoch = 0
         self._last_node_deletion = 0.0
         self._last_node_creation = 0.0
+        self._node_deletion_seq = 0  # guards the lock-free node prefetch
         kube.watch("Node", self._on_node_event)
         kube.watch("Pod", self._on_pod_event)
 
@@ -118,6 +119,7 @@ class Cluster:
             if event.type == DELETED:
                 self._nodes.pop(node.name, None)
                 self._last_node_deletion = self.clock.now()
+                self._node_deletion_seq += 1
                 self._bump_epoch()
                 return
             self._update_node(node)
@@ -178,16 +180,26 @@ class Cluster:
         # backend that's a network round trip, so do it BEFORE taking the lock
         # (holding it would serialize all state access on apiserver latency)
         prefetched = _NOT_FETCHED
+        prefetch_seq = -1
         bound_to = pod.spec.node_name or None
         if bound_to is not None and event.type != DELETED and not podutils.is_terminal(pod):
             with self._lock:
                 known = bound_to in self._nodes
+                prefetch_seq = self._node_deletion_seq
             if not known:
                 prefetched = self.kube.get_node(bound_to)
         with self._lock:
             if event.type == DELETED or podutils.is_terminal(pod):
                 self._remove_pod(pod)
                 return
+            # a node DELETED event processed between the prefetch and now
+            # could make the prefetched object resurrect a deleted node
+            # (_update_node would re-insert it with no later event to remove
+            # it — a ghost consolidation/scheduling could target forever);
+            # discard the prefetch and let _update_pod re-fetch under
+            # current state
+            if prefetched is not _NOT_FETCHED and self._node_deletion_seq != prefetch_seq:
+                prefetched = _NOT_FETCHED
             self._update_pod(pod, prefetched)
 
     def _update_pod(self, pod: Pod, prefetched_node=_NOT_FETCHED) -> None:

@@ -20,14 +20,27 @@ log = get_logger("observability")
 
 
 def _handler(routes):
+    import inspect
+    from urllib.parse import parse_qs, urlparse
+
+    # arity decided once at registration: probe/metrics routes are zero-arg,
+    # profiling routes take the parsed query. (Dispatching on TypeError at
+    # call time would re-invoke a side-effectful route whose BODY raised
+    # TypeError — a second live capture.)
+    wants_query = {path: len(inspect.signature(fn).parameters) >= 1 for path, fn in routes.items()}
+
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            route = routes.get(self.path.split("?")[0])
+            url = urlparse(self.path)
+            route = routes.get(url.path)
             if route is None:
                 self.send_error(404)
                 return
             try:
-                ok, content_type, body = route()
+                if wants_query[url.path]:
+                    ok, content_type, body = route(parse_qs(url.query))
+                else:
+                    ok, content_type, body = route()
             except Exception as exc:  # noqa: BLE001 - a probe must answer, not die
                 self.send_error(500, str(exc))
                 return
@@ -55,6 +68,7 @@ class ObservabilityServer:
         metrics_port: Optional[int],
         host: str = "0.0.0.0",
         registry=REGISTRY,
+        extra_routes=None,
     ):
         def probe(fn, label):
             def route():
@@ -75,7 +89,12 @@ class ObservabilityServer:
                 ThreadingHTTPServer((host, health_port), _handler({"/healthz": probe(healthy, "liveness"), "/readyz": probe(ready, "readiness")}))
             )
         if metrics_port is not None and metrics_port >= 0:
-            self._servers.append(ThreadingHTTPServer((host, metrics_port), _handler({"/metrics": metrics_route})))
+            # extra routes (e.g. the live profiling endpoints behind
+            # --enable-profiling) share the metrics listener, the reference's
+            # AddMetricsExtraHandler seam (controllers.go:183-202)
+            metrics_routes = {"/metrics": metrics_route}
+            metrics_routes.update(extra_routes or {})
+            self._servers.append(ThreadingHTTPServer((host, metrics_port), _handler(metrics_routes)))
 
     @property
     def ports(self) -> List[int]:

@@ -92,3 +92,110 @@ def maybe_profile_round(enabled: bool, tag: str = "round") -> Iterator[None]:
     with host_profile(directory / f"{stamp}.prof"):
         with device_trace(directory / f"{stamp}-device"):
             yield
+
+
+class LiveProfiler:
+    """On-demand profiling over the metrics port — the live half of the
+    pprof analog (controllers.go:183-202 serves /debug/pprof/* behind
+    --enable-profiling). Per-round artifacts (maybe_profile_round) cover
+    offline analysis; these routes profile a RUNNING process, so a live
+    latency regression can be inspected without a restart:
+
+      /debug/pprof/            index
+      /debug/pprof/profile     ?seconds=N (default 1, cap 60): statistical
+                               wall-clock sampler over sys._current_frames()
+                               across ALL threads; returns collapsed-stack
+                               text (flamegraph.pl / speedscope compatible)
+      /debug/pprof/heap        tracemalloc top allocations (tracing starts
+                               on the first call; the first response is the
+                               baseline)
+      /debug/pprof/trace       ?seconds=N: JAX/XLA device trace written
+                               under the profile dir; returns the path
+
+    One profile/trace at a time (a lock rejects concurrent captures), and
+    the sampler excludes its own serving thread.
+    """
+
+    MAX_SECONDS = 60.0
+    SAMPLE_INTERVAL = 0.005
+
+    def __init__(self, directory: Optional[os.PathLike] = None):
+        import threading
+
+        self._capture_lock = threading.Lock()
+        self._dir = Path(directory) if directory else (profile_dir() or Path("profiles"))
+
+    def routes(self) -> dict:
+        return {
+            "/debug/pprof/": self.index,
+            "/debug/pprof/profile": self.profile,
+            "/debug/pprof/heap": self.heap,
+            "/debug/pprof/trace": self.trace,
+        }
+
+    @staticmethod
+    def _seconds(query: dict, default: float = 1.0) -> float:
+        try:
+            value = float(query.get("seconds", [default])[0])
+        except (TypeError, ValueError):
+            value = default
+        return max(0.05, min(value, LiveProfiler.MAX_SECONDS))
+
+    def index(self, query=None):
+        body = "live profiling endpoints:\n  /debug/pprof/profile?seconds=N\n  /debug/pprof/heap\n  /debug/pprof/trace?seconds=N\n"
+        return True, "text/plain; charset=utf-8", body
+
+    def profile(self, query=None):
+        import sys
+        import threading
+
+        if not self._capture_lock.acquire(blocking=False):
+            return False, "text/plain; charset=utf-8", "a capture is already running\n"
+        try:
+            seconds = self._seconds(query or {})
+            me = threading.get_ident()
+            samples: dict = {}
+            total = 0
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = []
+                    f = frame
+                    while f is not None and len(stack) < 64:
+                        code = f.f_code
+                        stack.append(f"{Path(code.co_filename).name}:{code.co_name}")
+                        f = f.f_back
+                    key = tuple(reversed(stack))
+                    samples[key] = samples.get(key, 0) + 1
+                total += 1
+                time.sleep(self.SAMPLE_INTERVAL)
+            lines = [f"{';'.join(stack)} {n}" for stack, n in sorted(samples.items(), key=lambda kv: -kv[1])]
+            header = f"# wall-clock samples over {seconds:.2f}s ({total} sweeps, {self.SAMPLE_INTERVAL * 1000:.0f}ms interval), collapsed-stack format\n"
+            return True, "text/plain; charset=utf-8", header + "\n".join(lines) + "\n"
+        finally:
+            self._capture_lock.release()
+
+    def heap(self, query=None):
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return True, "text/plain; charset=utf-8", "tracemalloc started; this response is the baseline — call again for allocations\n"
+        snapshot = tracemalloc.take_snapshot()
+        stats = snapshot.statistics("lineno")[:30]
+        lines = [f"{stat.size / 1024:.1f} KiB in {stat.count} blocks: {stat.traceback}" for stat in stats]
+        return True, "text/plain; charset=utf-8", "\n".join(lines) + "\n"
+
+    def trace(self, query=None):
+        if not self._capture_lock.acquire(blocking=False):
+            return False, "text/plain; charset=utf-8", "a capture is already running\n"
+        try:
+            seconds = self._seconds(query or {})
+            out = self._dir / f"live-trace-{time.strftime('%Y%m%d-%H%M%S')}"
+            with device_trace(out):
+                time.sleep(seconds)
+            return True, "text/plain; charset=utf-8", f"device trace written to {out}\n"
+        finally:
+            self._capture_lock.release()

@@ -84,7 +84,12 @@ class Runtime:
         if self.dense_solver is None and self.options.dense_solver_enabled:
             from .solver import DenseSolver
 
-            self.dense_solver = DenseSolver(min_batch=self.options.dense_min_batch)
+            min_batch = self.options.dense_min_batch
+            if min_batch <= 0:  # auto: measure the dispatch round trip once
+                from .solver.dense import measure_dense_crossover
+
+                min_batch = measure_dense_crossover()
+            self.dense_solver = DenseSolver(min_batch=min_batch)
         remote_solver = None
         if self.options.solver_service_address:
             from .service.client import SolverClient

@@ -117,17 +117,6 @@ class TopologyGroup:
             self.domains[domain] = self.domains.get(domain, 0) + count
             self._zero_domains.discard(domain)
 
-    def unrecord(self, *domains: str, count: int = 1) -> None:
-        """Inverse of record — retracts counts placed as fill-time
-        scaffolding (the dense solver's reservation ledger, solver/dense.py
-        _fill_existing), restoring the zero-domain index when a domain
-        returns to zero."""
-        for domain in domains:
-            left = self.domains.get(domain, 0) - count
-            self.domains[domain] = left
-            if left == 0:
-                self._zero_domains.add(domain)
-
     def register(self, *domains: str) -> None:
         for domain in domains:
             if self.domains.setdefault(domain, 0) == 0:

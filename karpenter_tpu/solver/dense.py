@@ -65,6 +65,68 @@ _PAD = 128  # pad the pod axis to multiples of this for compile caching
 # canonical in utils/options.py so every routing site shares one number.
 from ..utils.options import DENSE_MIN_BATCH_DEFAULT as MIN_BATCH_DEFAULT  # noqa: E402
 
+# Host-loop throughput calibration for the measured crossover: the exact
+# loop schedules ~4k pods/sec on the reference sweep (100 pods: 26ms, 300:
+# 73ms — the r3 measurement on DenseSolver.__init__), and unlike the device
+# round trip it does not vary with the deployment's device link.
+HOST_SECONDS_PER_POD = 2.5e-4
+CROSSOVER_FLOOR = 64
+CROSSOVER_CEILING = 2048
+
+
+def measure_dense_crossover(
+    trials: int = 3,
+    dispatch=None,
+    host_seconds_per_pod: float = HOST_SECONDS_PER_POD,
+    floor: int = CROSSOVER_FLOOR,
+    ceiling: int = CROSSOVER_CEILING,
+) -> int:
+    """Measure the device dispatch round trip and derive the batch size
+    below which the exact host loop is the faster scheduler.
+
+    The dense path's fixed cost is dispatch latency, not compute — a local
+    chip answers in ~1 ms where a tunneled one takes 90-180 ms — so a baked
+    crossover constant is wrong on every deployment but the one it was
+    measured on. At startup (Runtime with dense_min_batch=0, bench sweep)
+    this times the SAME jitted op the solver dispatches (compile excluded:
+    one warmup call, then min over `trials`) and returns
+    round_trip / host_seconds_per_pod clamped to [floor, ceiling]. Any
+    measurement failure falls back to the calibrated default — routing must
+    never break startup. `dispatch` is injectable so tests can prove the
+    constant adapts to a simulated slow link."""
+    if dispatch is None:
+
+        def dispatch():
+            import jax.numpy as jnp
+
+            from ..ops.feasibility import bucket_type_cost_packed
+
+            stats = jnp.asarray(np.ones((2, 8, 4), np.float32))
+            caps = jnp.asarray(np.full((32, 4), 8.0, np.float32))
+            prices = jnp.asarray(np.ones((32,), np.float32))
+            allowed = jnp.asarray(np.ones((8, 32), bool))
+            np.asarray(bucket_type_cost_packed(stats, caps, prices, allowed))
+
+    try:
+        dispatch()  # compile + cache warmup, excluded from the measurement
+        round_trip = min(_timed(dispatch) for _ in range(max(1, trials)))
+    except Exception as exc:  # noqa: BLE001 - measurement must never break startup
+        log.warning("dense crossover measurement failed (%s); using default %d", exc, MIN_BATCH_DEFAULT)
+        return MIN_BATCH_DEFAULT
+    crossover = int(round_trip / host_seconds_per_pod)
+    measured = max(floor, min(ceiling, crossover))
+    log.info(
+        "measured dense routing crossover: dispatch rt %.1f ms -> min_batch %d (default %d)",
+        round_trip * 1000, measured, MIN_BATCH_DEFAULT,
+    )
+    return measured
+
+
+def _timed(fn) -> float:
+    start = time.perf_counter()
+    fn()
+    return time.perf_counter() - start
+
 
 def _preview_type_cost(bucket_stats: np.ndarray, caps: np.ndarray, prices: np.ndarray, allowed: np.ndarray):
     """Host preview of ops/feasibility.py:bucket_type_cost — same formula,

@@ -30,8 +30,10 @@ class Options:
     batch_idle_duration: float = 1.0
     dense_solver_enabled: bool = True
     # below this batch size the exact host loop is faster and cheaper than a
-    # device dispatch (measured crossover ~350 pods; see solver/dense.py)
-    dense_min_batch: int = DENSE_MIN_BATCH_DEFAULT
+    # device dispatch. 0 (the default) = measure the dispatch round trip at
+    # startup and derive the crossover for THIS deployment's device link
+    # (solver/dense.py measure_dense_crossover); a positive value pins it
+    dense_min_batch: int = 0
     cluster_name: str = ""
     log_level: str = "info"
     # period of the leader-only pricing refresh loop (pricing.go:76-393 runs

@@ -58,6 +58,64 @@ class TestObservabilityServer:
         server.start()
         server.stop()
 
+    def test_live_profiling_endpoints(self, tmp_path):
+        """The pprof analog behind --enable-profiling
+        (controllers.go:183-202): an on-demand profile of the RUNNING
+        process over the metrics port must catch a busy thread in the act,
+        and the routes must be absent when profiling is off."""
+        import threading
+        import time as _time
+
+        from karpenter_tpu.profiling import LiveProfiler
+
+        registry = Registry()
+        server = ObservabilityServer(
+            healthy=lambda: True,
+            ready=lambda: True,
+            health_port=None,
+            metrics_port=0,
+            host="127.0.0.1",
+            registry=registry,
+            extra_routes=LiveProfiler(tmp_path).routes(),
+        )
+        server.start()
+        (port,) = server.ports
+        stop = threading.Event()
+
+        def busy_spin_marker():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+
+        worker = threading.Thread(target=busy_spin_marker, daemon=True)
+        worker.start()
+        try:
+            code, body = self._get(port, "/debug/pprof/")
+            assert code == 200 and "profile" in body
+            code, body = self._get(port, "/debug/pprof/profile?seconds=0.3")
+            assert code == 200
+            assert "busy_spin_marker" in body, f"sampler missed the busy thread: {body[:400]}"
+            assert "collapsed-stack" in body
+            code, body = self._get(port, "/debug/pprof/heap")
+            assert code == 200  # first call starts tracing (baseline)
+            code, body = self._get(port, "/debug/pprof/heap")
+            assert code == 200 and "KiB" in body
+        finally:
+            stop.set()
+            worker.join(timeout=2)
+            server.stop()
+
+    def test_profiling_routes_absent_by_default(self):
+        registry = Registry()
+        server = ObservabilityServer(
+            healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=0, host="127.0.0.1", registry=registry
+        )
+        server.start()
+        (port,) = server.ports
+        try:
+            assert self._get(port, "/debug/pprof/profile")[0] == 404
+        finally:
+            server.stop()
+
 
 class TestWebhookSelfRegistration:
     def test_registration_completes_applied_configurations(self):

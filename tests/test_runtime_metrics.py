@@ -147,6 +147,46 @@ class TestScrapers:
         assert "karpenter_provisioner_usage" in text
         assert "karpenter_provisioner_limit" in text
 
+    def test_pod_state_carries_reference_dimensionality(self):
+        """The reference's full label set (pod/controller.go:41-97): name,
+        namespace, owner, node, provisioner, zone, arch, capacity_type,
+        instance_type, phase — owner as the synthesized selflink, node-
+        derived labels N/A for unscheduled pods with the provisioner falling
+        back to the pod's nodeSelector."""
+        from karpenter_tpu.api import labels as lbl
+        from karpenter_tpu.api.objects import OwnerReference
+        from karpenter_tpu.controllers.metrics import PodMetricsController
+        from karpenter_tpu.controllers.metrics.pod import LABEL_NAMES
+
+        registry = Registry()
+        runtime, clock = make_runtime()
+        runtime.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "1"})
+        pod.metadata.owner_references.append(
+            OwnerReference(kind="ReplicaSet", name="web-rs", api_version="apps/v1")
+        )
+        runtime.kube.create(pod)
+        unscheduled = make_pod(node_selector={lbl.PROVISIONER_NAME_LABEL: "special"})
+        runtime.kube.create(unscheduled)
+        runtime.provision_once()
+        runtime.kube.bind_pod(pod, runtime.kube.list_nodes()[0].metadata.name)
+
+        pod_metrics = PodMetricsController(runtime.kube, registry)
+        pod_metrics.scrape()
+        text = registry.export_text()
+        scheduled_line = next(l for l in text.splitlines() if pod.metadata.name in l and "pods_state" in l)
+        for name in LABEL_NAMES:
+            assert f'{name}="' in scheduled_line, f"missing label {name}: {scheduled_line}"
+        assert 'owner="/apis/apps/v1/namespaces/default/replicasets/web-rs"' in scheduled_line
+        node = runtime.kube.list_nodes()[0]
+        assert f'zone="{node.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE]}"' in scheduled_line
+        assert f'instance_type="{node.metadata.labels[lbl.LABEL_INSTANCE_TYPE]}"' in scheduled_line
+        unscheduled_line = next(
+            l for l in text.splitlines() if unscheduled.metadata.name in l and "pods_state" in l
+        )
+        assert 'zone="N/A"' in unscheduled_line and 'instance_type="N/A"' in unscheduled_line
+        assert 'provisioner="special"' in unscheduled_line
+
 
 class TestOptions:
     def test_parse_defaults(self):
