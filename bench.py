@@ -243,14 +243,29 @@ def run_once(pods, provider, provisioners, solver, state_nodes=()):
     return elapsed, scheduled, len(results.new_nodes), cost, solver.stats, stats_line
 
 
-def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS):
+# per-config phase breakdown (encode/fill/device/commit medians, warm-fill
+# routing, node-guard counters), keyed by the BASELINE config name and
+# emitted in the JSON line — so stage-level drift is attributable from the
+# parsed artifact without rerunning by hand (VERDICT r5 hygiene ask)
+PHASE_BREAKDOWN: dict = {}
+
+
+def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS, phase_key=None):
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
     times = []
+    phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "commit", "fill_device")}
+    last_stats = None
     for _ in range(trials):
         elapsed, scheduled, nodes, cost, stats, packing = run_once(
             pods, provider, provisioners, solver, state_nodes
         )
         times.append(elapsed)
+        last_stats = stats
+        phase_trials["encode"].append(stats.encode_seconds)
+        phase_trials["fill"].append(stats.fill_seconds)
+        phase_trials["device"].append(stats.device_seconds)
+        phase_trials["commit"].append(stats.commit_seconds)
+        phase_trials["fill_device"].append(stats.fill_device_seconds)
         log(
             f"  [{name}] trial {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f}"
             f" fill {stats.fill_seconds*1000:.0f} device {stats.device_seconds*1000:.0f}"
@@ -259,6 +274,15 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         )
         if scheduled < len(pods) * 0.99:
             log(f"  [{name}] WARNING: only {scheduled}/{len(pods)} pods scheduled")
+    if phase_key is not None and last_stats is not None:
+        PHASE_BREAKDOWN[phase_key] = {
+            **{k: round(float(np.median(v)) * 1000, 2) for k, v in phase_trials.items()},
+            "fills_vectorized": last_stats.fills_vectorized,
+            "fills_host": last_stats.fills_host,
+            "nodes_opened_dense": last_stats.nodes_opened_dense,
+            "nodes_opened_host_floor": last_stats.nodes_opened_host_floor,
+            "node_guard_failopens": last_stats.node_guard_failopens,
+        }
     if PROFILE_DIR:
         profile_config(name, pods, provider, provisioners, solver, state_nodes)
     return float(np.median(times) * 1000), times
@@ -307,6 +331,93 @@ def measure_cost_regret() -> float:
     return round(regret, 4)
 
 
+def smoke() -> dict:
+    """Structural perf-path assertions on scaled-down BASELINE configs — no
+    wall-clock gates, so it runs green on CPU in tier-1 (tests/
+    test_bench_smoke.py) and catches perf-path breakage (dense path not
+    engaging, warm fill falling back to the host loop, node-count guard
+    tripping, device column gone) without timing flakes.
+
+    Asserts per config: every pod scheduled; the dense path committed
+    (cold configs) or the vectorized warm fill engaged with nonzero device
+    time (repack config); the node-guard never tripped and the dense node
+    count stayed within the guard ratio of the host floor."""
+    from karpenter_tpu.api.objects import Taint
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+    from karpenter_tpu.solver import DenseSolver
+    from tests.helpers import make_pod, make_provisioner
+
+    summary: dict = {}
+
+    def check(name, pods, provider, provisioners, state_nodes=(), repack=False):
+        solver = DenseSolver(min_batch=1)
+        elapsed, scheduled, nodes, cost, stats, _packing = run_once(
+            pods, provider, provisioners, solver, state_nodes
+        )
+        assert scheduled == len(pods), f"[{name}] scheduled {scheduled}/{len(pods)}"
+        assert stats.node_guard_failopens == 0, f"[{name}] node guard tripped"
+        if stats.nodes_opened_host_floor:
+            ratio = stats.nodes_opened_dense / stats.nodes_opened_host_floor
+            assert (
+                stats.nodes_opened_dense < DenseSolver._NODE_GUARD_MIN_NODES
+                or ratio <= DenseSolver._NODE_GUARD_RATIO
+            ), f"[{name}] node-count ratio {ratio:.2f} over guard"
+        if repack:
+            assert stats.fills_vectorized >= 1, f"[{name}] warm fill fell back to host loop"
+            assert stats.fill_device_seconds > 0, f"[{name}] no device work in the fill"
+        else:
+            assert stats.pods_committed > 0, f"[{name}] dense path never committed"
+        summary[name] = {
+            "pods": len(pods),
+            "nodes": nodes,
+            "dense_committed": stats.pods_committed,
+            "fills_vectorized": stats.fills_vectorized,
+            "nodes_opened_dense": stats.nodes_opened_dense,
+            "nodes_opened_host_floor": stats.nodes_opened_host_floor,
+        }
+        log(f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes)")
+
+    log("smoke: anti_spread (headline shape, scaled)")
+    check("anti_spread", build_workload(700, seed=42), FakeCloudProvider(instance_types(100)), [make_provisioner()])
+
+    log("smoke: ffd_parity")
+    check(
+        "ffd_parity",
+        [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(300)],
+        FakeCloudProvider(instance_types(50)),
+        [make_provisioner()],
+    )
+
+    log("smoke: selectors_taints")
+    check(
+        "selectors_taints",
+        build_selectors_taints_workload(400),
+        FakeCloudProvider(instance_types(100)),
+        [make_provisioner(taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")])],
+    )
+
+    log("smoke: repack (warm fill)")
+    check(
+        "repack",
+        build_workload(600, seed=3),
+        FakeCloudProvider(instance_types(60)),
+        [make_provisioner()],
+        state_nodes=build_repack_state(90),
+        repack=True,
+    )
+
+    log("smoke: spot_od_multiprov")
+    check(
+        "spot_od",
+        build_workload(500, seed=5),
+        FakeCloudProvider(build_spot_od_types(100)),
+        [make_provisioner(name="spot", weight=10), make_provisioner(name="on-demand", weight=1)],
+    )
+
+    summary["ok"] = True
+    return summary
+
+
 def main() -> None:
     from karpenter_tpu.api.objects import Taint
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
@@ -328,7 +439,7 @@ def main() -> None:
     pods = build_workload(HEADLINE_PODS)
     headline_ms, _ = run_config(
         "headline_10k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
-        trials=HEADLINE_TRIALS,
+        trials=HEADLINE_TRIALS, phase_key="anti_spread_10k_x_500",
     )
     configs["anti_spread_10k_x_500"] = round(headline_ms, 1)
     del pods
@@ -340,7 +451,7 @@ def main() -> None:
 
     provider = FakeCloudProvider(instance_types(50))
     pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(1000)]
-    ms, _ = run_config("ffd_1k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1))
+    ms, _ = run_config("ffd_1k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1), phase_key="ffd_parity_1k_x_50")
     configs["ffd_parity_1k_x_50"] = round(ms, 1)
     del pods
     gc.collect()
@@ -350,7 +461,7 @@ def main() -> None:
     provider = FakeCloudProvider(instance_types(500))
     pods = build_selectors_taints_workload(5000)
     tainted = make_provisioner(taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")])
-    ms, _ = run_config("sel_taints_5k", pods, provider, [tainted], DenseSolver(min_batch=1))
+    ms, _ = run_config("sel_taints_5k", pods, provider, [tainted], DenseSolver(min_batch=1), phase_key="selectors_taints_5k_x_500")
     configs["selectors_taints_5k_x_500"] = round(ms, 1)
     del pods
     gc.collect()
@@ -362,7 +473,7 @@ def main() -> None:
     state_nodes = build_repack_state(300)
     ms, _ = run_config(
         "repack_2k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
-        state_nodes=state_nodes,
+        state_nodes=state_nodes, phase_key="repack_2k_x_300",
     )
     configs["repack_2k_x_300"] = round(ms, 1)
     del pods, state_nodes
@@ -378,7 +489,7 @@ def main() -> None:
     state_nodes = build_repack_state(2400)
     ms, _ = run_config(
         "repack_16k", pods, provider, [make_provisioner()], DenseSolver(min_batch=1),
-        state_nodes=state_nodes, trials=SIDE_TRIALS,
+        state_nodes=state_nodes, trials=SIDE_TRIALS, phase_key="repack_16k_x_2400",
     )
     configs["repack_16k_x_2400"] = round(ms, 1)
     del pods, state_nodes
@@ -390,7 +501,7 @@ def main() -> None:
     pods = build_workload(5000, seed=5)
     spot = make_provisioner(name="spot", weight=10)
     od = make_provisioner(name="on-demand", weight=1)
-    ms, _ = run_config("spot_od_5k", pods, provider, [spot, od], DenseSolver(min_batch=1))
+    ms, _ = run_config("spot_od_5k", pods, provider, [spot, od], DenseSolver(min_batch=1), phase_key="spot_od_multiprov_x_500")
     configs["spot_od_multiprov_x_500"] = round(ms, 1)
     del pods
     gc.collect()
@@ -441,6 +552,7 @@ def main() -> None:
                 "vs_baseline": round(baseline_ms / headline_ms, 1),
                 "configs": configs,
                 "pods_per_sec_sweep": sweep,
+                "phases": PHASE_BREAKDOWN,
                 "cost_regret_vs_ilp": regret,
             }
         )
@@ -448,6 +560,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        print(json.dumps(smoke()))
+        sys.exit(0)
     if "--profile" in sys.argv:
         i = sys.argv.index("--profile")
         PROFILE_DIR = (

