@@ -253,7 +253,7 @@ PHASE_BREAKDOWN: dict = {}
 def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS, phase_key=None):
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
     times = []
-    phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "commit", "fill_device")}
+    phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "assemble", "commit", "fill_device")}
     last_stats = None
     for _ in range(trials):
         elapsed, scheduled, nodes, cost, stats, packing = run_once(
@@ -264,6 +264,9 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         phase_trials["encode"].append(stats.encode_seconds)
         phase_trials["fill"].append(stats.fill_seconds)
         phase_trials["device"].append(stats.device_seconds)
+        # host work overlapped with the device RT: splits device-link time
+        # from host assembly when attributing headline drift
+        phase_trials["assemble"].append(stats.assemble_seconds)
         phase_trials["commit"].append(stats.commit_seconds)
         phase_trials["fill_device"].append(stats.fill_device_seconds)
         log(
@@ -279,6 +282,8 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
             **{k: round(float(np.median(v)) * 1000, 2) for k, v in phase_trials.items()},
             "fills_vectorized": last_stats.fills_vectorized,
             "fills_host": last_stats.fills_host,
+            "fill_pods_vectorized": last_stats.fill_pods_vectorized,
+            "fill_pods_host": last_stats.fill_pods_host,
             "nodes_opened_dense": last_stats.nodes_opened_dense,
             "nodes_opened_host_floor": last_stats.nodes_opened_host_floor,
             "node_guard_failopens": last_stats.node_guard_failopens,
@@ -372,6 +377,8 @@ def smoke() -> dict:
             "nodes": nodes,
             "dense_committed": stats.pods_committed,
             "fills_vectorized": stats.fills_vectorized,
+            "fill_pods_vectorized": stats.fill_pods_vectorized,
+            "fill_pods_host": stats.fill_pods_host,
             "nodes_opened_dense": stats.nodes_opened_dense,
             "nodes_opened_host_floor": stats.nodes_opened_host_floor,
         }
@@ -413,6 +420,31 @@ def smoke() -> dict:
         FakeCloudProvider(build_spot_od_types(100)),
         [make_provisioner(name="spot", weight=10), make_provisioner(name="on-demand", weight=1)],
     )
+
+    # the repack shape's fill stream must be fully vectorized (the certified
+    # common case, now including single-extra-rule affinity cohorts): a
+    # nonzero host-routed pod count here means a plan() fail-open regressed
+    assert summary["repack"]["fill_pods_vectorized"] >= 1, "[repack] no pods through the vectorized fill"
+
+    log("smoke: interruption queue counters")
+    from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clk = FakeClock()
+    backend = CloudBackend(clock=clk)
+    queue = backend.notifications
+    queue.send({"kind": "rebalance_recommendation", "instance_id": "i-smoke"})
+    queue.send({"malformed": True})
+    received = queue.receive_messages(max_messages=10)
+    assert len(received) == 2, "queue must deliver both messages"
+    assert queue.delete_message(received[0].receipt_handle), "fresh receipt handle must delete"
+    for _ in range(backend.notifications.max_receive_count):
+        clk.step(queue.visibility_timeout + 1)
+        queue.receive_messages(max_messages=10)
+    attrs = queue.attributes()
+    assert attrs["dead_letter_depth"] == 1, "undeleted payload must dead-letter after the redrive threshold"
+    assert attrs["depth"] == 0
+    summary["interruption_queue"] = attrs
 
     summary["ok"] = True
     return summary

@@ -32,6 +32,9 @@ TERMINATION_FINALIZER = GROUP + "/termination"
 TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
 TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+# applied by the interruption controller on an interruption notice; paired
+# with spec.unschedulable so drains and the scheduler both see the cordon
+TAINT_INTERRUPTION = GROUP + "/interruption"
 
 ARCHITECTURE_AMD64 = "amd64"
 ARCHITECTURE_ARM64 = "arm64"

@@ -19,6 +19,9 @@ Protocol (all JSON; bearer-token auth on every route):
   POST   /v1/fleet                                       CreateFleet
   GET    /v1/instances/{id}                              liveness probe
   DELETE /v1/instances/{id}                              terminate
+  POST   /v1/queue/receive                               ReceiveMessage (long-poll)
+  DELETE /v1/queue/messages/{receipt-handle}             DeleteMessage
+  GET    /v1/queue/attributes                            queue depth/dead-letter stats
 
 Error taxonomy is structured, not stringly: a failed CreateFleet returns
 {"error": {"code": "insufficient_capacity", "pools": [...]}} or
@@ -265,6 +268,32 @@ class CloudAPIService:
             if method == "DELETE":
                 be.terminate_instance(parts[2])
                 return 200, {}
+        if parts[:2] == ["v1", "queue"]:
+            queue = be.notifications
+            if parts[2:] == ["receive"] and method == "POST":
+                # long-poll ReceiveMessage: wait_seconds is capped below the
+                # client's transport timeout so a patient poll never reads
+                # as a dead connection
+                messages = queue.receive_messages(
+                    max_messages=int(body.get("max_messages", 10)),
+                    wait_seconds=min(float(body.get("wait_seconds", 0.0)), 5.0),
+                    visibility_timeout=body.get("visibility_timeout"),
+                )
+                return 200, {
+                    "messages": [
+                        {
+                            "message_id": m.message_id,
+                            "receipt_handle": m.receipt_handle,
+                            "receive_count": m.receive_count,
+                            "body": m.body,
+                        }
+                        for m in messages
+                    ]
+                }
+            if parts[2:3] == ["messages"] and len(parts) == 4 and method == "DELETE":
+                return 200, {"deleted": queue.delete_message(parts[3])}
+            if parts[2:] == ["attributes"] and method == "GET":
+                return 200, queue.attributes()
         raise _NotFound("/".join(parts))
 
 

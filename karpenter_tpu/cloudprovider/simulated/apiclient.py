@@ -252,6 +252,39 @@ class CloudAPIClient:
         except _RemoteNotFound:
             return False
 
+    # -- notification queue (notifications.py over the wire) -----------------
+
+    def receive_messages(self, max_messages: int = 10, wait_seconds: float = 0.0, visibility_timeout=None):
+        """ReceiveMessage long-poll. Duck-types NotificationQueue so the
+        interruption controller is transport-agnostic. The service caps the
+        server-side wait at 5s (below the transport timeout); longer waits
+        are the caller's loop."""
+        from .notifications import ReceivedMessage
+
+        body = {"max_messages": max_messages, "wait_seconds": wait_seconds}
+        if visibility_timeout is not None:
+            body["visibility_timeout"] = visibility_timeout
+        page = self._call("POST", "/v1/queue/receive", body)
+        return [
+            ReceivedMessage(
+                message_id=m["message_id"],
+                receipt_handle=m["receipt_handle"],
+                receive_count=int(m.get("receive_count", 1)),
+                body=dict(m.get("body", {})),
+            )
+            for m in page.get("messages", [])
+        ]
+
+    def delete_message(self, receipt_handle: str) -> bool:
+        page = self._call("DELETE", f"/v1/queue/messages/{quote(receipt_handle)}")
+        return bool(page.get("deleted"))
+
+    def queue_attributes(self) -> dict:
+        return self._call("GET", "/v1/queue/attributes")
+
+    def dead_letter_depth(self) -> int:
+        return int(self.queue_attributes().get("dead_letter_depth", 0))
+
 
 class _RemoteNotFound(RuntimeError):
     pass

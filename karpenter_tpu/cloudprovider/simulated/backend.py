@@ -124,9 +124,18 @@ def default_catalog() -> List[InstanceTypeInfo]:
 class CloudBackend:
     def __init__(self, catalog: Optional[List[InstanceTypeInfo]] = None, zones: Sequence[str] = ("zone-a", "zone-b", "zone-c"), clock=None):
         from ...utils.clock import Clock
+        from .notifications import NotificationQueue
 
         self.clock = clock or Clock()
         self._lock = threading.Lock()
+        # the SQS-analog interruption feed (notifications.py): every
+        # lifecycle event below lands here; consumers poll it in-process or
+        # over the HTTP transport (api.py /v1/queue routes)
+        self.notifications = NotificationQueue(clock=self.clock)
+        # spot reclaims in flight: instance_id -> reclaim deadline; the
+        # instance dies (instance_terminated notification) once the sim
+        # clock passes the deadline and reclaim_due_instances() runs
+        self.pending_reclaims: Dict[str, float] = {}
         self.catalog = catalog if catalog is not None else default_catalog()
         self.subnets = [
             Subnet(subnet_id=f"subnet-{z}", zone=z, available_ip_count=1000 + 100 * i, tags={"discovery": "cluster"})
@@ -265,11 +274,66 @@ class CloudBackend:
     def terminate_instance(self, instance_id: str) -> None:
         with self._lock:
             self.terminate_calls.append(instance_id)
-            self.instances.pop(instance_id, None)
+            existed = self.instances.pop(instance_id, None) is not None
+            self.pending_reclaims.pop(instance_id, None)
+        if existed:
+            self.notifications.send({"kind": "instance_terminated", "instance_id": instance_id})
 
     def instance_exists(self, instance_id: str) -> bool:
         with self._lock:
             return instance_id in self.instances
+
+    # -- lifecycle notifications (the EventBridge-rule analogs) --------------
+    # Fault-injection seams: tests and chaos drivers call these to make the
+    # cloud misbehave; each feeds the notification queue the way EventBridge
+    # feeds the reference's SQS queue.
+
+    def interrupt_spot_instance(self, instance_id: str, warning_seconds: float = None) -> Optional[float]:
+        """Issue a spot interruption warning: the instance will be reclaimed
+        `warning_seconds` (default: the EC2 2-minute lead) from now. Returns
+        the absolute deadline, or None for an unknown instance — though a
+        notice for an unknown id can still be forced onto the queue with
+        notifications.send() (the consumer must tolerate it)."""
+        from .notifications import SPOT_INTERRUPTION_WARNING
+
+        if warning_seconds is None:
+            warning_seconds = SPOT_INTERRUPTION_WARNING
+        with self._lock:
+            if instance_id not in self.instances:
+                return None
+            deadline = self.clock.now() + warning_seconds
+            self.pending_reclaims[instance_id] = deadline
+        self.notifications.send({"kind": "spot_interruption", "instance_id": instance_id, "deadline": deadline})
+        return deadline
+
+    def recommend_rebalance(self, instance_id: str) -> None:
+        """EC2 rebalance recommendation: elevated reclaim risk, no deadline."""
+        self.notifications.send({"kind": "rebalance_recommendation", "instance_id": instance_id})
+
+    def schedule_maintenance(self, instance_id: str, not_before_seconds: float = 600.0) -> float:
+        """Scheduled maintenance (the scheduled-change health event analog)."""
+        not_before = self.clock.now() + not_before_seconds
+        self.notifications.send({"kind": "scheduled_maintenance", "instance_id": instance_id, "not_before": not_before})
+        return not_before
+
+    def stop_instance(self, instance_id: str) -> None:
+        """Stop an instance out from under its node (state-change event)."""
+        with self._lock:
+            existed = self.instances.pop(instance_id, None) is not None
+            self.pending_reclaims.pop(instance_id, None)
+        if existed:
+            self.notifications.send({"kind": "instance_stopped", "instance_id": instance_id})
+
+    def reclaim_due_instances(self) -> List[str]:
+        """Reclaim every spot instance whose interruption deadline has
+        passed (the cloud making good on its warnings). Returns the ids
+        reclaimed; each emits instance_terminated via terminate_instance."""
+        with self._lock:
+            now = self.clock.now()
+            due = [i for i, deadline in self.pending_reclaims.items() if deadline <= now]
+        for instance_id in due:
+            self.terminate_instance(instance_id)
+        return due
 
     def reset(self) -> None:
         with self._lock:

@@ -180,6 +180,13 @@ class SimulatedCloudProvider(CloudProvider):
     def name(self) -> str:
         return "simulated"
 
+    def notification_source(self):
+        """The interruption feed for this cloud: the backend's in-process
+        NotificationQueue, or the CloudAPIClient itself on the HTTP
+        transport (it duck-types receive_messages/delete_message/
+        dead_letter_depth over /v1/queue)."""
+        return getattr(self.backend, "notifications", self.backend)
+
     def refresh_pricing(self) -> bool:
         """One pricing-refresh tick (the synchronous core of the reference's
         async OD/spot updaters, pricing.go:76-393): re-pull the price books

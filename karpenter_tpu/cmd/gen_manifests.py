@@ -219,13 +219,19 @@ def rbac(namespace: str) -> List[Dict]:
     ]
 
 
-def configmaps(namespace: str) -> List[Dict]:
+def configmaps(namespace: str, interruption_queue: str = "") -> List[Dict]:
+    data = dict(DEFAULT_CONFIGMAP_DATA)
+    if interruption_queue:
+        # settings parity with the reference's aws.interruptionQueueName:
+        # recorded in the global-settings ConfigMap so operators see the
+        # deployed queue name; the boot flag stays authoritative
+        data["interruptionQueueName"] = interruption_queue
     return [
         {
             "apiVersion": "v1",
             "kind": "ConfigMap",
             "metadata": _meta(CONFIGMAP_NAME, namespace, APP_LABELS),
-            "data": dict(DEFAULT_CONFIGMAP_DATA),
+            "data": data,
         }
     ]
 
@@ -239,6 +245,9 @@ def controller_deployment(args) -> Dict:
     ]
     if args.solver_sidecar:
         container_args += ["--solver-service-address", SOLVER_SIDECAR_ADDR]
+    # getattr: embedded callers build bare namespaces without the flag
+    if getattr(args, "interruption_queue", ""):
+        container_args += ["--interruption-queue", args.interruption_queue]
     containers = [
         {
             "name": "controller",
@@ -423,7 +432,7 @@ def render(args) -> List[Dict]:
         crd_nodeclass(),
     ]
     docs += rbac(args.namespace)
-    docs += configmaps(args.namespace)
+    docs += configmaps(args.namespace, interruption_queue=getattr(args, "interruption_queue", ""))
     docs.append(controller_deployment(args))
     docs += webhook_bundle(args)
     docs += stability(args.namespace, args.service_monitor)
@@ -439,6 +448,10 @@ def main(argv=None) -> int:
     parser.add_argument("--solver-sidecar", action="store_true", help="add the gRPC solver sidecar container")
     parser.add_argument("--tpu-resource", default="", help="device resource for the sidecar, e.g. google.com/tpu=1")
     parser.add_argument("--service-monitor", action="store_true", help="emit a prometheus-operator ServiceMonitor")
+    parser.add_argument(
+        "--interruption-queue", dest="interruption_queue", default="",
+        help="cloud interruption queue name: wires --interruption-queue into the controller args and the settings ConfigMap",
+    )
     args = parser.parse_args(argv)
 
     import yaml

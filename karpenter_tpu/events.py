@@ -49,6 +49,24 @@ class Recorder:
     def waiting_on_readiness(self, node) -> None:
         self._record("Node", "WaitingOnReadiness", "Waiting on readiness to continue consolidation", node.name)
 
+    # interruption-subsystem events (controllers/interruption); identical
+    # notices dedupe through DedupeRecorder's TTL window
+    def node_interrupted(self, node, kind: str, message: str) -> None:
+        reasons = {
+            "spot_interruption": "SpotInterrupted",
+            "rebalance_recommendation": "RebalanceRecommended",
+            "scheduled_maintenance": "MaintenanceScheduled",
+            "instance_stopped": "InstanceStopped",
+            "instance_terminated": "InstanceTerminated",
+        }
+        self._record("Node", reasons.get(kind, "Interrupted"), message, node.name)
+
+    def interruption_replacement_launched(self, node, pod_count: int) -> None:
+        self._record(
+            "Node", "InterruptionReplacement",
+            f"Launching replacement capacity for {pod_count} pod(s) ahead of the drain", node.name,
+        )
+
     def of(self, reason: str) -> List[Event]:
         with self._lock:
             return [e for e in self.events if e.reason == reason]

@@ -107,6 +107,26 @@ class Runtime:
         self.consolidation = ConsolidationController(
             self.kube, self.cluster, self.cloud_provider, self.provisioner, self.recorder, clock=self.kube.clock
         )
+        # interruption subsystem: enabled by --interruption-queue against a
+        # provider that exposes a notification source (the metrics decorator
+        # forwards notification_source to the inner provider); the reference
+        # gates its SQS controllers on aws.interruptionQueueName the same way
+        self.interruption = None
+        if self.options.interruption_queue:
+            source_fn = getattr(self.cloud_provider, "notification_source", None)
+            source = source_fn() if source_fn is not None else None
+            if source is None:
+                log.warning(
+                    "--interruption-queue=%s set but provider %s exposes no notification source; disabled",
+                    self.options.interruption_queue, self.cloud_provider.name(),
+                )
+            else:
+                from .controllers.interruption import InterruptionController
+
+                self.interruption = InterruptionController(
+                    self.kube, self.cluster, self.provisioner, source,
+                    termination=self.termination, recorder=self.recorder, clock=self.kube.clock,
+                )
         self.pod_metrics = PodMetricsController(self.kube)
         self.provisioner_metrics = ProvisionerMetricsController(self.kube)
         self.node_metrics = NodeMetricsScraper(self.cluster)
@@ -158,6 +178,10 @@ class Runtime:
         # so followers never reach this spawn — the election gating of the
         # reference's OD/spot price updaters (pricing.go:76-393)
         self._spawn(self._pricing_loop, "pricing-refresh")
+        if self.interruption is not None:
+            # same leader gating: only the leader acts on interruption
+            # notices (two replicas polling would double-provision)
+            self._spawn(self._interruption_loop, "interruption")
 
     def stop(self) -> None:
         self._stop.set()
@@ -194,6 +218,16 @@ class Runtime:
         while not self._stop.wait(timeout=self.options.pricing_refresh_period):
             self.refresh_pricing_once()
 
+    def _interruption_loop(self) -> None:
+        # the receive itself long-polls (wait_seconds) while the transport
+        # is healthy; a failed receive (-1) returns instantly, so THAT path
+        # waits the full poll interval — otherwise an outage hot-spins
+        while not self._stop.is_set():
+            received = self.interruption.poll_once(wait_seconds=self.options.interruption_poll_interval)
+            pause = self.options.interruption_poll_interval if received < 0 else 0.05
+            if received <= 0 and self._stop.wait(timeout=pause):
+                return
+
     def refresh_pricing_once(self) -> bool:
         """One pricing-refresh tick against providers that support it (the
         metrics decorator forwards refresh_pricing to the inner provider;
@@ -212,6 +246,8 @@ class Runtime:
 
     def reconcile_once(self) -> None:
         """One pass of every non-provisioning controller."""
+        if self.interruption is not None:
+            self.interruption.poll_once()
         self.node_controller.reconcile_all()
         self.termination.reconcile_all()
         self.counter.reconcile_all()

@@ -254,11 +254,12 @@ def plan(scheduler, problem: DenseProblem, buckets, extra_pods: Sequence = ()) -
             kind = _AFFINITY if group.kind == GroupKind.AFFINITY else _SPREAD
             if kind == _AFFINITY and aff is None:
                 return None
-            if kind == _AFFINITY and (len(checks) != 1 or checks[0][0] != "aff"):
-                # the bootstrap round (and its closed-form sweep) admits by
-                # zone membership + capacity only; a cohort carrying ANY
-                # other integer rule (inverse anti-affinity, spread) would
-                # skip that rule exactly there — host loop owns these
+            if kind == _AFFINITY and sum(1 for op, _s, _a in checks if op != "aff") > 1:
+                # certified: the aff rule plus AT MOST one extra integer
+                # rule. The bootstrap round enforces the extra through the
+                # same admit()/room_vector algebra the per-pod scans use
+                # (execute()'s bootstrap branch); cohorts stacking several
+                # extra rules still fail open to the host loop wholesale
                 return None
         elif group.kind == GroupKind.PLAIN:
             kind = _PLAIN
@@ -434,7 +435,10 @@ def execute(scheduler, problem: DenseProblem, buckets, plan_: WarmFillPlan, solv
         return int((head[positive] // s[positive]).min())
 
     def admit(spec: _BucketSpec, v: int) -> bool:
-        for op, gs, arg in spec.checks:
+        return admit_checks(spec.checks, v)
+
+    def admit_checks(checks, v: int) -> bool:
+        for op, gs, arg in checks:
             if op == "zero":
                 if gs.counts_v[v] != 0:
                     return False
@@ -708,12 +712,21 @@ def execute(scheduler, problem: DenseProblem, buckets, plan_: WarmFillPlan, solv
                 # accepting view's zone, then the certified run sweeps the
                 # remainder of the run onto it in closed form. At most once
                 # per cohort — populated counts never return to zero.
+                # The certified single extra rule (plan() admits at most
+                # one non-aff check) gates the boot view through the same
+                # admit algebra and caps the sweep by room_vector — both
+                # exact closed forms of the per-pod protocol, so a skipped
+                # view stays skipped (zero/hskew counts and residuals are
+                # monotone) and the remainder falls to the generic scan.
                 gs = spec.aff
+                extras = [c for c in spec.checks if c[0] != "aff"]
                 boot = -1
                 for v in np.flatnonzero(spec.accept_perpod & alive[sid]):
                     v = int(v)
                     if gs.dom_of_view[v] < 0:
                         continue  # zone outside the group: full add vetoes
+                    if extras and not admit_checks(extras, v):
+                        continue  # the extra integer rule vetoes this host
                     if ((req_v[v] + s) > at[v]).any():
                         alive[sid, v] = False
                         continue
@@ -724,6 +737,8 @@ def execute(scheduler, problem: DenseProblem, buckets, plan_: WarmFillPlan, solv
                 place(spec, boot, [rows[i]], s, bulk=False)
                 i += 1
                 n = min(closed_form(boot, s, positive), len(rows) - i)
+                if extras:
+                    n = min(n, int(room_vector(spec)[boot]))
                 if n > 0:
                     place(spec, boot, rows[i : i + n], s, bulk=True)
                     i += n

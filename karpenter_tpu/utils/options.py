@@ -41,6 +41,13 @@ class Options:
     pricing_refresh_period: float = 300.0
     solver_service_address: str = ""  # host:port of the gRPC solver sidecar (empty = in-process)
     solver_service_timeout: float = 30.0
+    # name of the cloud-side interruption queue (the aws.interruptionQueueName
+    # settings analog). Non-empty enables the interruption controller's
+    # leader-gated poll loop against the provider's notification source
+    interruption_queue: str = ""
+    # long-poll wait per receive; the loop re-polls immediately after a
+    # non-empty batch, so this only paces the idle case
+    interruption_poll_interval: float = 2.0
     # URL of a Kubernetes apiserver (http://host:port). Empty = the in-memory
     # simulation backend; set (or KUBERNETES_APISERVER_URL) = the real-protocol
     # HTTP client (kube/client.py) with the QPS/burst budget above
@@ -58,6 +65,8 @@ class Options:
             errs.append("batch durations must satisfy 0 < idle <= max")
         if self.pricing_refresh_period <= 0:
             errs.append("pricing refresh period must be positive")
+        if self.interruption_poll_interval <= 0:
+            errs.append("interruption poll interval must be positive")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -95,6 +104,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--solver-service-address", default=_env("SOLVER_SERVICE_ADDRESS", defaults.solver_service_address))
     parser.add_argument("--solver-service-timeout", type=float, default=_env("SOLVER_SERVICE_TIMEOUT", defaults.solver_service_timeout))
     parser.add_argument("--pricing-refresh-period", type=float, default=_env("PRICING_REFRESH_PERIOD", defaults.pricing_refresh_period))
+    parser.add_argument("--interruption-queue", dest="interruption_queue", default=_env("INTERRUPTION_QUEUE", defaults.interruption_queue))
+    parser.add_argument("--interruption-poll-interval", type=float, default=_env("INTERRUPTION_POLL_INTERVAL", defaults.interruption_poll_interval))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))

@@ -18,8 +18,17 @@ def test_bench_smoke():
     summary = bench.smoke()
     assert summary.pop("ok") is True
     # every config ran and reported its structural counters
+    queue_attrs = summary.pop("interruption_queue")
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od"}
     for name, info in summary.items():
         assert info["pods"] > 0, name
+        # the per-pod fill routing counters are part of the schema
+        assert "fill_pods_vectorized" in info and "fill_pods_host" in info, name
     # the repack shape exercised the vectorized warm fill specifically
     assert summary["repack"]["fills_vectorized"] >= 1
+    assert summary["repack"]["fill_pods_vectorized"] >= 1
+    # the interruption-queue counters are part of the smoke JSON schema
+    assert {"depth", "in_flight", "dead_letter_depth", "sent_total", "deleted_total", "redelivered_total"} <= set(
+        queue_attrs
+    )
+    assert queue_attrs["dead_letter_depth"] == 1

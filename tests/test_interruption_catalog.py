@@ -1,0 +1,407 @@
+"""Interruption suite: queue semantics + controller catalog.
+
+Mirrors the reference interruption-controller suite shapes (SQS-fed spot
+interruption / rebalance / scheduled-change / state-change handling): one
+test per message kind, duplicate-delivery idempotence, unknown-instance
+tolerance, the dead-letter path for malformed payloads, and the deadline
+race — the drain (with replacement capacity pre-provisioned) completes
+before the simulated 2-minute reclaim deadline.
+
+The end-to-end drill runs on BOTH transports: the in-process backend and
+the HTTP CloudAPIService/Client pair (the queue spoken over sockets).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NO_SCHEDULE, NodeCondition, NodeSelectorRequirement, OP_IN, OwnerReference, Taint
+from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend
+from karpenter_tpu.cloudprovider.simulated.notifications import NotificationQueue
+from karpenter_tpu.cloudprovider.simulated.provider import SimulatedCloudProvider
+from karpenter_tpu.controllers.interruption.messages import MessageParseError, parse
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import LeaderElector, Runtime
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_pod, make_provisioner
+
+
+# -- queue semantics ---------------------------------------------------------
+
+
+class TestNotificationQueue:
+    def test_at_least_once_visibility_redelivery(self):
+        clock = FakeClock()
+        queue = NotificationQueue(clock=clock, visibility_timeout=30.0)
+        queue.send({"kind": "rebalance_recommendation", "instance_id": "i-1"})
+        first = queue.receive_messages()
+        assert len(first) == 1 and first[0].receive_count == 1
+        # in flight: invisible until the timeout lapses
+        assert queue.receive_messages() == []
+        clock.step(31)
+        second = queue.receive_messages()
+        assert len(second) == 1 and second[0].receive_count == 2
+        assert second[0].message_id == first[0].message_id
+
+    def test_stale_receipt_handle_does_not_delete(self):
+        clock = FakeClock()
+        queue = NotificationQueue(clock=clock, visibility_timeout=30.0)
+        queue.send({"kind": "rebalance_recommendation", "instance_id": "i-1"})
+        first = queue.receive_messages()
+        clock.step(31)
+        second = queue.receive_messages()
+        assert queue.delete_message(first[0].receipt_handle) is False
+        assert queue.depth() == 1
+        assert queue.delete_message(second[0].receipt_handle) is True
+        assert queue.depth() == 0
+
+    def test_dead_letter_after_max_receives(self):
+        clock = FakeClock()
+        queue = NotificationQueue(clock=clock, visibility_timeout=10.0, max_receive_count=3)
+        queue.send({"poison": True})
+        for _ in range(3):
+            assert len(queue.receive_messages()) == 1
+            clock.step(11)
+        # the 4th receive attempt moves it to the dead-letter list
+        assert queue.receive_messages() == []
+        assert queue.depth() == 0
+        assert queue.dead_letter_depth() == 1
+        assert queue.dead_letters()[0].body == {"poison": True}
+
+    def test_long_poll_returns_on_arrival(self):
+        import threading
+        import time
+
+        queue = NotificationQueue()
+        result = {}
+
+        def recv():
+            t0 = time.monotonic()
+            result["messages"] = queue.receive_messages(wait_seconds=5.0)
+            result["elapsed"] = time.monotonic() - t0
+
+        thread = threading.Thread(target=recv)
+        thread.start()
+        time.sleep(0.1)
+        queue.send({"kind": "instance_stopped", "instance_id": "i-9"})
+        thread.join(timeout=5)
+        assert result["messages"], "long poll must deliver the arrival"
+        assert result["elapsed"] < 4.0, "arrival must wake the waiter before the deadline"
+
+
+# -- message taxonomy --------------------------------------------------------
+
+
+class TestMessageParsing:
+    def test_parses_every_kind(self):
+        for body, kind in [
+            ({"kind": "spot_interruption", "instance_id": "i-1", "deadline": 100.0}, "spot_interruption"),
+            ({"kind": "rebalance_recommendation", "instance_id": "i-1"}, "rebalance_recommendation"),
+            ({"kind": "scheduled_maintenance", "instance_id": "i-1", "not_before": 5.0}, "scheduled_maintenance"),
+            ({"kind": "instance_stopped", "instance_id": "i-1"}, "instance_stopped"),
+            ({"kind": "instance_terminated", "instance_id": "i-1"}, "instance_terminated"),
+        ]:
+            assert parse(body).kind == kind
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            "not a dict",
+            {},
+            {"kind": "unheard_of", "instance_id": "i-1"},
+            {"kind": "spot_interruption", "instance_id": "i-1"},  # no deadline
+            {"kind": "spot_interruption", "instance_id": "", "deadline": 1.0},
+            {"kind": "scheduled_maintenance", "instance_id": "i-1", "not_before": "soon"},
+        ],
+    )
+    def test_rejects_malformed(self, body):
+        with pytest.raises(MessageParseError):
+            parse(body)
+
+
+# -- controller catalog ------------------------------------------------------
+
+
+class InterruptionEnv:
+    """Runtime + simulated cloud with the interruption subsystem enabled,
+    optionally over the HTTP transport."""
+
+    def __init__(self, transport: str = "inprocess"):
+        self.clock = FakeClock()
+        self.kube = KubeCluster(clock=self.clock)
+        self.backend = CloudBackend(clock=self.clock)
+        self.service = None
+        backend = self.backend
+        if transport == "http":
+            from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+            self.service = CloudAPIService(backend=self.backend).start()
+            backend = CloudAPIClient(self.service.url, clock=self.clock)
+        self.provider = SimulatedCloudProvider(backend=backend, kube=self.kube, clock=self.clock)
+        self.runtime = Runtime(
+            kube=self.kube,
+            cloud_provider=self.provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False, interruption_queue="interruptions"),
+        )
+        self.interruption = self.runtime.interruption
+        assert self.interruption is not None
+        self.kube.create(
+            make_provisioner(
+                requirements=[
+                    NodeSelectorRequirement(
+                        key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=["spot", "on-demand"]
+                    )
+                ]
+            )
+        )
+
+    def close(self):
+        if self.service is not None:
+            self.service.stop()
+        LeaderElector._leader = None
+
+    def launch_node_with_pods(self, pod_count: int = 3):
+        pods = []
+        for _ in range(pod_count):
+            pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+            pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+            pods.append(pod)
+            self.kube.create(pod)
+        self.runtime.provision_once()
+        node = self.kube.list_nodes()[0]
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        self.kube.update(node)
+        for pod in pods:
+            self.kube.bind_pod(pod, node.name)
+        self.runtime.node_controller.reconcile_all()
+        return node, pods
+
+    def instance_id(self, node) -> str:
+        return node.spec.provider_id.split("///", 1)[1]
+
+    def converge(self, rounds: int = 4) -> None:
+        """Drain the at-least-once echo chain (interruption -> termination
+        -> instance_terminated notification -> no-op delete) to quiescence."""
+        for _ in range(rounds):
+            self.interruption.poll_once()
+            self.runtime.termination.reconcile_all()
+
+
+@pytest.fixture(params=["inprocess", "http"])
+def env(request):
+    e = InterruptionEnv(transport=request.param)
+    yield e
+    e.close()
+
+
+@pytest.fixture()
+def env_local():
+    e = InterruptionEnv()
+    yield e
+    e.close()
+
+
+def _interruption_tainted(node) -> bool:
+    return node.spec.unschedulable and any(t.key == lbl.TAINT_INTERRUPTION for t in node.spec.taints)
+
+
+class TestInterruptionCatalog:
+    def test_spot_interruption_drill_end_to_end(self, env):
+        """The acceptance drill, on both transports: a spot notice for a
+        node running reschedulable pods -> replacement capacity launched
+        and pods landed on live nodes before the 2-minute deadline, the
+        message deleted, metrics observable."""
+        node, pods = env.launch_node_with_pods(3)
+        received_before = env.interruption.messages_received.value(kind="spot_interruption")
+        deadline = env.backend.interrupt_spot_instance(env.instance_id(node))
+        assert deadline == env.clock.now() + 120.0
+
+        env.interruption.poll_once()
+        # replacement capacity launched BEFORE the drain finished its victim
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert replacements, "proactive solve must launch replacement capacity"
+        env.converge()
+        # the victim is gone, the replacement alive
+        assert env.kube.get_node(node.name) is None
+        live = env.kube.list_nodes()
+        assert live and all(env.backend.instance_exists(env.instance_id(n)) for n in live)
+
+        # the ReplicaSet recreates the evicted pods; the next round binds
+        # them onto the pre-provisioned capacity — no new node needed
+        recreated = []
+        for _ in range(3):
+            pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+            pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+            recreated.append(pod)
+            env.kube.create(pod)
+        results = env.runtime.provision_once()
+        placed_existing = sum(len(v.pods) for v in results.existing_nodes)
+        launched_new = len([n for n in results.new_nodes if n.pods])
+        assert placed_existing == 3 and launched_new == 0, (
+            f"recreated pods must land on the pre-provisioned node "
+            f"(existing={placed_existing}, new={launched_new})"
+        )
+
+        # before the deadline, queue drained, metrics visible
+        assert env.clock.now() < deadline
+        assert env.backend.notifications.depth() == 0, "no message may leak undeleted"
+        assert env.interruption.messages_received.value(kind="spot_interruption") == received_before + 1
+        assert env.interruption.actions_performed.value(action="cordon_and_drain") >= 1
+        reasons = {e.reason for e in env.runtime.recorder.events}
+        assert "SpotInterrupted" in reasons and "InterruptionReplacement" in reasons
+
+    def test_rebalance_recommendation_cordons_only(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        env.backend.recommend_rebalance(env.instance_id(node))
+        env.interruption.poll_once()
+        refreshed = env.kube.get_node(node.name)
+        assert _interruption_tainted(refreshed)
+        assert refreshed.metadata.deletion_timestamp is None, "rebalance must not drain"
+        assert len(env.kube.list_nodes()) == 1, "rebalance must not launch capacity"
+        assert env.backend.notifications.depth() == 0
+        assert env.interruption.actions_performed.value(action="cordon") >= 1
+
+    def test_scheduled_maintenance_drains_with_replacement(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        env.backend.schedule_maintenance(env.instance_id(node), not_before_seconds=600.0)
+        env.interruption.poll_once()
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert replacements, "maintenance is a drain: replacement capacity launches"
+        env.converge()
+        assert env.kube.get_node(node.name) is None
+        assert env.backend.notifications.depth() == 0
+
+    def test_instance_stopped_garbage_collects(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        env.backend.stop_instance(env.instance_id(node))
+        env.interruption.poll_once()
+        env.converge()
+        assert env.kube.get_node(node.name) is None, "stopped instance's node is garbage-collected"
+        assert env.backend.notifications.depth() == 0
+        assert env.interruption.actions_performed.value(action="garbage_collect") >= 1
+
+    def test_instance_terminated_garbage_collects(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        # terminate behind the controller's back (an external reclaim)
+        env.backend.terminate_instance(env.instance_id(node))
+        env.interruption.poll_once()
+        env.converge()
+        assert env.kube.get_node(node.name) is None
+        assert env.backend.notifications.depth() == 0
+
+    def test_duplicate_delivery_is_idempotent(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        deadline = env.clock.now() + 120.0
+        body = {"kind": "spot_interruption", "instance_id": env.instance_id(node), "deadline": deadline}
+        env.backend.notifications.send(body)
+        env.backend.notifications.send(body)  # duplicate send (distinct ids)
+        env.interruption.poll_once()
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert len(replacements) == 1, "one victim -> exactly one proactive solve"
+        env.converge()
+        assert env.backend.notifications.depth() == 0, "both copies deleted"
+
+    def test_redelivered_message_short_circuits(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        queue = env.backend.notifications
+        queue.send({"kind": "spot_interruption", "instance_id": env.instance_id(node), "deadline": env.clock.now() + 120.0})
+        # receive once WITHOUT deleting (a consumer crash mid-handling)
+        first = queue.receive_messages()
+        env.interruption._handle(first[0])
+        queue_nodes = len(env.kube.list_nodes())
+        # delete raced the redelivery: the handle is stale, the copy returns
+        env.clock.step(31)
+        env.interruption.poll_once()
+        assert len(env.kube.list_nodes()) == queue_nodes, "redelivery must not double-provision"
+        assert queue.depth() == 0, "the redelivered copy is deleted by its fresh handle"
+
+    def test_unknown_instance_tolerated(self, env_local):
+        env = env_local
+        env.backend.notifications.send(
+            {"kind": "spot_interruption", "instance_id": "i-never-existed", "deadline": env.clock.now() + 120.0}
+        )
+        env.interruption.poll_once()
+        assert env.backend.notifications.depth() == 0, "moot notice deleted cleanly"
+        assert env.interruption.actions_performed.value(action="no_op") >= 1
+
+    def test_malformed_payload_dead_letters(self, env_local):
+        env = env_local
+        parse_errors_before = env.interruption.message_parse_errors.value()
+        env.backend.notifications.send({"kind": "spot_interruption"})  # no instance_id
+        for _ in range(4):
+            env.interruption.poll_once()
+            env.clock.step(31)  # lapse the visibility timeout -> redelivery
+        assert env.backend.notifications.depth() == 0
+        assert env.backend.notifications.dead_letter_depth() == 1, "poison payload must dead-letter"
+        assert env.interruption.message_parse_errors.value() >= parse_errors_before + 3
+        env.interruption.poll_once()
+        assert env.interruption.dead_letter_depth.value() == 1.0, "dead-letter depth gauge visible"
+
+    def test_deadline_race_drain_beats_the_warning_window(self, env_local):
+        """The drill's timing contract: with the proactive solve done at
+        notice time, the drain + rebind completes well inside the 2-minute
+        window; when the cloud makes good on the warning, the victim
+        instance is already deleted and no OTHER instance is reclaimed."""
+        env = env_local
+        node, pods = env.launch_node_with_pods(3)
+        victim_id = env.instance_id(node)
+        deadline = env.backend.interrupt_spot_instance(victim_id)
+        env.interruption.poll_once()
+        env.converge()
+        assert env.kube.get_node(node.name) is None
+        assert env.clock.now() < deadline, "drain must finish inside the warning window"
+        # the cloud reclaims at the deadline: nothing is left to kill
+        env.clock.step(121)
+        assert env.backend.reclaim_due_instances() == []
+        survivors = env.kube.list_nodes()
+        assert survivors and all(env.backend.instance_exists(env.instance_id(n)) for n in survivors)
+
+    def test_transient_solve_failure_retries_on_redelivery(self, env_local):
+        """A provisioning hiccup during the proactive solve must not burn
+        the one-solve-per-victim claim: the message stays on the queue, the
+        node is NOT drained without a replacement attempt, and the
+        redelivered notice retries the solve."""
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        env.backend.interrupt_spot_instance(env.instance_id(node))
+        real_schedule = env.runtime.provisioner.schedule
+        calls = {"n": 0}
+
+        def flaky_schedule(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient cloud hiccup")
+            return real_schedule(*args, **kwargs)
+
+        env.runtime.provisioner.schedule = flaky_schedule
+        env.interruption.poll_once()
+        assert env.kube.get_node(node.name).metadata.deletion_timestamp is None, (
+            "drain must not start without a replacement attempt"
+        )
+        assert env.backend.notifications.depth() == 1, "failed handling leaves the message for redelivery"
+        env.clock.step(31)  # lapse the visibility timeout
+        env.interruption.poll_once()
+        assert calls["n"] == 2, "redelivery must retry the proactive solve"
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert replacements, "retried solve launches the replacement"
+        env.converge()
+        assert env.kube.get_node(node.name) is None
+        assert env.backend.notifications.depth() == 0
+
+    def test_events_deduped_within_ttl(self, env_local):
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        env.backend.recommend_rebalance(env.instance_id(node))
+        env.interruption.poll_once()
+        env.backend.recommend_rebalance(env.instance_id(node))
+        env.interruption.poll_once()
+        events = [e for e in env.runtime.recorder.events if e.reason == "RebalanceRecommended" and e.object_name == node.name]
+        assert len(events) == 1, "identical notices within the TTL emit one event"

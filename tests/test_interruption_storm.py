@@ -1,0 +1,254 @@
+"""Interruption chaos tier: a notice storm against the RUNNING Runtime.
+
+Marked `slow` (excluded from tier-1): this drives real threads — the
+Runtime's interruption poll loop, lifecycle loop, and provisioning batcher —
+with a test-side "cluster" thread standing in for the kubelet (Ready
+conditions), the kube-scheduler (binding pending pods to live capacity),
+and workload controllers (recreating evicted ReplicaSet pods).
+
+The storm: ~50 queue messages, mixing real spot-interruption notices for
+several nodes at once (short reclaim windows the backend makes good on),
+duplicate deliveries, malformed payloads, and notices for unknown /
+already-deleted instances. Convergence contract (ISSUE 2 acceptance):
+
+  - every workload pod ends bound to a node whose instance is alive;
+  - no node object survives pointing at a dead instance (no lost nodes);
+  - the queue drains to zero — no message leaks undeleted;
+  - dead-letter holds exactly the malformed payloads.
+
+Runs on both transports: the in-process backend and the HTTP
+CloudAPIService/Client pair.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeCondition, NodeSelectorRequirement, OP_IN, OwnerReference
+from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend
+from karpenter_tpu.cloudprovider.simulated.provider import SimulatedCloudProvider
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import LeaderElector, Runtime
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_pod, make_provisioner
+
+POD_CPU = 0.5
+DESIRED_PODS = 24
+STORM_MESSAGES = 50
+RECLAIM_WARNING = 4.0  # short warning window so reclaims land mid-test
+DEADLINE = 60.0
+
+
+def _workload_pod():
+    pod = make_pod(requests={"cpu": POD_CPU, "memory": "512Mi"}, labels={"app": "storm"})
+    pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="storm-rs"))
+    return pod
+
+
+class ClusterStandIn(threading.Thread):
+    """Kubelet + kube-scheduler + ReplicaSet controller, minimally: flips
+    new nodes Ready, binds pending pods onto schedulable live capacity
+    (first-fit on cpu), and keeps the workload at DESIRED_PODS replicas."""
+
+    def __init__(self, kube: KubeCluster, backend: CloudBackend):
+        super().__init__(daemon=True)
+        self.kube = kube
+        self.backend = backend
+        self.stop_event = threading.Event()
+
+    def run(self):
+        while not self.stop_event.wait(timeout=0.1):
+            self.tick()
+
+    def tick(self):
+        nodes = self.kube.list_nodes()
+        for node in nodes:
+            if not node.ready():
+                node.status.conditions = [NodeCondition(type="Ready", status="True")]
+                try:
+                    self.kube.update(node)
+                except Exception:
+                    pass
+        # schedulable live capacity, with a first-fit cpu ledger
+        usable = []
+        for node in nodes:
+            if node.spec.unschedulable or node.metadata.deletion_timestamp is not None:
+                continue
+            instance_id = node.spec.provider_id.split("///", 1)[-1]
+            if not self.backend.instance_exists(instance_id):
+                continue
+            used = sum(
+                sum(c.resources.requests.get("cpu", 0.0) for c in p.spec.containers)
+                for p in self.kube.pods_on_node(node.name)
+            )
+            usable.append([node, node.status.allocatable.get("cpu", 0.0) - used])
+        pods = self.kube.list_pods()
+        live = [p for p in pods if p.status.phase not in ("Succeeded", "Failed")]
+        for pod in live:
+            if pod.spec.node_name:
+                continue
+            for slot in usable:
+                if slot[1] >= POD_CPU:
+                    try:
+                        self.kube.bind_pod(pod, slot[0].name)
+                    except Exception:
+                        break
+                    slot[1] -= POD_CPU
+                    break
+        # the ReplicaSet keeps the replica count
+        deficit = DESIRED_PODS - len(live)
+        for _ in range(max(0, deficit)):
+            self.kube.create(_workload_pod())
+
+
+def _converged(kube: KubeCluster, backend: CloudBackend, malformed: int) -> bool:
+    pods = [p for p in kube.list_pods() if p.status.phase not in ("Succeeded", "Failed")]
+    if len(pods) != DESIRED_PODS or any(not p.spec.node_name for p in pods):
+        return False
+    for node in kube.list_nodes():
+        instance_id = node.spec.provider_id.split("///", 1)[-1]
+        if not backend.instance_exists(instance_id):
+            return False  # a node object survives its dead instance
+    for pod in pods:
+        node = kube.get_node(pod.spec.node_name)
+        if node is None:
+            return False
+    if backend.notifications.depth() != 0:
+        return False
+    if backend.notifications.dead_letter_depth() != malformed:
+        return False
+    return True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("transport", ["inprocess", "http"])
+def test_interruption_notice_storm_converges(transport):
+    kube = KubeCluster()
+    backend = CloudBackend()
+    # short redelivery cycle so the malformed payloads run the full
+    # redrive-to-dead-letter path inside the test budget
+    backend.notifications.visibility_timeout = 1.0
+    service = None
+    cloud = backend
+    if transport == "http":
+        from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+        service = CloudAPIService(backend=backend).start()
+        cloud = CloudAPIClient(service.url)
+    provider = SimulatedCloudProvider(backend=cloud, kube=kube, clock=kube.clock)
+    runtime = Runtime(
+        kube=kube,
+        cloud_provider=provider,
+        options=Options(
+            leader_elect=False,
+            dense_solver_enabled=False,
+            batch_max_duration=0.3,
+            batch_idle_duration=0.05,
+            interruption_queue="interruptions",
+            interruption_poll_interval=0.2,
+        ),
+    )
+    kube.create(
+        make_provisioner(
+            requirements=[NodeSelectorRequirement(key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=["spot", "on-demand"])]
+        )
+    )
+    stand_in = ClusterStandIn(kube, backend)
+    try:
+        runtime.start()
+        stand_in.start()
+        # seed the workload; let the first capacity settle
+        for _ in range(DESIRED_PODS):
+            kube.create(_workload_pod())
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            pods = kube.list_pods()
+            if pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.2)
+        victims = [n for n in kube.list_nodes() if kube.pods_on_node(n.name)]
+        assert victims, "storm needs populated nodes"
+
+        # -- the storm: ~50 messages in one burst ---------------------------
+        malformed = 0
+        sent = 0
+        queue = backend.notifications
+        victim_ids = [n.spec.provider_id.split("///", 1)[-1] for n in victims]
+        # N simultaneous reclaims: real interruption warnings, short window
+        for instance_id in victim_ids:
+            backend.interrupt_spot_instance(instance_id, warning_seconds=RECLAIM_WARNING)
+            sent += 1
+        # duplicate deliveries of the first victim's notice
+        for _ in range(6):
+            queue.send(
+                {"kind": "spot_interruption", "instance_id": victim_ids[0], "deadline": time.monotonic() + RECLAIM_WARNING}
+            )
+            sent += 1
+        # malformed payloads -> dead-letter
+        for i in range(5):
+            queue.send({"kind": "spot_interruption", "deadline": "garbage", "seq": i})
+            malformed += 1
+            sent += 1
+        # notices for unknown / already-deleted instances
+        for i in range(8):
+            queue.send({"kind": "instance_stopped", "instance_id": f"i-ghost-{i}"})
+            sent += 1
+        # rebalance + maintenance chatter for the victims
+        for instance_id in victim_ids:
+            backend.recommend_rebalance(instance_id)
+            sent += 1
+        while sent < STORM_MESSAGES:
+            queue.send({"kind": "rebalance_recommendation", "instance_id": f"i-ghost-extra-{sent}"})
+            sent += 1
+        assert sent >= STORM_MESSAGES
+
+        # the cloud makes good on its warnings while the storm is handled
+        reclaim_stop = threading.Event()
+
+        def reclaimer():
+            while not reclaim_stop.wait(timeout=0.25):
+                backend.reclaim_due_instances()
+
+        reclaim_thread = threading.Thread(target=reclaimer, daemon=True)
+        reclaim_thread.start()
+
+        deadline = time.monotonic() + DEADLINE
+        ok = False
+        while time.monotonic() < deadline:
+            if _converged(kube, backend, malformed):
+                ok = True
+                break
+            time.sleep(0.5)
+        reclaim_stop.set()
+        reclaim_thread.join(timeout=2)
+        pods = [p for p in kube.list_pods() if p.status.phase not in ("Succeeded", "Failed")]
+        assert ok, (
+            f"storm did not converge: pods={len(pods)} unbound={[p.name for p in pods if not p.spec.node_name][:5]} "
+            f"queue_depth={backend.notifications.depth()} dlq={backend.notifications.dead_letter_depth()} "
+            f"(want dlq={malformed}) nodes={[n.name for n in kube.list_nodes()]}"
+        )
+        # every victim's pods landed on live capacity
+        for pod in pods:
+            node = kube.get_node(pod.spec.node_name)
+            assert node is not None
+            assert backend.instance_exists(node.spec.provider_id.split("///", 1)[-1])
+        # dead-letter holds exactly the malformed payloads
+        bodies = [m.body for m in backend.notifications.dead_letters()]
+        assert len(bodies) == malformed and all("instance_id" not in b for b in bodies)
+        # the loop observed everything: received >= sent (redeliveries count)
+        received = sum(
+            runtime.interruption.messages_received.value(kind=k)
+            for k in ("spot_interruption", "rebalance_recommendation", "instance_stopped", "instance_terminated", "malformed")
+        )
+        assert received >= sent
+    finally:
+        stand_in.stop_event.set()
+        stand_in.join(timeout=3)
+        runtime.stop()
+        if service is not None:
+            service.stop()
+        LeaderElector._leader = None

@@ -113,6 +113,19 @@ class TestManifestBundle:
         assert containers["solver"]["resources"]["requests"] == {"google.com/tpu": "4"}
         assert containers["solver"]["resources"]["limits"] == {"google.com/tpu": "4"}
 
+    def test_interruption_queue_wires_args_and_settings(self):
+        docs = render(_args(interruption_queue="karpenter-interruptions"))
+        deployment = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        args = deployment["spec"]["template"]["spec"]["containers"][0]["args"]
+        idx = args.index("--interruption-queue")
+        assert args[idx + 1] == "karpenter-interruptions"
+        cm = next(d for d in by_kind(docs, "ConfigMap") if d["metadata"]["name"] == CONFIGMAP_NAME)
+        assert cm["data"]["interruptionQueueName"] == "karpenter-interruptions"
+        # default render stays clean: no flag, no settings key
+        plain = render(_args())
+        deployment = next(d for d in by_kind(plain, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")
+        assert "--interruption-queue" not in deployment["spec"]["template"]["spec"]["containers"][0]["args"]
+
     def test_controller_never_schedules_on_managed_capacity(self):
         docs = render(_args())
         controller = next(d for d in by_kind(docs, "Deployment") if d["metadata"]["name"] == "karpenter-tpu")

@@ -224,6 +224,118 @@ def test_hostname_spread_multi_skew_parity(seed, monkeypatch):
     assert new_v == new_h
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_affinity_single_extra_rule_certified(seed, monkeypatch):
+    """PR-1 deferral closed: a zonal self-affinity cohort carrying ONE extra
+    integer rule — here the reachable common shape, an inverse anti-affinity
+    'zero' check from anti pods already BOUND in the warm cluster whose
+    selector matches the cohort — used to fail the WHOLE plan open to the
+    host loop (plan()'s old gate required exactly [aff]). The bootstrap now
+    enforces the extra rule through admit()/room_vector and the plan stays
+    vectorized. (Batch-internal anti cohorts whose selector cross-matches
+    the affinity cohort fail the owned-groups gate earlier, and non-zero
+    recorded inverse counts bail in presolve — so the cluster-fed zero-count
+    inverse check is the single-extra-rule case that actually reaches the
+    affinity gate.) Parity is asserted byte-exactly against the host loop,
+    and the certification is asserted to ENGAGE (fills_vectorized >= 1) so
+    this sweep can never silently degrade to host-vs-host."""
+    from karpenter_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_HOSTNAME,
+        LABEL_INSTANCE_TYPE,
+        LABEL_TOPOLOGY_ZONE,
+        PROVISIONER_NAME_LABEL,
+    )
+    from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+    from karpenter_tpu.controllers.state.cluster import Cluster
+    from karpenter_tpu.kube.cluster import KubeCluster
+    from tests.helpers import make_node, make_pod
+
+    zones = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+    def build(tag):
+        rng = np.random.default_rng(9300 + seed)
+        provider = FakeCloudProvider(instance_types(50))
+        kube = KubeCluster()
+        # warm nodes WITHOUT hostname labels: the inverse groups the bound
+        # anti pods create then carry zero recorded counts, which is what
+        # lets presolve proceed (non-zero counts route the batch to host)
+        for i in range(int(rng.integers(6, 12))):
+            name = f"a1xnode-{seed}-{i:03d}"
+            kube.create(
+                make_node(
+                    name=name,
+                    labels={
+                        PROVISIONER_NAME_LABEL: "default",
+                        LABEL_INSTANCE_TYPE: "fake-it-3",
+                        LABEL_CAPACITY_TYPE: "on-demand",
+                        LABEL_TOPOLOGY_ZONE: zones[int(rng.integers(3))],
+                    },
+                    allocatable={"cpu": int(rng.integers(8, 33)), "memory": "64Gi", "pods": 110},
+                )
+            )
+        cluster = Cluster(kube, None)
+        nodes = kube.list_nodes()
+        # anti pods already running on a few warm nodes; their selector
+        # matches the affinity cohort's shared label -> inverse 'zero' veto
+        for j in range(int(rng.integers(2, 5))):
+            anti = make_pod(
+                name=f"a1x-anti-{seed}-{j}",
+                labels={"anti": "a", "shared": "x"},
+                requests={"cpu": 0.25, "memory": "256Mi"},
+                pod_anti_requirements=[
+                    PodAffinityTerm(
+                        topology_key=LABEL_HOSTNAME, label_selector=LabelSelector(match_labels={"shared": "x"})
+                    )
+                ],
+                node_name=nodes[j % len(nodes)].name,
+                phase="Running",
+                unschedulable=False,
+            )
+            kube.create(anti)
+        pods = []
+        for _ in range(int(rng.integers(5, 14))):  # the certified cohort
+            pods.append(
+                make_pod(
+                    labels={"aff": "b", "shared": "x"},
+                    requests={"cpu": 0.5, "memory": "512Mi"},
+                    pod_requirements=[
+                        PodAffinityTerm(
+                            topology_key=LABEL_TOPOLOGY_ZONE, label_selector=LabelSelector(match_labels={"aff": "b"})
+                        )
+                    ],
+                )
+            )
+        for _ in range(int(rng.integers(10, 30))):  # filler plain pods
+            pods.append(make_pod(labels={"app": "filler"}, requests={"cpu": 0.25, "memory": "256Mi"}))
+        _rename(pods, f"a1x{seed}")
+        return pods, cluster, provider
+
+    def solve(no_vector):
+        pods, cluster, provider = build("vec" if not no_vector else "host")
+        if no_vector:
+            monkeypatch.setenv(NO_VECTOR_ENV, "1")
+        else:
+            monkeypatch.delenv(NO_VECTOR_ENV, raising=False)
+        solver = DenseSolver(min_batch=1)
+        scheduler = build_scheduler(
+            _provisioners(), provider, pods, cluster=cluster,
+            state_nodes=cluster.nodes_snapshot(), dense_solver=solver,
+        )
+        return scheduler.solve(pods), solver, scheduler, cluster
+
+    results_v, solver_v, sched_v, _cluster_v = solve(no_vector=False)
+    results_h, solver_h, sched_h, _cluster_h = solve(no_vector=True)
+    assert solver_v.stats.fills_vectorized >= 1, (
+        f"seed {seed}: single-extra-rule affinity cohort fell back to the host loop"
+    )
+    views_v, topo_v, new_v = _fill_fingerprint(results_v, sched_v)
+    views_h, topo_h, new_h = _fill_fingerprint(results_h, sched_h)
+    assert views_v == views_h, f"seed {seed}: per-view placements/residuals diverge"
+    assert topo_v == topo_h, f"seed {seed}: topology domain counts diverge"
+    assert new_v == new_h, f"seed {seed}: new-node placement diverges"
+
+
 def test_vectorized_path_actually_engaged():
     # the parity sweep is vacuous if every seed failed open to the host loop
     if not _vectorized_hits:
