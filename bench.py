@@ -250,6 +250,31 @@ def run_once(pods, provider, provisioners, solver, state_nodes=()):
 PHASE_BREAKDOWN: dict = {}
 
 
+def capture_span_tree():
+    """The span tree of the most recently completed solve (tracing.py runs
+    enabled for the whole bench): lands in the phases JSON so a headline
+    drift is bisectable from the artifact — per-solve encode/device/commit
+    child spans, not just aggregate medians."""
+    from karpenter_tpu.tracing import TRACER
+
+    trace_id = TRACER.last_trace_id()
+    return TRACER.span_tree(trace_id) if trace_id else None
+
+
+def assert_span_tree(tree, context: str) -> None:
+    """Structural gate on a solve trace: non-empty, rooted at the solve span,
+    and the measured encode/device/commit children sum to no more than the
+    parent wall-clock (they are disjoint sub-intervals of the solve)."""
+    assert tree, f"[{context}] tracing produced no span tree"
+    assert tree.get("name") == "solve", f"[{context}] trace root is {tree.get('name')!r}, not the solve span"
+    children = {c["name"]: c for c in tree.get("children", ())}
+    for name in ("encode", "device", "commit"):
+        assert name in children, f"[{context}] span tree missing dense child {name!r}: {sorted(children)}"
+    child_sum = sum(children[n]["duration_ms"] for n in ("encode", "device", "commit"))
+    parent = tree["duration_ms"]
+    assert child_sum <= parent + 1e-3, f"[{context}] child spans sum {child_sum}ms > parent solve {parent}ms"
+
+
 def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS, phase_key=None):
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
     times = []
@@ -287,6 +312,9 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
             "nodes_opened_dense": last_stats.nodes_opened_dense,
             "nodes_opened_host_floor": last_stats.nodes_opened_host_floor,
             "node_guard_failopens": last_stats.node_guard_failopens,
+            # the final trial's span tree (encode/device/commit children
+            # under the solve root) — the bisect-from-artifacts evidence
+            "span_tree": capture_span_tree(),
         }
     if PROFILE_DIR:
         profile_config(name, pods, provider, provisioners, solver, state_nodes)
@@ -347,6 +375,21 @@ def smoke() -> dict:
     (cold configs) or the vectorized warm fill engaged with nonzero device
     time (repack config); the node-guard never tripped and the dense node
     count stayed within the guard ratio of the host floor."""
+    from karpenter_tpu.tracing import TRACER
+
+    was_enabled = TRACER.enabled
+    TRACER.enable()  # smoke runs traced: an empty span tree is a tier-1 failure
+    try:
+        return _smoke()
+    finally:
+        if not was_enabled:
+            # smoke runs inside tier-1 (test_bench_smoke): even a failing
+            # assert must not leave the process-wide tracer on for
+            # unrelated tests that follow
+            TRACER.disable()
+
+
+def _smoke() -> dict:
     from karpenter_tpu.api.objects import Taint
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
     from karpenter_tpu.solver import DenseSolver
@@ -359,6 +402,8 @@ def smoke() -> dict:
         elapsed, scheduled, nodes, cost, stats, _packing = run_once(
             pods, provider, provisioners, solver, state_nodes
         )
+        span_tree = capture_span_tree()
+        assert_span_tree(span_tree, name)
         assert scheduled == len(pods), f"[{name}] scheduled {scheduled}/{len(pods)}"
         assert stats.node_guard_failopens == 0, f"[{name}] node guard tripped"
         if stats.nodes_opened_host_floor:
@@ -381,6 +426,7 @@ def smoke() -> dict:
             "fill_pods_host": stats.fill_pods_host,
             "nodes_opened_dense": stats.nodes_opened_dense,
             "nodes_opened_host_floor": stats.nodes_opened_host_floor,
+            "span_tree": span_tree,
         }
         log(f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes)")
 
@@ -454,9 +500,15 @@ def main() -> None:
     from karpenter_tpu.api.objects import Taint
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
     from karpenter_tpu.solver import DenseSolver
+    from karpenter_tpu.tracing import TRACER
     from tests.helpers import make_provisioner
 
     import gc
+
+    # the whole grid runs traced (a handful of spans per solve — noise-level
+    # next to the solve itself) so the emitted phases JSON carries the span
+    # tree of every config's final trial, headline included
+    TRACER.enable()
 
     configs: dict = {}
 

@@ -78,14 +78,20 @@ def main(argv=None) -> int:
     # must still answer kubelet probes (controllers.go:167-181)
     from ..observability import ObservabilityServer
 
-    extra_routes = None
+    extra_routes = {}
     if options.enable_profiling:
         # live pprof-analog endpoints on the metrics port
         # (controllers.go:183-202): on-demand host profile + XLA trace of
         # the RUNNING process, no restart needed
         from ..profiling import LiveProfiler
 
-        extra_routes = LiveProfiler().routes()
+        extra_routes.update(LiveProfiler().routes())
+    if options.enable_tracing:
+        # decision-tracing read surface: /debug/traces (+ ?id, ?format=chrome)
+        # and /debug/decisions (+ ?pod=) on the metrics port
+        from .. import tracing
+
+        extra_routes.update(tracing.routes())
     obs = ObservabilityServer(
         healthy=runtime.healthy,
         ready=lambda: runtime.ready() and runtime.healthy(),

@@ -29,6 +29,7 @@ from ...cloudprovider.types import CloudProvider, NodeRequest
 from ...events import Recorder
 from ...kube.cluster import KubeCluster
 from ...scheduler import SchedulerOptions
+from ...tracing import TRACER
 from ...utils import pod as podutils
 from ..state.cluster import Cluster, StateNode
 from ...logsetup import get_logger
@@ -165,8 +166,11 @@ class ConsolidationController:
 
     def process_cluster(self) -> ConsolidationAction:
         self.metrics.evaluations += 1
-        with self.metrics._eval_duration.time():
-            return self._process_cluster()
+        with TRACER.span("consolidate") as sp:
+            with self.metrics._eval_duration.time():
+                action = self._process_cluster()
+            sp.set(action=action.type.value, reason=action.reason)
+            return action
 
     def _process_cluster(self) -> ConsolidationAction:
         # finish a replacement that was waiting on readiness; the wait is
@@ -304,11 +308,14 @@ class ConsolidationController:
         (controller.go:430-498)."""
         reschedulable = [p for p in pods if not podutils.is_owned_by_daemonset(p) and not podutils.is_terminal(p)]
         state_nodes = self.cluster.nodes_snapshot()
-        results = self.provisioner_controller.schedule(
-            reschedulable,
-            state_nodes,
-            opts=SchedulerOptions(simulation_mode=True, exclude_nodes=[candidate.name]),
-        )
+        # the simulated solve's span tree (incl. the dense phase children)
+        # nests under this, so a slow consolidation pass is attributable
+        with TRACER.span("simulate", candidate=candidate.name, pods=len(reschedulable)):
+            results = self.provisioner_controller.schedule(
+                reschedulable,
+                state_nodes,
+                opts=SchedulerOptions(simulation_mode=True, exclude_nodes=[candidate.name]),
+            )
         if results.unschedulable:
             return ConsolidationAction(ActionType.NO_ACTION, reason="pods would not reschedule")
         if not results.new_nodes or all(not n.pods for n in results.new_nodes):
@@ -350,6 +357,10 @@ class ConsolidationController:
     def perform(self, action: ConsolidationAction) -> None:
         if action.type == ActionType.NO_ACTION:
             return
+        with TRACER.span("perform", action=action.type.value, nodes=len(action.nodes)):
+            self._perform(action)
+
+    def _perform(self, action: ConsolidationAction) -> None:
         if action.type == ActionType.REPLACE:
             # cordon the outgoing node before launching so new pods cannot
             # land on it while the replacement converges (controller.go:310-312)

@@ -43,6 +43,7 @@ from ...kube.cluster import KubeCluster
 from ...logsetup import get_logger
 from ...metrics import REGISTRY
 from ...scheduler import SchedulerOptions
+from ...tracing import TRACER
 from ...utils import pod as podutils
 from ..state.cluster import Cluster
 from .messages import (
@@ -160,13 +161,22 @@ class InterruptionController:
             self._mark_handled(received.message_id)
             self._delete(received)
             return
-        self.recorder.node_interrupted(node, msg.kind, self._describe(msg))
-        if action == ACTION_GARBAGE_COLLECT:
-            self._garbage_collect(node)
-        elif action == ACTION_CORDON:
-            self._cordon(node)
-        elif action == ACTION_CORDON_AND_DRAIN:
-            self._cordon_and_drain(node, msg)
+        # one trace per acted-on notice: cordon -> re-solve -> replacement
+        # launch -> drain handoff all share the trace ID, and the deadline
+        # attrs make the 2-minute warning budget auditable span by span
+        with TRACER.span(
+            "interruption-notice", controller="interruption", kind=msg.kind, instance=msg.instance_id,
+            node=node.name, action=action,
+            deadline_remaining_s=round(msg.deadline - self.clock.now(), 3) if msg.deadline else None,
+        ):
+            self.recorder.node_interrupted(node, msg.kind, self._describe(msg))
+            if action == ACTION_GARBAGE_COLLECT:
+                self._garbage_collect(node)
+            elif action == ACTION_CORDON:
+                with TRACER.span("cordon", node=node.name):
+                    self._cordon(node)
+            elif action == ACTION_CORDON_AND_DRAIN:
+                self._cordon_and_drain(node, msg)
         self.actions_performed.inc(action=action)
         self._mark_handled(received.message_id)
         self._delete(received)
@@ -242,7 +252,8 @@ class InterruptionController:
         return True
 
     def _cordon_and_drain(self, node: Node, msg: InterruptionMessage) -> None:
-        self._cordon(node)
+        with TRACER.span("cordon", node=node.name):
+            self._cordon(node)
         if node.metadata.deletion_timestamp is None and not self._replacement_in_flight(node.name):
             # the proactive solve, BEFORE the drain starts: replacement
             # capacity launches while the warning window ticks. A transient
@@ -267,11 +278,12 @@ class InterruptionController:
         """Termination-controller handoff: the delete starts the cordon/
         drain/finalize protocol it owns; reconcile now rather than waiting
         for the lifecycle loop's next tick."""
-        self.kube.delete(node)
-        if self.termination is not None:
-            refreshed = self.kube.get_node(node.name)
-            if refreshed is not None:
-                self.termination.reconcile(refreshed)
+        with TRACER.span("drain-handoff", node=node.name):
+            self.kube.delete(node)
+            if self.termination is not None:
+                refreshed = self.kube.get_node(node.name)
+                if refreshed is not None:
+                    self.termination.reconcile(refreshed)
 
     def _replacement_in_flight(self, node_name: str) -> bool:
         now = self.clock.now()
@@ -297,10 +309,13 @@ class InterruptionController:
         if not pods:
             return 0
         state_nodes = self.cluster.nodes_snapshot()
-        results = self.provisioner.schedule(
-            pods, state_nodes, opts=SchedulerOptions(simulation_mode=True, exclude_nodes=[node.name])
-        )
-        launched = self.provisioner.launch_nodes(results)
+        with TRACER.span("re-solve", node=node.name, pods=len(pods)):
+            results = self.provisioner.schedule(
+                pods, state_nodes, opts=SchedulerOptions(simulation_mode=True, exclude_nodes=[node.name])
+            )
+        with TRACER.span("launch-replacement", node=node.name) as sp:
+            launched = self.provisioner.launch_nodes(results)
+            sp.set(launched=len(launched))
         self.recorder.interruption_replacement_launched(node, len(pods))
         log.info(
             "proactive re-solve for %s: %d pod(s) -> %d replacement node(s) launched, %d onto existing capacity",

@@ -24,8 +24,10 @@ from ...cloudprovider.types import CloudProvider, NodeRequest
 from ...config import Config
 from ...events import Recorder
 from ...kube.cluster import Conflict, KubeCluster
+from ...metrics import REGISTRY
 from ...scheduler import SchedulerOptions, build_scheduler
 from ...scheduler.scheduler import SchedulingResults
+from ...tracing import DECISIONS, TRACER
 from ...utils import pod as podutils
 from ...utils import resources as res
 from ..state.cluster import Cluster
@@ -81,6 +83,13 @@ class ProvisionerController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_results: Optional[SchedulingResults] = None
+        # same family the Runtime loops feed for every other controller
+        self.reconcile_duration = REGISTRY.histogram(
+            "karpenter_reconcile_duration_seconds",
+            "Duration of controller reconcile passes",
+            ("controller",),
+        )
+        self.last_trace_id: Optional[str] = None  # trace of the latest round (tracing on)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -114,6 +123,14 @@ class ProvisionerController:
     # -- the provisioning round ------------------------------------------------
 
     def provision(self) -> SchedulingResults:
+        with TRACER.span("provision", controller="provisioning") as root:
+            with self.reconcile_duration.time(controller="provisioning"):
+                results = self._provision_round(root)
+            self.last_trace_id = getattr(root, "trace_id", None)
+        self.last_results = results
+        return results
+
+    def _provision_round(self, root):
         if self.wait_for_cluster_sync:
             deadline = self.clock.now() + 10.0
             while not self.cluster.synchronized():
@@ -122,10 +139,20 @@ class ProvisionerController:
                 self.clock.sleep(0.05)
 
         state_nodes = self.cluster.nodes_snapshot()
-        pods = self.get_pods()
+        # batch: collect + constrain the pending pods (PVC validation and
+        # volume-topology injection live inside get_pods)
+        with TRACER.span("batch") as sp:
+            pods = self.get_pods()
+            sp.set(pods=len(pods), state_nodes=len(state_nodes))
         start = self.clock.now()
         results = self.schedule(pods, state_nodes)
         launched = self.launch_nodes(results)
+        root.set(
+            pods=len(pods),
+            launched=len(launched),
+            on_existing=sum(len(v.pods) for v in results.existing_nodes),
+            unschedulable=len(results.unschedulable),
+        )
         if pods:
             log.info(
                 "provisioned batch: %d pods -> %d new nodes (%d launched), %d on existing, %d unschedulable in %.0f ms",
@@ -136,7 +163,6 @@ class ProvisionerController:
                 len(results.unschedulable),
                 (self.clock.now() - start) * 1000,
             )
-        self.last_results = results
         return results
 
     def get_pods(self) -> List[Pod]:
@@ -179,16 +205,17 @@ class ProvisionerController:
                 p.name: apply_kubelet_max_pods(p, cloud_provider.get_instance_types(p)) for p in provisioners
             }
             try:
-                results = self.remote_solver.solve(
-                    provisioners,
-                    instance_types,
-                    pods,
-                    daemonset_pods=self.daemonset_pods(),
-                    state_nodes=state_nodes,
-                    kube=self.kube,
-                    simulation_mode=bool(opts and opts.simulation_mode),
-                    exclude_nodes=list(opts.exclude_nodes) if opts else [],
-                )
+                with TRACER.span("solve-remote", pods=len(pods)):
+                    results = self.remote_solver.solve(
+                        provisioners,
+                        instance_types,
+                        pods,
+                        daemonset_pods=self.daemonset_pods(),
+                        state_nodes=state_nodes,
+                        kube=self.kube,
+                        simulation_mode=bool(opts and opts.simulation_mode),
+                        exclude_nodes=list(opts.exclude_nodes) if opts else [],
+                    )
                 if not (opts and opts.simulation_mode):
                     for pod, err in results.unschedulable.items():
                         self.recorder.pod_failed_to_schedule(pod, err)
@@ -233,6 +260,12 @@ class ProvisionerController:
     LAUNCH_WORKERS = 50
 
     def launch_nodes(self, results: SchedulingResults) -> List[str]:
+        with TRACER.span("launch") as sp:
+            launched = self._launch_nodes(results)
+            sp.set(nodes=len(launched))
+        return launched
+
+    def _launch_nodes(self, results: SchedulingResults) -> List[str]:
         provisioners = {p.name: p for p in self.kube.list_provisioners()}
         to_launch = [vn for vn in results.new_nodes if vn.pods]
 
@@ -266,30 +299,44 @@ class ProvisionerController:
             approved.append(vn)
 
         # fan out the cloud Create calls — one slow or failing launch neither
-        # serializes nor aborts its siblings (provisioner.go:172-190)
+        # serializes nor aborts its siblings (provisioner.go:172-190). The
+        # ambient span is thread-local, so the pool workers parent their
+        # launch-node spans under an explicitly captured context.
+        parent_ctx = TRACER.current_context()
         if len(approved) <= 1:
-            names = [self._launch(vn) for vn in approved]
+            names = [self._launch(vn, parent_ctx) for vn in approved]
         else:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=min(len(approved), self.LAUNCH_WORKERS)) as pool:
-                names = list(pool.map(self._launch, approved))
+                names = list(pool.map(lambda vn: self._launch(vn, parent_ctx), approved))
         launched = [n for n in names if n is not None]
         # nominate pods onto existing nodes they were scheduled against
-        for view in results.existing_nodes:
-            if view.pods:
-                self.cluster.nominate_node_for_pod(view.node.name)
-                for pod in view.pods:
-                    self.recorder.nominate_pod(pod, view.node)
+        with TRACER.span("bind") as sp:
+            nominated = 0
+            for view in results.existing_nodes:
+                if view.pods:
+                    self.cluster.nominate_node_for_pod(view.node.name)
+                    for pod in view.pods:
+                        self.recorder.nominate_pod(pod, view.node)
+                        nominated += 1
+            sp.set(nominated=nominated)
         return launched
 
-    def _launch(self, virtual_node) -> Optional[str]:
+    def _launch(self, virtual_node, parent_ctx=None) -> Optional[str]:
+        with TRACER.span(
+            "launch-node", parent=parent_ctx, provisioner=virtual_node.provisioner_name, pods=len(virtual_node.pods)
+        ) as sp:
+            return self._launch_one(virtual_node, sp)
+
+    def _launch_one(self, virtual_node, sp) -> Optional[str]:
         try:
             node = self.cloud_provider.create(
                 NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
             )
         except Exception as e:  # noqa: BLE001 - capacity errors self-heal next batch
             log.warning("node launch failed for provisioner %s: %s", virtual_node.provisioner_name, e)
+            sp.set(error=str(e))
             for pod in virtual_node.pods:
                 self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
             return None
@@ -297,6 +344,18 @@ class ProvisionerController:
             self.kube.create(node)
         except Conflict:
             pass  # idempotent create (provisioner.go:317-328)
+        sp.set(node=node.name, instance_type=node.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE, ""))
+        if TRACER.enabled:
+            # the scheduler recorded placed-new against the placeholder
+            # hostname; the audit record should name the real instance.
+            # Matching on the placeholder means launches fed by simulated
+            # solves (which recorded nothing) back-fill nothing.
+            DECISIONS.update_node(
+                [p.name for p in virtual_node.pods],
+                node.name,
+                node.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE, ""),
+                placeholder=getattr(virtual_node, "_hostname", ""),
+            )
         self.recorder.launching_node(node, f"for {len(virtual_node.pods)} pod(s)")
         self.cluster.nominate_node_for_pod(node.name)
         for pod in virtual_node.pods:

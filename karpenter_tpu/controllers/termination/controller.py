@@ -20,6 +20,7 @@ from ...events import Recorder
 from ...logsetup import get_logger
 from ...kube.cluster import KubeCluster
 from ...scheduling.taints import Taints
+from ...tracing import TRACER
 from ...utils import pod as podutils
 from .eviction import EvictionQueue
 
@@ -53,12 +54,20 @@ class TerminationController:
     def reconcile(self, node: Node) -> None:
         if lbl.TERMINATION_FINALIZER not in node.metadata.finalizers:
             return
-        self.cordon(node)
-        if not self.drain(node):
-            log.debug("draining %s: pods still evicting", node.name)
-            return  # pods still evicting; re-reconcile later
-        self.cloud_provider.delete(node)
-        self.kube.finalize(node)
+        with TRACER.span("terminate", controller="termination", node=node.name) as sp:
+            with TRACER.span("cordon", node=node.name):
+                self.cordon(node)
+            with TRACER.span("drain", node=node.name) as drain_sp:
+                drained = self.drain(node)
+                drain_sp.set(drained=drained)
+            if not drained:
+                sp.set(outcome="pods-still-evicting")
+                log.debug("draining %s: pods still evicting", node.name)
+                return  # pods still evicting; re-reconcile later
+            with TRACER.span("finalize", node=node.name):
+                self.cloud_provider.delete(node)
+                self.kube.finalize(node)
+            sp.set(outcome="terminated")
         log.info("terminated node %s: drained, instance deleted, finalizer removed", node.name)
         if node.metadata.deletion_timestamp is not None:
             duration = self.clock.now() - node.metadata.deletion_timestamp

@@ -4,8 +4,15 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Deque, List, Optional, Tuple
+
+# retained events per recorder: a long-lived runtime records on every
+# provisioning/termination/interruption action, so an unbounded list is a
+# slow leak — the ring keeps the newest window, like the apiserver's event
+# TTL keeps only recent history
+DEFAULT_EVENT_CAPACITY = 1000
 
 
 @dataclass
@@ -18,10 +25,12 @@ class Event:
 
 
 class Recorder:
-    """Typed event surface (pkg/events/recorder.go:24-41)."""
+    """Typed event surface (pkg/events/recorder.go:24-41). Events live in a
+    bounded ring: appending past capacity evicts the oldest."""
 
-    def __init__(self):
-        self.events: List[Event] = []
+    def __init__(self, capacity: int = DEFAULT_EVENT_CAPACITY):
+        self.capacity = capacity
+        self.events: Deque[Event] = deque(maxlen=capacity)
         self._lock = threading.Lock()
 
     def _record(self, kind: str, reason: str, message: str, name: str) -> None:
@@ -73,15 +82,15 @@ class Recorder:
 
     def reset(self) -> None:
         with self._lock:
-            self.events = []
+            self.events.clear()
 
 
 class DedupeRecorder(Recorder):
     """TTL-deduped decorator (pkg/events/dedupe.go:25-95): identical events
     within the window are suppressed."""
 
-    def __init__(self, inner: Recorder, ttl_seconds: float = 120.0, clock=None):
-        super().__init__()
+    def __init__(self, inner: Recorder, ttl_seconds: float = 120.0, clock=None, capacity: int = DEFAULT_EVENT_CAPACITY):
+        super().__init__(capacity=capacity)
         from .utils.clock import Clock
 
         self.inner = inner

@@ -45,7 +45,10 @@ def _handler(routes):
                 self.send_error(500, str(exc))
                 return
             payload = body.encode()
-            self.send_response(200 if ok else 503)
+            # a route may return an explicit int status (the tracing routes'
+            # 404-shaped JSON); bool keeps the probe semantics (ok -> 200/503)
+            status = ok if isinstance(ok, int) and not isinstance(ok, bool) else (200 if ok else 503)
+            self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()

@@ -32,6 +32,7 @@ from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
 from .logsetup import configure as configure_logging, get_logger, set_level
 from .metrics import REGISTRY
+from .tracing import TRACER
 from .utils.options import Options
 
 log = get_logger("runtime")
@@ -71,6 +72,11 @@ class Runtime:
 
     def __post_init__(self):
         configure_logging(self.options.log_level)
+        if self.options.enable_tracing:
+            # the process-wide tracer (tracing.py): spans from every
+            # controller pass land in one bounded ring served over
+            # /debug/traces on the metrics port
+            TRACER.enable(capacity=self.options.trace_ring_size)
         self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree
@@ -145,6 +151,24 @@ class Runtime:
             "karpenter_allocation_controller_scheduling_duration_seconds",
             "Duration of provisioning scheduling rounds",
         )
+        # one observation (and one span, when tracing is on) per controller
+        # pass — the controller-runtime reconcile histogram analog; the
+        # provisioning controller feeds the same family from its own round
+        self.reconcile_duration = REGISTRY.histogram(
+            "karpenter_reconcile_duration_seconds",
+            "Duration of controller reconcile passes",
+            ("controller",),
+        )
+
+    def _pass(self, controller: str, fn):
+        """One reconcile pass of one controller: a span (trace root when no
+        ambient trace) + the per-controller duration histogram. Idle passes
+        (no child spans) are not retained — at ~3 empty traces/sec from the
+        lifecycle loop they would evict every interesting trace from the
+        bounded ring within minutes; the histogram still observes them."""
+        with TRACER.span("reconcile", controller=controller, drop_childless=True):
+            with self.reconcile_duration.time(controller=controller):
+                return fn()
 
     # -- health --------------------------------------------------------------
 
@@ -199,29 +223,31 @@ class Runtime:
 
     def _lifecycle_loop(self) -> None:
         while not self._stop.wait(timeout=1.0):
-            self.node_controller.reconcile_all()
-            self.termination.reconcile_all()
-            self.counter.reconcile_all()
+            self._pass("node", self.node_controller.reconcile_all)
+            self._pass("termination", self.termination.reconcile_all)
+            self._pass("counter", self.counter.reconcile_all)
 
     def _consolidation_loop(self) -> None:
         while not self._stop.wait(timeout=ConsolidationController.POLL_INTERVAL):
             if self.consolidation.should_run():
-                self.consolidation.process_cluster()
+                self._pass("consolidation", self.consolidation.process_cluster)
 
     def _metrics_loop(self) -> None:
         while not self._stop.wait(timeout=5.0):
-            self.pod_metrics.scrape()
-            self.provisioner_metrics.scrape()
-            self.node_metrics.scrape()
+            self._pass("pod-metrics", self.pod_metrics.scrape)
+            self._pass("provisioner-metrics", self.provisioner_metrics.scrape)
+            self._pass("node-metrics", self.node_metrics.scrape)
 
     def _pricing_loop(self) -> None:
         while not self._stop.wait(timeout=self.options.pricing_refresh_period):
-            self.refresh_pricing_once()
+            self._pass("pricing", self.refresh_pricing_once)
 
     def _interruption_loop(self) -> None:
         # the receive itself long-polls (wait_seconds) while the transport
         # is healthy; a failed receive (-1) returns instantly, so THAT path
-        # waits the full poll interval — otherwise an outage hot-spins
+        # waits the full poll interval — otherwise an outage hot-spins.
+        # (No _pass wrapper here: the long poll would drown the histogram in
+        # idle waits; the controller spans/times each handled notice itself.)
         while not self._stop.is_set():
             received = self.interruption.poll_once(wait_seconds=self.options.interruption_poll_interval)
             pause = self.options.interruption_poll_interval if received < 0 else 0.05
@@ -247,15 +273,15 @@ class Runtime:
     def reconcile_once(self) -> None:
         """One pass of every non-provisioning controller."""
         if self.interruption is not None:
-            self.interruption.poll_once()
-        self.node_controller.reconcile_all()
-        self.termination.reconcile_all()
-        self.counter.reconcile_all()
+            self._pass("interruption", self.interruption.poll_once)
+        self._pass("node", self.node_controller.reconcile_all)
+        self._pass("termination", self.termination.reconcile_all)
+        self._pass("counter", self.counter.reconcile_all)
         if self.consolidation.should_run():
-            self.consolidation.process_cluster()
-        self.pod_metrics.scrape()
-        self.provisioner_metrics.scrape()
-        self.node_metrics.scrape()
+            self._pass("consolidation", self.consolidation.process_cluster)
+        self._pass("pod-metrics", self.pod_metrics.scrape)
+        self._pass("provisioner-metrics", self.provisioner_metrics.scrape)
+        self._pass("node-metrics", self.node_metrics.scrape)
 
     def provision_once(self):
         from .profiling import maybe_profile_round

@@ -55,6 +55,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
 from ..ir.encode import DenseProblem, GroupKind, catalog_key, catalog_pin, encode_catalog, encode_problem, resource_vector
+from ..tracing import TRACER
 from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
@@ -340,6 +341,7 @@ class DenseSolver:
         self._view_free_memo.clear()
         self._view_accepts_memo.clear()
 
+        assemble_before = self.stats.assemble_seconds  # delta -> this solve's assemble child span
         t0 = time.perf_counter()
         zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
         capacity_types = scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ())
@@ -442,6 +444,19 @@ class DenseSolver:
         leftover.extend(problem.pods[row] for row in fallback_rows)
         self.stats.pods_committed += committed
         self.stats.pods_to_host += len(leftover)
+        if TRACER.enabled:
+            # the measured phase boundaries as completed child spans under the
+            # ambient solve span (tracing.py record_span): the per-solve half
+            # of the DenseSolveStats story, so device vs host time is visible
+            # per trace, not just aggregated per bench run
+            TRACER.record_span("encode", t0, t_encoded - t0, {"pods": problem.P, "groups": len(problem.groups)})
+            TRACER.record_span("fill", t_encoded, t1 - t_encoded, {"on_existing": existing_committed})
+            device_ctx = TRACER.record_span("device", t1, t2 - t1, {"buckets": len(buckets)})
+            assemble = self.stats.assemble_seconds - assemble_before
+            if assemble > 0 and device_ctx is not None:
+                # host-side assembly hidden under the device round trip
+                TRACER.record_span("assemble", max(t1, t2 - assemble), assemble, parent=device_ctx)
+            TRACER.record_span("commit", t2, t3 - t2, {"committed": committed, "to_host": len(leftover)})
         return leftover
 
     @staticmethod

@@ -25,6 +25,11 @@ class Options:
     kube_client_qps: float = 200.0
     kube_client_burst: int = 300
     enable_profiling: bool = False
+    # decision tracing (tracing.py): spans per controller pass + per-pod
+    # decision records, served on /debug/traces and /debug/decisions over
+    # the metrics port. Off by default — disabled tracing is a true no-op
+    enable_tracing: bool = False
+    trace_ring_size: int = 256  # completed traces retained (bounded ring)
     leader_elect: bool = True
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -67,6 +72,8 @@ class Options:
             errs.append("pricing refresh period must be positive")
         if self.interruption_poll_interval <= 0:
             errs.append("interruption poll interval must be positive")
+        if self.trace_ring_size <= 0:
+            errs.append("trace ring size must be positive")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -94,6 +101,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--kube-client-qps", type=float, default=_env("KUBE_CLIENT_QPS", defaults.kube_client_qps))
     parser.add_argument("--kube-client-burst", type=int, default=_env("KUBE_CLIENT_BURST", defaults.kube_client_burst))
     parser.add_argument("--enable-profiling", action="store_true", default=_env("ENABLE_PROFILING", defaults.enable_profiling))
+    parser.add_argument("--enable-tracing", action="store_true", default=_env("ENABLE_TRACING", defaults.enable_tracing))
+    parser.add_argument("--trace-ring-size", type=int, default=_env("TRACE_RING_SIZE", defaults.trace_ring_size))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
     parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))

@@ -24,6 +24,15 @@ def test_bench_smoke():
         assert info["pods"] > 0, name
         # the per-pod fill routing counters are part of the schema
         assert "fill_pods_vectorized" in info and "fill_pods_host" in info, name
+        # tracing regression gate: every config's solve emitted a non-empty
+        # span tree whose dense phase children are disjoint sub-intervals of
+        # the solve (encode+device+commit must not exceed the parent) — an
+        # empty tree here means tracing silently died in the pipeline
+        tree = info["span_tree"]
+        assert tree and tree["name"] == "solve", name
+        children = {c["name"]: c["duration_ms"] for c in tree["children"]}
+        assert {"encode", "device", "commit"} <= set(children), (name, sorted(children))
+        assert children["encode"] + children["device"] + children["commit"] <= tree["duration_ms"] + 1e-3, name
     # the repack shape exercised the vectorized warm fill specifically
     assert summary["repack"]["fills_vectorized"] >= 1
     assert summary["repack"]["fill_pods_vectorized"] >= 1

@@ -113,8 +113,114 @@ class TestObservabilityServer:
         (port,) = server.ports
         try:
             assert self._get(port, "/debug/pprof/profile")[0] == 404
+            assert self._get(port, "/debug/traces")[0] == 404, "tracing routes are opt-in (--enable-tracing)"
         finally:
             server.stop()
+
+
+class TestTracingRoutes:
+    """/debug/traces + /debug/decisions over the metrics listener — the
+    read surface cmd/controller.py wires behind --enable-tracing."""
+
+    def _get(self, port, path):
+        import urllib.error
+
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    @pytest.fixture
+    def server(self):
+        from karpenter_tpu import tracing
+
+        tracing.TRACER.enable()
+        tracing.TRACER.reset()
+        tracing.DECISIONS.reset()
+        server = ObservabilityServer(
+            healthy=lambda: True,
+            ready=lambda: True,
+            health_port=None,
+            metrics_port=0,
+            host="127.0.0.1",
+            registry=Registry(),
+            extra_routes=tracing.routes(),
+        )
+        server.start()
+        try:
+            yield server, server.ports[0]
+        finally:
+            server.stop()
+            tracing.TRACER.disable()
+            tracing.TRACER.reset()
+            tracing.DECISIONS.reset()
+
+    def test_empty_ring_serves_empty_index(self, server):
+        import json
+
+        _, port = server
+        code, body = self._get(port, "/debug/traces")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["traces"] == [] and payload["enabled"] is True
+
+    def test_unknown_trace_id_is_404_json_not_500(self, server):
+        import json
+
+        _, port = server
+        for path in ("/debug/traces?id=deadbeef", "/debug/traces?id=deadbeef&format=chrome"):
+            code, body = self._get(port, path)
+            assert code == 404, path
+            payload = json.loads(body)  # 404-shaped JSON, not an HTML error page
+            assert "error" in payload and payload["status"] == 404
+
+    def test_trace_fetch_and_chrome_export(self, server):
+        import json
+
+        from karpenter_tpu import tracing
+
+        _, port = server
+        with tracing.TRACER.span("provision"):
+            with tracing.TRACER.span("solve", pods=3):
+                pass
+        trace_id = tracing.TRACER.last_trace_id()
+
+        code, body = self._get(port, "/debug/traces")
+        index = json.loads(body)["traces"]
+        assert code == 200 and index[0]["trace_id"] == trace_id
+
+        code, body = self._get(port, f"/debug/traces?id={trace_id}")
+        assert code == 200
+        tree = json.loads(body)["root"]
+        assert tree["name"] == "provision" and tree["children"][0]["name"] == "solve"
+
+        code, body = self._get(port, f"/debug/traces?id={trace_id}&format=chrome")
+        assert code == 200
+        chrome = json.loads(body)  # valid JSON is the Perfetto-loadable bar
+        events = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+        ts = [e["ts"] for e in events]
+        assert len(events) == 2 and ts == sorted(ts), "ts fields must be monotonic"
+
+    def test_decisions_by_pod_and_404(self, server):
+        import json
+
+        from karpenter_tpu import tracing
+
+        _, port = server
+        tracing.DECISIONS.record(
+            tracing.DecisionRecord(pod="pod-x", outcome="placed-new", node="node-1", instance_type="it-1")
+        )
+        code, body = self._get(port, "/debug/decisions?pod=pod-x")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["records"][0]["node"] == "node-1"
+
+        code, body = self._get(port, "/debug/decisions?pod=missing")
+        assert code == 404 and "error" in json.loads(body)
+
+        code, body = self._get(port, "/debug/decisions")
+        assert code == 200 and json.loads(body)["records"][0]["pod"] == "pod-x"
 
 
 class TestWebhookSelfRegistration:

@@ -1,0 +1,369 @@
+"""Decision tracing: span trees across the pipeline + per-pod audit records.
+
+Covers the tracer core (ambient nesting, bounded ring, synthetic spans, the
+disabled-is-free guarantee), the end-to-end provisioning trace linkage the
+headline-drift postmortem asked for (one trace ID from batch through the
+dense phase children to launch/bind), the per-pod decision records, the
+bounded event recorder, and the gen_docs --check staleness gate.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from karpenter_tpu import tracing
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.tracing import DECISIONS, TRACER, DecisionLog, DecisionRecord, Tracer
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def global_tracing():
+    """Enable the process-wide tracer for one test, restoring the disabled
+    default (and draining rings) afterwards so other tests stay untraced."""
+    TRACER.enable()
+    TRACER.reset()
+    DECISIONS.reset()
+    yield TRACER
+    TRACER.disable()
+    TRACER.reset()
+    DECISIONS.reset()
+
+
+class TestTracerCore:
+    def test_nesting_and_ambient_parent(self):
+        t = Tracer()
+        t.enable()
+        with t.span("root") as root:
+            root.set(k="v")
+            with t.span("child"):
+                with t.span("grandchild", deep=True):
+                    pass
+            with t.span("sibling"):
+                pass
+        (entry,) = t.traces()
+        tree = t.span_tree(entry["trace_id"])
+        assert tree["name"] == "root" and tree["attributes"] == {"k": "v"}
+        assert [c["name"] for c in tree["children"]] == ["child", "sibling"]
+        assert [c["name"] for c in tree["children"][0]["children"]] == ["grandchild"]
+
+    def test_record_span_synthetic_children(self):
+        t = Tracer()
+        t.enable()
+        with t.span("solve"):
+            t0 = time.perf_counter()
+            ctx = t.record_span("device", t0, 0.25, {"buckets": 3})
+            t.record_span("assemble", t0 + 0.1, 0.1, parent=ctx)
+        tree = t.span_tree(t.last_trace_id())
+        device = tree["children"][0]
+        assert device["name"] == "device" and device["duration_ms"] == 250.0
+        assert device["children"][0]["name"] == "assemble"
+
+    def test_ring_bounds_and_dropped_counter(self):
+        t = Tracer()
+        t.enable(capacity=3)
+        before = tracing.TRACES_DROPPED.value()
+        for i in range(5):
+            with t.span(f"trace-{i}"):
+                pass
+        index = t.traces()
+        assert len(index) == 3
+        # newest first, oldest evicted
+        assert [e["root"] for e in index] == ["trace-4", "trace-3", "trace-2"]
+        assert tracing.TRACES_DROPPED.value() - before == 2
+        assert t.span_tree("nope") is None and t.export_chrome("nope") is None
+
+    def test_drop_childless_roots_skip_the_ring(self):
+        # the idle-reconcile case: an empty pass must not churn real traces
+        # out of the bounded ring (the histogram still observes it)
+        t = Tracer()
+        t.enable()
+        with t.span("reconcile", drop_childless=True):
+            pass
+        assert t.traces() == []
+        with t.span("reconcile", drop_childless=True):
+            with t.span("terminate"):
+                pass
+        (entry,) = t.traces()
+        assert entry["root"] == "reconcile" and entry["spans"] == 2
+
+    def test_disabled_is_a_true_noop(self):
+        t = Tracer()
+        with t.span("ignored") as sp:
+            sp.set(anything=1)  # the null span swallows attributes
+        assert t._ring is None, "disabled tracer must not allocate its ring"
+        assert t.current_context() is None
+        assert t.record_span("x", 0.0, 1.0) is None
+        assert t.traces() == []
+
+    def test_explicit_parent_crosses_threads(self):
+        import threading
+
+        t = Tracer()
+        t.enable()
+        with t.span("root"):
+            ctx = t.current_context()
+
+            def worker():
+                with t.span("worker-span", parent=ctx):
+                    pass
+
+            thread = threading.Thread(target=worker)
+            thread.start()
+            thread.join()
+        tree = t.span_tree(t.last_trace_id())
+        assert [c["name"] for c in tree["children"]] == ["worker-span"]
+
+    def test_chrome_export_monotonic_and_json(self):
+        t = Tracer()
+        t.enable()
+        with t.span("outer"):
+            with t.span("inner"):
+                pass
+        chrome = t.export_chrome(t.last_trace_id())
+        payload = json.loads(json.dumps(chrome))  # round-trips as strict JSON
+        events = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == 2
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts), "chrome export ts must be monotonic"
+        assert all(e["dur"] >= 1 for e in events)
+
+
+class TestPipelineTrace:
+    """The acceptance trace: one trace ID links batch, the dense phase
+    children (device time visible), and launch/bind."""
+
+    def test_provision_round_links_batch_solve_dense_launch_bind(self, global_tracing):
+        from karpenter_tpu.solver import DenseSolver
+        from tests.env import Environment
+        from tests.helpers import make_pod, make_provisioner
+
+        env = Environment(dense_solver=DenseSolver(min_batch=1))
+        env.kube.create(make_provisioner())
+        for _ in range(8):
+            env.kube.create(make_pod(requests={"cpu": 1, "memory": "1Gi"}))
+        env.provision()
+
+        trace_id = env.provisioner_controller.last_trace_id
+        assert trace_id, "a traced round must publish its trace id"
+        spans = TRACER.spans_of(trace_id)
+        assert spans and all(s.trace_id == trace_id for s in spans), "every span shares the trace ID"
+
+        tree = TRACER.span_tree(trace_id)
+        assert tree["name"] == "provision"
+        children = {c["name"]: c for c in tree["children"]}
+        assert {"batch", "solve", "launch"} <= set(children)
+        solve_children = {c["name"]: c for c in children["solve"]["children"]}
+        # the dense phase children: device vs host time visible per solve
+        assert {"encode", "fill", "device", "commit"} <= set(solve_children)
+        assert solve_children["device"]["duration_ms"] > 0
+        launch_children = [c["name"] for c in children["launch"]["children"]]
+        assert "launch-node" in launch_children and "bind" in launch_children
+        # phase children are sub-intervals of the solve
+        phase_sum = sum(solve_children[n]["duration_ms"] for n in ("encode", "device", "commit"))
+        assert phase_sum <= children["solve"]["duration_ms"] + 1e-3
+
+    def test_decision_records_name_node_and_instance_type(self, global_tracing):
+        from tests.env import Environment
+        from tests.helpers import make_pod, make_provisioner
+
+        env = Environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"})
+        env.kube.create(pod)
+        env.provision()
+
+        (record,) = DECISIONS.for_pod(pod.name)
+        assert record["outcome"] == "placed-new"
+        assert record["node"].startswith("fake-node-"), "launch must back-fill the real node name"
+        assert record["instance_type"], "the chosen instance type is part of the audit record"
+        assert record["trace_id"] == env.provisioner_controller.last_trace_id
+
+    def test_failed_pod_gets_rejection_counts(self, global_tracing):
+        from tests.env import Environment
+        from tests.helpers import make_pod, make_provisioner
+
+        env = Environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": 100000, "memory": "1Gi"})  # fits nothing
+        env.kube.create(pod)
+        results = env.provision()
+
+        assert results.unschedulable
+        (record,) = DECISIONS.for_pod(pod.name)
+        assert record["outcome"] == "failed"
+        assert record["error"]
+        assert sum(record["rejections"].values()) > 0, "rejections along the admission path are tallied"
+
+    def test_simulation_solves_record_no_decisions(self, global_tracing):
+        from karpenter_tpu.scheduler import SchedulerOptions
+        from tests.env import Environment
+        from tests.helpers import make_pod, make_provisioner
+
+        env = Environment()
+        env.kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": 1, "memory": "1Gi"})
+        env.kube.create(pod)
+        env.provisioner_controller.schedule([pod], [], opts=SchedulerOptions(simulation_mode=True))
+        assert len(DECISIONS) == 0, "what-if solves must not pollute the audit log"
+
+    def test_reconcile_duration_histogram_per_controller(self, global_tracing):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from karpenter_tpu.metrics import REGISTRY
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        rt = Runtime(
+            kube=KubeCluster(),
+            cloud_provider=FakeCloudProvider(instance_types(2)),
+            options=Options(leader_elect=False, dense_solver_enabled=False),
+        )
+        try:
+            hist = REGISTRY.get("karpenter_reconcile_duration_seconds")
+            before = {c: hist.count(controller=c) for c in ("node", "termination", "counter")}
+            rt.reconcile_once()
+            for controller, count in before.items():
+                assert hist.count(controller=controller) == count + 1, controller
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+
+
+class TestDecisionLog:
+    def test_ring_bound_and_eviction(self):
+        log = DecisionLog(capacity=3)
+        for i in range(5):
+            log.record(DecisionRecord(pod=f"p{i}", outcome="failed"))
+        assert len(log) == 3
+        assert log.for_pod("p0") == [] and log.for_pod("p1") == []
+        assert log.for_pod("p4")[0]["pod"] == "p4"
+        assert [r["pod"] for r in log.recent()] == ["p4", "p3", "p2"]
+
+    def test_update_node_backfills_matching_placeholder_only(self):
+        log = DecisionLog()
+        log.record(DecisionRecord(pod="a", outcome="placed-new", node="hostname-placeholder-1"))
+        log.record(DecisionRecord(pod="b", outcome="failed"))
+        log.update_node(["a", "b"], "real-node", "big-type", placeholder="hostname-placeholder-1")
+        assert log.for_pod("a")[0]["node"] == "real-node"
+        assert log.for_pod("a")[0]["instance_type"] == "big-type"
+        assert log.for_pod("b")[0]["node"] == "", "failed records are not rewritten"
+        # a launch fed by a simulation-mode solve (interruption re-solve)
+        # recorded no decisions: its back-fill must not touch the pod's
+        # earlier, already-backfilled record
+        log.update_node(["a"], "replacement-node", "other-type", placeholder="hostname-placeholder-9")
+        assert log.for_pod("a")[0]["node"] == "real-node", "mismatched placeholder must not rewrite history"
+
+
+class TestOverheadGuard:
+    """Tracing must stay cheap when on and FREE when off."""
+
+    PODS = 250
+
+    def _solve_once(self) -> float:
+        from karpenter_tpu.scheduler import build_scheduler
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from tests.helpers import make_pod, make_provisioner
+
+        provider = FakeCloudProvider(instance_types(20))
+        pods = [make_pod(requests={"cpu": 0.5, "memory": "512Mi"}) for _ in range(self.PODS)]
+        scheduler = build_scheduler([make_provisioner()], provider, pods)
+        start = time.perf_counter()
+        results = scheduler.solve(pods)
+        elapsed = time.perf_counter() - start
+        placed = sum(len(n.pods) for n in results.new_nodes) + sum(len(v.pods) for v in results.existing_nodes)
+        assert placed == self.PODS
+        return elapsed
+
+    def test_disabled_allocates_nothing(self):
+        from karpenter_tpu.scheduler import build_scheduler
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from tests.helpers import make_pod, make_provisioner
+
+        assert not TRACER.enabled
+        decisions_before = len(DECISIONS)
+        pods = [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(5)]
+        scheduler = build_scheduler([make_provisioner()], FakeCloudProvider(instance_types(5)), pods)
+        # no per-pod rejection state, no decision records: the no-op promise
+        assert scheduler._rejections is None
+        scheduler.solve(pods)
+        assert len(DECISIONS) == decisions_before
+        # and a never-enabled tracer holds no ring at all
+        assert Tracer()._ring is None
+
+    def test_enabled_overhead_within_bound(self, global_tracing):
+        # interleave to wash out warmup bias; the bound is deliberately
+        # generous — this is a regression tripwire for accidentally hooking
+        # per-pod hot paths, not a microbenchmark
+        untraced, traced = [], []
+        for _ in range(3):
+            TRACER.disable()
+            untraced.append(self._solve_once())
+            TRACER.enable()
+            traced.append(self._solve_once())
+        base, with_tracing = min(untraced), min(traced)
+        assert with_tracing <= base * 3.0 + 0.25, (
+            f"tracing overhead too high: {with_tracing * 1000:.1f}ms traced vs {base * 1000:.1f}ms untraced"
+        )
+
+
+class TestBoundedEvents:
+    def test_old_events_evicted_at_capacity(self):
+        from tests.helpers import make_pod
+
+        recorder = Recorder(capacity=5)
+        for i in range(8):
+            recorder.evict_pod(make_pod(name=f"pod-{i}"))
+        assert len(recorder.events) == 5
+        names = [e.object_name for e in recorder.events]
+        assert names == [f"pod-{i}" for i in range(3, 8)], "oldest events evicted first"
+        # of()/reset() semantics survive the ring
+        assert len(recorder.of("EvictPod")) == 5
+        recorder.reset()
+        assert len(recorder.events) == 0
+        recorder.evict_pod(make_pod(name="after-reset"))
+        assert [e.object_name for e in recorder.events] == ["after-reset"]
+
+    def test_dedupe_recorder_ring_bounded_too(self):
+        from karpenter_tpu.events import DedupeRecorder
+        from tests.helpers import make_pod
+
+        recorder = DedupeRecorder(Recorder(capacity=4), capacity=4)
+        for i in range(6):
+            recorder.evict_pod(make_pod(name=f"pod-{i}"))
+        assert len(recorder.events) == 4
+        assert len(recorder.inner.events) == 4
+
+
+class TestGenDocsCheck:
+    def test_check_passes_current_and_fails_stale(self, tmp_path):
+        """One subprocess (isolated registry): --check exits 0 against the
+        committed METRICS.md and 1 against a copy missing a family."""
+        stale = tmp_path / "METRICS-stale.md"
+        committed = (REPO_ROOT / "METRICS.md").read_text()
+        stale.write_text(
+            "\n".join(l for l in committed.splitlines() if "karpenter_reconcile_duration_seconds" not in l) + "\n"
+        )
+        code = (
+            "from karpenter_tpu.cmd import gen_docs\n"
+            f"ok = gen_docs.check({str(REPO_ROOT / 'METRICS.md')!r})\n"
+            f"bad = gen_docs.check({str(stale)!r})\n"
+            "print(f'ok={ok} bad={bad}')\n"
+            "raise SystemExit(0 if (ok == 0 and bad == 1) else 1)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+        assert "missing from" in proc.stderr, "the stale check names the missing family"
