@@ -24,8 +24,17 @@ PROVISIONER_NAME_LABEL = GROUP + "/provisioner-name"
 LABEL_CAPACITY_TYPE = GROUP + "/capacity-type"
 LABEL_NODE_INITIALIZED = GROUP + "/initialized"
 DO_NOT_EVICT_ANNOTATION = GROUP + "/do-not-evict"
+# the modern spelling of the eviction veto; the legacy do-not-evict spelling
+# stays honored everywhere the new one is (utils/pod.py has_do_not_disrupt)
+DO_NOT_DISRUPT_ANNOTATION = GROUP + "/do-not-disrupt"
 DO_NOT_CONSOLIDATE_ANNOTATION = GROUP + "/do-not-consolidate"
 EMPTINESS_TIMESTAMP_ANNOTATION = GROUP + "/emptiness-timestamp"
+# spec-hash of the launch template the node was created from (stamped by the
+# provider at launch); mismatch against the current Provisioner flags drift
+PROVISIONER_HASH_ANNOTATION = GROUP + "/provisioner-hash"
+# set by the disruption controller's drift method when the recorded hash no
+# longer matches the Provisioner + launch template
+DRIFTED_ANNOTATION = GROUP + "/drifted"
 TERMINATION_FINALIZER = GROUP + "/termination"
 
 # Node lifecycle taints (mirrors k8s well-known taints)

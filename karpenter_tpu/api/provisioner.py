@@ -30,6 +30,30 @@ class Consolidation:
 
 
 @dataclass
+class Budget:
+    """One disruption-rate budget: at most `nodes` (an int count like "5" or
+    a percentage like "10%") of the provisioner's nodes may be voluntarily
+    disrupted at once. With `schedule` (5-field cron, UTC) + `duration`
+    (seconds) the budget only applies inside the recurring window; without
+    them it applies always."""
+
+    nodes: str = "10%"
+    schedule: Optional[str] = None
+    duration: Optional[float] = None
+
+
+@dataclass
+class Disruption:
+    """spec.disruption: the provisioner's voluntary-disruption policy,
+    enforced atomically across every method (emptiness, expiration, drift,
+    consolidation) by the disruption orchestrator. The effective limit at
+    any instant is the MINIMUM across active budgets; no budgets means
+    unlimited."""
+
+    budgets: List[Budget] = field(default_factory=list)
+
+
+@dataclass
 class Limits:
     resources: Dict[str, float] = field(default_factory=dict)
 
@@ -55,6 +79,7 @@ class ProvisionerSpec:
     limits: Optional[Limits] = None
     weight: Optional[int] = None
     consolidation: Optional[Consolidation] = None
+    disruption: Optional[Disruption] = None
 
 
 @dataclass
@@ -89,6 +114,53 @@ def order_by_weight(provisioners: List[Provisioner]) -> List[Provisioner]:
 
 
 VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+
+
+def parse_budget_nodes(value: str):
+    """Parse a Budget.nodes value into ("percent", p) or ("count", n).
+    Raises ValueError with a human-readable message on malformed input."""
+    text = str(value).strip()
+    if text.endswith("%"):
+        body = text[:-1]
+        if not body.isdigit():
+            raise ValueError(f"budget nodes {value!r} is not a valid percentage; use e.g. \"10%\"")
+        pct = int(body)
+        if pct > 100:
+            raise ValueError(f"budget nodes {value!r} exceeds 100%")
+        return ("percent", pct)
+    if not text.isdigit():
+        raise ValueError(f"budget nodes {value!r} must be a non-negative integer (\"5\") or a percentage (\"10%\")")
+    return ("count", int(text))
+
+
+def validate_disruption(disruption: "Disruption") -> List[str]:
+    """spec.disruption rule set: nodes syntax, schedule/duration pairing,
+    cron syntax, and zero-node windows. A permanently-zero budget (nodes
+    "0" with no schedule) is rejected — it silently blocks every voluntary
+    method forever; per-pod karpenter.sh/do-not-disrupt or a scheduled
+    maintenance window is the intended spelling."""
+    from ..utils import cron
+
+    errs: List[str] = []
+    for i, budget in enumerate(disruption.budgets):
+        prefix = f"disruption.budgets[{i}]"
+        kind = number = None
+        try:
+            kind, number = parse_budget_nodes(budget.nodes)
+        except ValueError as e:
+            errs.append(f"{prefix}: {e}")
+        if (budget.schedule is None) != (budget.duration is None):
+            errs.append(f"{prefix}: schedule and duration must be set together (a window needs both)")
+        if budget.schedule is not None:
+            errs.extend(f"{prefix}: {e}" for e in cron.cron_errors(budget.schedule))
+        if budget.duration is not None and budget.duration <= 0:
+            errs.append(f"{prefix}: duration must be positive, got {budget.duration} (a zero-length window never applies)")
+        if kind is not None and number == 0 and budget.schedule is None:
+            errs.append(
+                f"{prefix}: nodes {budget.nodes!r} with no schedule blocks all voluntary disruption permanently; "
+                "scope it with a schedule + duration window, or use the karpenter.sh/do-not-disrupt pod annotation"
+            )
+    return errs
 
 
 def validate_requirement(req: NodeSelectorRequirement) -> List[str]:
@@ -190,4 +262,6 @@ def validate_provisioner(provisioner: Provisioner) -> List[str]:
         for name, value in spec.limits.resources.items():
             if value < 0:
                 errs.append(f"limits.resources[{name}] cannot be negative")
+    if spec.disruption is not None:
+        errs.extend(validate_disruption(spec.disruption))
     return errs

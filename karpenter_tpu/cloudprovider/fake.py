@@ -269,6 +269,9 @@ class FakeCloudProvider(CloudProvider):
         allocatable = res.subtract(capacity, it.overhead())
         return Node(
             metadata=ObjectMeta(name=name, namespace="", labels=labels,
+                                # the drift seam: record what this node was
+                                # launched from so config changes are detectable
+                                annotations={lbl.PROVISIONER_HASH_ANNOTATION: node_request.template.spec_hash()},
                                 finalizers=[lbl.TERMINATION_FINALIZER]),
             spec=NodeSpec(
                 taints=list(node_request.template.taints) + list(node_request.template.startup_taints),

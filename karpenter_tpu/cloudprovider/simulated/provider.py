@@ -326,7 +326,13 @@ class SimulatedCloudProvider(CloudProvider):
         capacity = dict(it.resources()) if it is not None else {}
         allocatable = res.clamp_negative_to_zero(res.subtract(capacity, it.overhead())) if it is not None else {}
         return Node(
-            metadata=ObjectMeta(name=name, namespace="", labels=labels, finalizers=[lbl.TERMINATION_FINALIZER]),
+            metadata=ObjectMeta(
+                name=name, namespace="", labels=labels,
+                # launch-template seam for drift detection: the spec-hash of
+                # the template this instance was actually launched from
+                annotations={lbl.PROVISIONER_HASH_ANNOTATION: node_request.template.spec_hash()},
+                finalizers=[lbl.TERMINATION_FINALIZER],
+            ),
             spec=NodeSpec(
                 taints=list(node_request.template.taints) + list(node_request.template.startup_taints),
                 provider_id=f"sim:///{instance.instance_id}",

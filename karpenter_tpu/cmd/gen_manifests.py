@@ -9,6 +9,7 @@ so the manifests cannot drift from the binaries.
 
     python -m karpenter_tpu.cmd.gen_manifests > deploy/karpenter-tpu.yaml
     python -m karpenter_tpu.cmd.gen_manifests --solver-sidecar --tpu-resource google.com/tpu=1
+    python -m karpenter_tpu.cmd.gen_manifests --check [dir]   # CI staleness gate
 
 Renders plain YAML (kubectl-appliable); parameterization covers what the
 chart's values.yaml exposes where it applies to this runtime.
@@ -85,6 +86,27 @@ def crd_provisioner() -> Dict:
         # [0, 100], matching the webhook's validate() (api/provisioner.py)
         "weight": {"type": "integer", "minimum": 0, "maximum": 100},
         "consolidation": {"type": "object", "properties": {"enabled": {"type": "boolean"}}},
+        # voluntary-disruption budgets enforced by the disruption
+        # orchestrator (controllers/disruption); the deep rule set — percent
+        # syntax, schedule/duration pairing, zero-node windows — runs in the
+        # validating webhook (api/provisioner.py validate_disruption)
+        "disruption": {
+            "type": "object",
+            "properties": {
+                "budgets": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["nodes"],
+                        "properties": {
+                            "nodes": {"type": "string"},
+                            "schedule": {"type": "string"},
+                            "duration": {"type": "number", "exclusiveMinimum": 0},
+                        },
+                    },
+                },
+            },
+        },
     }
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
@@ -439,7 +461,46 @@ def render(args) -> List[Dict]:
     return docs
 
 
+# the checked-in renders and the argv each was generated with — the source
+# of truth for both `--check` and tests/test_manifests.py's freshness pin
+CHECK_TARGETS = (
+    ("karpenter-tpu.yaml", ()),
+    ("karpenter-tpu-sidecar.yaml", ("--solver-sidecar", "--tpu-resource", "google.com/tpu=1", "--service-monitor")),
+)
+
+
+def check(directory: str = "deploy") -> int:
+    """Exit-code staleness gate, symmetrical to gen_docs --check: re-render
+    every committed manifest and diff; 0 when current, 1 (with the stale
+    paths and the regenerate command on stderr) when the generators moved —
+    e.g. a CRD schema key like disruption.budgets was added without
+    re-rendering."""
+    import io
+    import os
+    from contextlib import redirect_stdout
+
+    rc = 0
+    for filename, argv in CHECK_TARGETS:
+        path = os.path.join(directory, filename)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            main(list(argv))
+        if not os.path.exists(path):
+            print(f"gen_manifests --check: {path} does not exist; regenerate it:", file=sys.stderr)
+            rc = 1
+        elif open(path, encoding="utf-8").read() != buf.getvalue():
+            print(f"gen_manifests --check: {path} is stale against the generators; regenerate it:", file=sys.stderr)
+            rc = 1
+        else:
+            continue
+        print(f"  python -m karpenter_tpu.cmd.gen_manifests {' '.join(argv)} > {path}", file=sys.stderr)
+    return rc
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--check":
+        return check(argv[1] if len(argv) > 1 else "deploy")
     parser = argparse.ArgumentParser(prog="karpenter-tpu-gen-manifests", description=__doc__)
     parser.add_argument("--namespace", default="karpenter")
     parser.add_argument("--image", default="karpenter-tpu:latest")

@@ -33,8 +33,8 @@ from ...tracing import TRACER
 from ...utils import pod as podutils
 from ..state.cluster import Cluster, StateNode
 from ...logsetup import get_logger
+from ..disruption.eligibility import PDBLimits
 from .helpers import disruption_cost, lifetime_remaining
-from .pdblimits import PDBLimits
 
 log = get_logger("consolidation")
 
@@ -218,18 +218,24 @@ class ConsolidationController:
             self.perform(action)
             return action
 
-        pdb = PDBLimits(self.kube)
-        scored = sorted(candidates, key=lambda c: self._disruption_cost(c))
-        for candidate in scored:
+        candidate, action = self._first_beneficial_action(candidates, PDBLimits(self.kube))
+        if action.type != ActionType.NO_ACTION:
+            self.perform(action)
+        return action
+
+    def _first_beneficial_action(self, candidates, pdb: PDBLimits):
+        """The ascending-disruption-cost scan shared by standalone mode and
+        the orchestrator's propose(): the first candidate whose simulated
+        removal is beneficial wins (one non-empty action per pass). Returns
+        (candidate, action); candidate is None on NO_ACTION."""
+        for candidate in sorted(candidates, key=lambda c: self._disruption_cost(c)):
             pods = self.kube.pods_on_node(candidate.name)
-            reason = self._can_terminate(candidate, pods, pdb)
-            if reason is not None:
+            if self._can_terminate(candidate, pods, pdb) is not None:
                 continue
             action = self._replace_or_delete(candidate, pods)
             if action.type != ActionType.NO_ACTION:
-                self.perform(action)
-                return action
-        return ConsolidationAction(ActionType.NO_ACTION, reason="no beneficial action")
+                return candidate, action
+        return None, ConsolidationAction(ActionType.NO_ACTION, reason="no beneficial action")
 
     def _uninitialized_node_exists(self) -> bool:
         """An owned node still warming up blocks the pass (controller.go:196-203).
@@ -291,15 +297,67 @@ class ConsolidationController:
         return disruption_cost(pods, lifetime_remaining(self.clock, state.node, ttl))
 
     def _can_terminate(self, state: StateNode, pods, pdb: PDBLimits) -> Optional[str]:
-        reason = pdb.can_evict(pods)
-        if reason is not None:
-            return reason
-        for pod in pods:
-            if podutils.has_do_not_evict(pod):
-                return f"pod {pod.name} has do-not-evict"
-            if not podutils.is_owned(pod) and not podutils.is_owned_by_daemonset(pod):
-                return f"pod {pod.name} has no controller owner"
-        return None
+        # the gate shared with every other disruption method (eligibility.py):
+        # PDBs at their limit, do-not-disrupt/do-not-evict pods, ownerless pods
+        from ..disruption.eligibility import pod_ineligible_reason
+
+        return pod_ineligible_reason(pods, pdb)
+
+    # -- candidate-source mode (the disruption orchestrator) ---------------------
+
+    def propose(self, pdb: Optional[PDBLimits] = None, exclude: frozenset = frozenset()) -> list:
+        """Pure candidate-source mode: the same decision pipeline as
+        process_cluster — empty fast path, then the shared
+        _first_beneficial_action scan — but nothing is cordoned, launched,
+        or terminated here. The disruption orchestrator owns budgets, the
+        validated command queue, and execution; this method only PROPOSES.
+        `pdb` is the orchestrator's per-pass shared PDB snapshot (built here
+        only when called standalone); `exclude` is its busy set, filtered
+        BEFORE any simulation so queued candidates are not re-solved."""
+        from ..disruption.methods import METHOD_CONSOLIDATION, DisruptionCommand
+
+        self.metrics.evaluations += 1
+        with self.metrics._eval_duration.time():
+            if self._uninitialized_node_exists():
+                return []
+            candidates = [c for c in self.candidate_nodes() if c.name not in exclude]
+            if not candidates:
+                return []
+            commands = []
+            empty = [c for c in candidates if self._is_empty(c)]
+            if empty:
+                # ONE command per node, not one grouped command: a command
+                # larger than the provisioner's budget could never clear the
+                # in_flight + len(nodes) <= limit gate and would livelock in
+                # the queue; per-node commands let the budget pace them
+                for c in empty:
+                    commands.append(
+                        DisruptionCommand(
+                            method=METHOD_CONSOLIDATION,
+                            nodes=[c.node],
+                            provisioner_name=c.node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, ""),
+                            reason="empty nodes",
+                            created_at=self.clock.now(),
+                            # the decision is ONLY sound while the node holds
+                            # no reschedulable pods; execution must re-check
+                            require_empty=True,
+                        )
+                    )
+                return commands
+            candidate, action = self._first_beneficial_action(candidates, pdb or PDBLimits(self.kube))
+            if action.type != ActionType.NO_ACTION:
+                commands.append(
+                    DisruptionCommand(
+                        method=METHOD_CONSOLIDATION,
+                        nodes=action.nodes,
+                        provisioner_name=candidate.node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, ""),
+                        reason=action.reason,
+                        replacements=[action.replacement] if action.replacement is not None else [],
+                        candidate_price=self._node_price(candidate) if action.replacement is not None else None,
+                        created_at=self.clock.now(),
+                    )
+                )
+            return commands
 
     # -- the simulated scheduling decision --------------------------------------
 

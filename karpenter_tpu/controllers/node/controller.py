@@ -33,13 +33,20 @@ log = get_logger("node")
 
 
 class NodeController:
-    def __init__(self, kube: KubeCluster, cluster: Cluster, provider=None, clock=None):
+    def __init__(self, kube: KubeCluster, cluster: Cluster, provider=None, clock=None, delegate_disruption: bool = False):
         from ...utils.clock import Clock
 
         self.kube = kube
         self.cluster = cluster
         self.provider = provider
         self.clock = clock or kube.clock or Clock()
+        # when the disruption orchestrator owns voluntary disruption
+        # (runtime.py wires this True), emptiness/expiration become pure
+        # candidate SOURCES: this controller keeps stamping/clearing the
+        # emptiness timestamp — the signal the orchestrator's emptiness
+        # method consumes — but no longer deletes nodes itself, so every
+        # voluntary deletion flows through budgets and the command queue
+        self.delegate_disruption = delegate_disruption
 
     def reconcile_all(self) -> None:
         for node in list(self.kube.list_nodes()):
@@ -57,8 +64,9 @@ class NodeController:
         changed |= self._emptiness(node, provisioner)
         if changed:
             self.kube.update(node)
-        self._expiration(node, provisioner)
-        self._empty_ttl_delete(node, provisioner)
+        if not self.delegate_disruption:
+            self._expiration(node, provisioner)
+            self._empty_ttl_delete(node, provisioner)
 
     def _provisioner_of(self, node: Node) -> Optional[Provisioner]:
         name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL)

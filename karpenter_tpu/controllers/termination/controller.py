@@ -97,8 +97,10 @@ class TerminationController:
             if not pod.metadata.owner_references:
                 self.recorder.node_failed_to_drain(node, f"pod {pod.name} does not have any owner references")
                 return False
-            if podutils.has_do_not_evict(pod):
-                self.recorder.node_failed_to_drain(node, f"pod {pod.name} has do-not-evict")
+            if podutils.has_do_not_disrupt(pod):
+                # both spellings: karpenter.sh/do-not-disrupt and the legacy
+                # karpenter.sh/do-not-evict block a drain identically
+                self.recorder.node_failed_to_drain(node, f"pod {pod.name} has do-not-evict/do-not-disrupt")
                 return False
             if not self._obstructs_deletion(pod):
                 continue

@@ -20,6 +20,7 @@ from typing import Deque, Dict, Optional, Set
 from ...api.objects import Pod
 from ...events import Recorder
 from ...kube.cluster import KubeCluster
+from ...utils import pod as podutils
 
 
 class EvictionQueue:
@@ -79,8 +80,16 @@ class EvictionQueue:
                     self._queue.append(pod)
                     continue
             attempts += 1
-            if self.kube.get("Pod", pod.name, pod.namespace) is None:
+            current = self.kube.get("Pod", pod.name, pod.namespace)
+            if current is None:
                 self._forget(pod)  # 404: already gone counts as evicted (eviction.go:100-102)
+                continue
+            if podutils.has_do_not_disrupt(current) and not podutils.is_terminal(current):
+                # the disruption veto (karpenter.sh/do-not-disrupt, legacy
+                # do-not-evict): surfaced as a blocked-eviction reason — an
+                # involuntary drain must not retry it silently forever
+                self.recorder.eviction_blocked(current, "pod has karpenter.sh/do-not-disrupt")
+                self._requeue_failed(pod, now)
                 continue
             if self.kube.evict_pod(pod):
                 self.recorder.evict_pod(pod)

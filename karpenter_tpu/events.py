@@ -58,6 +58,12 @@ class Recorder:
     def waiting_on_readiness(self, node) -> None:
         self._record("Node", "WaitingOnReadiness", "Waiting on readiness to continue consolidation", node.name)
 
+    def eviction_blocked(self, pod, reason: str) -> None:
+        """A queued eviction that cannot proceed (do-not-disrupt veto):
+        surfaced instead of silently retrying forever; identical repeats
+        dedupe through DedupeRecorder's TTL window."""
+        self._record("Pod", "EvictionBlocked", f"Eviction blocked, {reason}", pod.name)
+
     # interruption-subsystem events (controllers/interruption); identical
     # notices dedupe through DedupeRecorder's TTL window
     def node_interrupted(self, node, kind: str, message: str) -> None:

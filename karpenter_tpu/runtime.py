@@ -107,12 +107,31 @@ class Runtime:
             remote_solver=remote_solver, clock=self.kube.clock,
         )
         self.reconciler = ProvisioningReconciler(self.kube, self.provisioner)
-        self.node_controller = NodeController(self.kube, self.cluster, self.cloud_provider, clock=self.kube.clock)
+        self.node_controller = NodeController(
+            self.kube, self.cluster, self.cloud_provider, clock=self.kube.clock,
+            # with the disruption orchestrator on, emptiness/expiration are
+            # pure candidate sources — the orchestrator owns every voluntary
+            # deletion (budgets + the validated command queue)
+            delegate_disruption=self.options.disruption_enabled,
+        )
         self.termination = TerminationController(self.kube, self.cloud_provider, self.recorder, clock=self.kube.clock)
         self.counter = CounterController(self.kube, self.cluster)
         self.consolidation = ConsolidationController(
             self.kube, self.cluster, self.cloud_provider, self.provisioner, self.recorder, clock=self.kube.clock
         )
+        # the unified disruption orchestrator: consolidation participates as
+        # a candidate source; the orchestrator owns budgets, validation, and
+        # execution of ALL voluntary disruption (interruption stays separate
+        # — involuntary capacity loss is never budget-limited)
+        self.disruption = None
+        if self.options.disruption_enabled:
+            from .controllers.disruption import DisruptionController
+
+            self.disruption = DisruptionController(
+                self.kube, self.cluster, self.cloud_provider, self.provisioner,
+                consolidation=self.consolidation, termination=self.termination,
+                recorder=self.recorder, clock=self.kube.clock,
+            )
         # interruption subsystem: enabled by --interruption-queue against a
         # provider that exposes a notification source (the metrics decorator
         # forwards notification_source to the inner provider); the reference
@@ -196,7 +215,13 @@ class Runtime:
         )
         self.provisioner.start()
         self._spawn(self._lifecycle_loop, "node-lifecycle")
-        self._spawn(self._consolidation_loop, "consolidation")
+        if self.disruption is not None:
+            # the orchestrator loop REPLACES the consolidation loop: the
+            # consolidation controller still evaluates, but as a candidate
+            # source inside the orchestrator's budgeted, validated pass
+            self._spawn(self._disruption_loop, "disruption")
+        else:
+            self._spawn(self._consolidation_loop, "consolidation")
         self._spawn(self._metrics_loop, "metrics-scraper")
         # leader-only by construction: start() blocks on leadership above,
         # so followers never reach this spawn — the election gating of the
@@ -231,6 +256,12 @@ class Runtime:
         while not self._stop.wait(timeout=ConsolidationController.POLL_INTERVAL):
             if self.consolidation.should_run():
                 self._pass("consolidation", self.consolidation.process_cluster)
+
+    def _disruption_loop(self) -> None:
+        from .controllers.disruption import DisruptionController
+
+        while not self._stop.wait(timeout=DisruptionController.POLL_INTERVAL):
+            self._pass("disruption", self.disruption.reconcile)
 
     def _metrics_loop(self) -> None:
         while not self._stop.wait(timeout=5.0):
@@ -277,7 +308,9 @@ class Runtime:
         self._pass("node", self.node_controller.reconcile_all)
         self._pass("termination", self.termination.reconcile_all)
         self._pass("counter", self.counter.reconcile_all)
-        if self.consolidation.should_run():
+        if self.disruption is not None:
+            self._pass("disruption", self.disruption.reconcile)
+        elif self.consolidation.should_run():
             self._pass("consolidation", self.consolidation.process_cluster)
         self._pass("pod-metrics", self.pod_metrics.scrape)
         self._pass("provisioner-metrics", self.provisioner_metrics.scrape)

@@ -255,6 +255,7 @@ class VirtualNode:
             startup_taints=template.startup_taints,
             requirements=requirements,
             kubelet_configuration=template.kubelet_configuration,
+            stamped_hash=template.stamped_hash,
         )
         requirements.add(Requirement(lbl.LABEL_HOSTNAME, OP_IN, hostname))
         node.topology = topology

@@ -223,6 +223,46 @@ class Tracer:
                 stack.pop()
             self._store(sp, complete_trace=is_root, drop_childless=is_root and drop_childless)
 
+    def open_span(self, name: str, parent: Optional[Tuple[str, str]] = None, **attrs) -> Optional[Span]:
+        """Open a span WITHOUT entering the ambient stack — for operations
+        whose lifetime crosses reconcile passes (a disruption command:
+        validate this pass, drain-handoff several passes later). The trace
+        stays in-flight until close_span() on the root; children attach by
+        passing ctx_of(span) as an explicit parent. Unlike span(), the
+        AMBIENT span is deliberately NOT inherited — a cross-pass operation
+        must outlive whatever reconcile pass happened to start it, so with
+        no explicit parent it roots its own trace. Returns None (and every
+        related call no-ops) when tracing is disabled."""
+        if not self.enabled:
+            return None
+        sp = Span(
+            trace_id=parent[0] if parent else _new_id(),
+            span_id=_new_id(),
+            parent_id=parent[1] if parent else None,
+            name=name,
+            start=_now(),
+            attributes=dict(attrs) if attrs else {},
+            thread=threading.current_thread().name,
+        )
+        sp._start_mono = time.perf_counter()  # type: ignore[attr-defined]
+        if sp.parent_id is None:
+            self._open_trace(sp.trace_id)
+        return sp
+
+    def close_span(self, sp: Optional[Span], **attrs) -> None:
+        """Complete a span from open_span(); a root completion moves the
+        whole trace into the ring."""
+        if sp is None or not self.enabled:
+            return
+        if attrs:
+            sp.attributes.update(attrs)
+        sp.duration = time.perf_counter() - getattr(sp, "_start_mono", time.perf_counter())
+        self._store(sp, complete_trace=sp.parent_id is None)
+
+    @staticmethod
+    def ctx_of(sp: Optional[Span]) -> Optional[Tuple[str, str]]:
+        return (sp.trace_id, sp.span_id) if sp is not None else None
+
     def record_span(
         self,
         name: str,

@@ -53,6 +53,12 @@ class Options:
     # long-poll wait per receive; the loop re-polls immediately after a
     # non-empty batch, so this only paces the idle case
     interruption_poll_interval: float = 2.0
+    # the unified disruption orchestrator (controllers/disruption): owns all
+    # voluntary disruption — emptiness, expiration, drift, consolidation —
+    # behind per-provisioner budgets and a validated command queue. Disabling
+    # falls back to the legacy per-controller paths (consolidation loop +
+    # node-controller TTL deletes) with no budgets or drift detection
+    disruption_enabled: bool = True
     # URL of a Kubernetes apiserver (http://host:port). Empty = the in-memory
     # simulation backend; set (or KUBERNETES_APISERVER_URL) = the real-protocol
     # HTTP client (kube/client.py) with the QPS/burst budget above
@@ -115,6 +121,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--pricing-refresh-period", type=float, default=_env("PRICING_REFRESH_PERIOD", defaults.pricing_refresh_period))
     parser.add_argument("--interruption-queue", dest="interruption_queue", default=_env("INTERRUPTION_QUEUE", defaults.interruption_queue))
     parser.add_argument("--interruption-poll-interval", type=float, default=_env("INTERRUPTION_POLL_INTERVAL", defaults.interruption_poll_interval))
+    parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))

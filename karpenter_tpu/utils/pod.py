@@ -74,6 +74,14 @@ def has_do_not_evict(pod: Pod) -> bool:
     return pod.metadata.annotations.get(lbl.DO_NOT_EVICT_ANNOTATION) == "true"
 
 
+def has_do_not_disrupt(pod: Pod) -> bool:
+    """The disruption veto, honoring both the karpenter.sh/do-not-disrupt
+    spelling and the legacy karpenter.sh/do-not-evict one — a pod carrying
+    either makes its node ineligible for voluntary disruption and surfaces
+    as a blocked-eviction reason on involuntary drains."""
+    return pod.metadata.annotations.get(lbl.DO_NOT_DISRUPT_ANNOTATION) == "true" or has_do_not_evict(pod)
+
+
 def has_required_pod_affinity(pod: Pod) -> bool:
     return bool(
         pod.spec.affinity

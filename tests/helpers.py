@@ -33,7 +33,7 @@ from karpenter_tpu.api.objects import (
     Volume,
     PersistentVolumeClaimVolumeSource,
 )
-from karpenter_tpu.api.provisioner import Consolidation, Limits, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.provisioner import Budget, Consolidation, Disruption, Limits, Provisioner, ProvisionerSpec
 from karpenter_tpu.utils.quantity import parse_quantity
 
 _counter = itertools.count(1)
@@ -135,6 +135,7 @@ def make_provisioner(
     consolidation_enabled: Optional[bool] = None,
     provider: Optional[dict] = None,
     kubelet_configuration=None,
+    budgets: Optional[List[Budget]] = None,
 ) -> Provisioner:
     spec = ProvisionerSpec(
         labels=dict(labels or {}),
@@ -148,6 +149,7 @@ def make_provisioner(
         consolidation=Consolidation(enabled=consolidation_enabled) if consolidation_enabled is not None else None,
         provider=provider,
         kubelet_configuration=kubelet_configuration,
+        disruption=Disruption(budgets=list(budgets)) if budgets is not None else None,
     )
     return Provisioner(metadata=ObjectMeta(name=name, namespace=""), spec=spec)
 

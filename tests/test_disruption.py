@@ -1,0 +1,536 @@
+"""Unified disruption orchestrator tests.
+
+Scenario catalog for controllers/disruption: budget math + schedule windows
+(budgets.py / utils/cron.py), spec.disruption admission validation, the
+spec-hash drift seam (provider-stamped karpenter.sh/provisioner-hash),
+method flows through the serialized validated command queue (emptiness,
+expiration, drift, consolidation-as-source), launch-before-drain replacement
+discipline, budget atomicity, the shared do-not-disrupt eligibility gate,
+eviction-queue veto surfacing, and the disrupt -> validate ->
+launch-replacement -> drain-handoff trace chain.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu import webhooks
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference
+from karpenter_tpu.api.provisioner import Budget, Disruption, validate_disruption
+from karpenter_tpu.cloudprovider.fake import instance_type, instance_types
+from karpenter_tpu.controllers.consolidation import ConsolidationController
+from karpenter_tpu.controllers.disruption import (
+    METHOD_CONSOLIDATION,
+    METHOD_DRIFT,
+    METHOD_EMPTINESS,
+    METHOD_EXPIRATION,
+    OUTCOME_DISRUPTED,
+    OUTCOME_INVALIDATED,
+    BudgetTracker,
+    DisruptionCommand,
+    DisruptionController,
+    allowed_disruptions,
+    budget_limit,
+)
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.tracing import TRACER
+from karpenter_tpu.utils import cron
+from tests.env import Environment
+from tests.helpers import make_pod, make_provisioner
+
+
+def owned_pod(**kwargs):
+    pod = make_pod(**kwargs)
+    pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+    return pod
+
+
+class DisruptionEnv(Environment):
+    """DeprovEnv-analog wired for the orchestrator: the node controller
+    delegates disruption (it stamps emptiness but never deletes), and all
+    voluntary disruption flows through DisruptionController."""
+
+    def __init__(self, provisioners=None, instance_types_list=None):
+        super().__init__(instance_types=instance_types_list)
+        for prov in provisioners or [make_provisioner()]:
+            self.kube.create(prov)
+        self.node_controller = NodeController(
+            self.kube, self.cluster, self.provider, clock=self.clock, delegate_disruption=True
+        )
+        self.termination_controller = TerminationController(self.kube, self.provider, self.recorder, clock=self.clock)
+        self.consolidation = ConsolidationController(
+            self.kube, self.cluster, self.provider, self.provisioner_controller, self.recorder, clock=self.clock
+        )
+        self.disruption = DisruptionController(
+            self.kube, self.cluster, self.provider, self.provisioner_controller,
+            consolidation=self.consolidation, termination=self.termination_controller,
+            recorder=self.recorder, clock=self.clock,
+        )
+
+    def launch_node_with_pods(self, *pods):
+        for pod in pods:
+            self.kube.create(pod)
+        self.provision()
+        self.bind_nominated()
+        self.node_controller.reconcile_all()
+        self.clock.step(self.cluster.nomination_ttl + 1)
+        return self.kube.list_nodes()
+
+    def tick(self):
+        """One deterministic runtime tick: lifecycle -> disruption -> drain."""
+        self.node_controller.reconcile_all()
+        self.disruption.reconcile()
+        self.termination_controller.reconcile_all()
+
+
+class TestBudgetMath:
+    def test_budget_limit_percent_floors(self):
+        assert budget_limit(Budget(nodes="10%"), 100) == 10
+        assert budget_limit(Budget(nodes="10%"), 19) == 1
+        assert budget_limit(Budget(nodes="10%"), 5) == 0
+
+    def test_budget_limit_count(self):
+        assert budget_limit(Budget(nodes="5"), 100) == 5
+        assert budget_limit(Budget(nodes="0", schedule="* * * * *", duration=60.0), 100) == 0
+
+    def test_allowed_is_min_across_active_budgets(self):
+        prov = make_provisioner(budgets=[Budget(nodes="50%"), Budget(nodes="3")])
+        assert allowed_disruptions(prov, 100, now=1000.0) == 3
+
+    def test_no_budgets_is_unlimited(self):
+        assert allowed_disruptions(make_provisioner(), 100, now=1000.0) is None
+        assert allowed_disruptions(make_provisioner(budgets=[]), 100, now=1000.0) is None
+
+    def test_inactive_window_does_not_apply(self):
+        # FakeClock epoch 1000s = 1970-01-01T00:16 UTC; a 09:00 window is closed
+        prov = make_provisioner(budgets=[Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)])
+        assert allowed_disruptions(prov, 100, now=1000.0) is None
+        # at 09:30 the window is open and the zero-node budget bites
+        at_0930 = 9.5 * 3600
+        assert allowed_disruptions(prov, 100, now=at_0930) == 0
+
+    def test_tracker_atomic_charge_release(self):
+        tracker = BudgetTracker()
+        assert tracker.try_charge("default", "n1", 2)
+        assert tracker.try_charge("default", "n1", 2)  # idempotent
+        assert tracker.try_charge("default", "n2", 2)
+        assert not tracker.try_charge("default", "n3", 2)  # at the limit
+        tracker.release("default", "n1")
+        assert tracker.try_charge("default", "n3", 2)
+        assert tracker.in_flight("default") == 2
+
+
+class TestCron:
+    def test_cron_errors(self):
+        assert cron.cron_errors("* * * * *") == []
+        assert cron.cron_errors("*/15 9-17 * * 1-5") == []
+        assert cron.cron_errors("0 9 * *") != []  # 4 fields
+        assert cron.cron_errors("61 * * * *") != []  # minute out of range
+        assert cron.cron_errors("x * * * *") != []
+
+    def test_dom_dow_or_semantics(self):
+        from datetime import datetime, timezone
+
+        # standard cron: both restricted -> EITHER matches (vixie semantics)
+        monday_not_15th = datetime(2026, 8, 3, 0, 0, tzinfo=timezone.utc)  # a Monday
+        the_15th_not_monday = datetime(2026, 8, 15, 0, 0, tzinfo=timezone.utc)  # a Saturday
+        neither = datetime(2026, 8, 4, 0, 0, tzinfo=timezone.utc)  # Tuesday the 4th
+        assert cron.matches("0 0 15 * 1", monday_not_15th)
+        assert cron.matches("0 0 15 * 1", the_15th_not_monday)
+        assert not cron.matches("0 0 15 * 1", neither)
+        # only one restricted: plain AND with the wildcard
+        assert cron.matches("0 0 15 * *", the_15th_not_monday)
+        assert not cron.matches("0 0 15 * *", monday_not_15th)
+
+    def test_window_active(self):
+        # every-minute schedule: always active for any positive duration
+        assert cron.window_active("* * * * *", 60.0, 1000.0)
+        # daily 09:00 window, one hour: 09:30 in, 11:00 out
+        assert cron.window_active("0 9 * * *", 3600.0, 9.5 * 3600)
+        assert not cron.window_active("0 9 * * *", 3600.0, 11 * 3600)
+
+
+class TestBudgetValidation:
+    def test_valid_budgets_pass(self):
+        d = Disruption(budgets=[Budget(nodes="10%"), Budget(nodes="5"), Budget(nodes="0", schedule="0 9 * * 1-5", duration=3600.0)])
+        assert validate_disruption(d) == []
+
+    def test_malformed_nodes_rejected(self):
+        for nodes in ("ten", "-1", "10 %", "%", ""):
+            errs = validate_disruption(Disruption(budgets=[Budget(nodes=nodes)]))
+            assert errs and "budget nodes" in errs[0], nodes
+
+    def test_over_100_percent_rejected(self):
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="150%")]))
+        assert any("exceeds 100%" in e for e in errs)
+
+    def test_schedule_and_duration_must_pair(self):
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="10%", schedule="0 9 * * *")]))
+        assert any("set together" in e for e in errs)
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="10%", duration=3600.0)]))
+        assert any("set together" in e for e in errs)
+
+    def test_bad_cron_rejected(self):
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="10%", schedule="99 9 * * *", duration=60.0)]))
+        assert any("invalid minute field" in e for e in errs)
+
+    def test_zero_length_window_rejected(self):
+        errs = validate_disruption(Disruption(budgets=[Budget(nodes="10%", schedule="0 9 * * *", duration=0.0)]))
+        assert any("zero-length window" in e for e in errs)
+
+    def test_permanent_zero_budget_rejected(self):
+        for nodes in ("0", "0%"):
+            errs = validate_disruption(Disruption(budgets=[Budget(nodes=nodes)]))
+            assert any("blocks all voluntary disruption permanently" in e for e in errs), nodes
+
+    def test_webhook_rejects_invalid_budgets(self):
+        kube = KubeCluster()
+        webhooks.register(kube)
+        with pytest.raises(webhooks.AdmissionError, match="budget nodes"):
+            kube.create(make_provisioner(budgets=[Budget(nodes="lots")]))
+        kube.create(make_provisioner(name="ok", budgets=[Budget(nodes="10%")]))
+
+
+class TestSpecHashSeam:
+    def test_launched_nodes_carry_provisioner_hash(self):
+        env = DisruptionEnv()
+        nodes = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        prov = env.kube.list_provisioners()[0]
+        expected = NodeTemplate.from_provisioner(prov).spec_hash()
+        assert nodes[0].metadata.annotations.get(lbl.PROVISIONER_HASH_ANNOTATION) == expected
+
+    def test_hash_is_stable_and_spec_sensitive(self):
+        prov = make_provisioner()
+        h1 = NodeTemplate.from_provisioner(prov).spec_hash()
+        assert h1 == NodeTemplate.from_provisioner(prov).spec_hash()
+        prov.spec.labels["team"] = "search"
+        assert NodeTemplate.from_provisioner(prov).spec_hash() != h1
+
+    def test_hash_survives_scheduler_tightening(self):
+        # the stamp is the BASE provisioner hash even though the launched
+        # node's template carried tightened (e.g. zone-pinned) requirements
+        env = DisruptionEnv(instance_types_list=instance_types(5))
+        nodes = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        prov = env.kube.list_provisioners()[0]
+        assert nodes[0].metadata.annotations[lbl.PROVISIONER_HASH_ANNOTATION] == NodeTemplate.from_provisioner(prov).spec_hash()
+
+
+class TestEmptinessMethod:
+    def test_empty_past_ttl_disrupted_through_queue(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.node_controller.reconcile_all()  # stamps the emptiness timestamp
+        env.clock.step(31)
+        # the delegating node controller does NOT delete on its own
+        env.node_controller.reconcile_all()
+        assert len(env.kube.list_nodes()) == 1
+        env.tick()
+        assert env.kube.list_nodes() == []
+        assert env.disruption.commands.value(method=METHOD_EMPTINESS, outcome=OUTCOME_DISRUPTED) >= 1
+
+    def test_command_invalidated_when_node_repopulates(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30)])
+        pod = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.node_controller.reconcile_all()
+        env.clock.step(31)
+        # enqueue the command, then repopulate the node before execution:
+        # the just-before-execution re-validation must catch it
+        from karpenter_tpu.controllers.disruption.eligibility import PDBLimits
+
+        env.disruption._propose(PDBLimits(env.kube))
+        assert len(env.disruption._queue) == 1
+        late = owned_pod(node_name=nodes[0].name, unschedulable=False, phase="Running")
+        env.kube.create(late)
+        env.disruption._drain_queue(PDBLimits(env.kube))
+        assert env.disruption.commands.value(method=METHOD_EMPTINESS, outcome=OUTCOME_INVALIDATED) >= 1
+        assert len(env.kube.list_nodes()) == 1  # survived
+
+
+class TestExpirationMethod:
+    def test_expired_node_replaced_before_drain(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        pod = owned_pod(requests={"cpu": "1"})
+        old = env.launch_node_with_pods(pod)[0]
+        env.clock.step(3601)
+        env.disruption.reconcile()  # proposes + launches the replacement, parks
+        names = [n.name for n in env.kube.list_nodes()]
+        assert old.name in names and len(names) == 2, "replacement launched BEFORE the old node is drained"
+        assert not env.kube.get_node(old.name).spec.unschedulable, "no cordon until the replacement initializes"
+        env.tick()  # initializes the replacement -> cordon + drain handoff
+        names = [n.name for n in env.kube.list_nodes()]
+        assert old.name not in names and len(names) == 1
+        assert env.disruption.commands.value(method=METHOD_EXPIRATION, outcome=OUTCOME_DISRUPTED) >= 1
+
+
+class TestDriftMethod:
+    def _drift(self, env):
+        prov = env.kube.list_provisioners()[0]
+        prov.spec.labels["fleet-generation"] = "v2"
+        env.kube.update(prov)
+
+    def test_drifted_node_flagged_and_replaced_after_replacement_initialized(self):
+        env = DisruptionEnv()
+        pod = owned_pod(requests={"cpu": "1"})
+        old = env.launch_node_with_pods(pod)[0]
+        self._drift(env)
+        env.disruption.reconcile()
+        node = env.kube.get_node(old.name)
+        assert node.metadata.annotations.get(lbl.DRIFTED_ANNOTATION) == "true"
+        assert len(env.kube.list_nodes()) == 2  # replacement up, old untouched
+        assert not env.kube.get_node(old.name).spec.unschedulable
+        env.tick()
+        assert env.kube.get_node(old.name) is None
+        survivors = env.kube.list_nodes()
+        assert len(survivors) == 1
+        # the replacement carries the CURRENT hash and the new label
+        prov = env.kube.list_provisioners()[0]
+        assert survivors[0].metadata.annotations[lbl.PROVISIONER_HASH_ANNOTATION] == NodeTemplate.from_provisioner(prov).spec_hash()
+        assert env.disruption.commands.value(method=METHOD_DRIFT, outcome=OUTCOME_DISRUPTED) >= 1
+
+    def test_unhashed_node_never_flagged(self):
+        env = DisruptionEnv()
+        old = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))[0]
+        del old.metadata.annotations[lbl.PROVISIONER_HASH_ANNOTATION]
+        env.kube.update(old)
+        self._drift(env)
+        env.tick()
+        node = env.kube.get_node(old.name)
+        assert node is not None and lbl.DRIFTED_ANNOTATION not in node.metadata.annotations
+
+    def test_reverted_provisioner_clears_drift_flag(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(budgets=[Budget(nodes="0", schedule="* * * * *", duration=3600.0)])])
+        old = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))[0]
+        prov = env.kube.list_provisioners()[0]
+        prov.spec.labels["fleet-generation"] = "v2"
+        env.kube.update(prov)
+        env.disruption.reconcile()  # flags; zero budget blocks execution
+        assert env.kube.get_node(old.name).metadata.annotations.get(lbl.DRIFTED_ANNOTATION) == "true"
+        del prov.spec.labels["fleet-generation"]
+        env.kube.update(prov)
+        env.disruption.reconcile()
+        assert lbl.DRIFTED_ANNOTATION not in env.kube.get_node(old.name).metadata.annotations
+
+
+class TestBudgets:
+    def test_budget_serializes_disruption(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_after_empty=30, budgets=[Budget(nodes="1")])])
+        p1, p2 = owned_pod(requests={"cpu": "12"}), owned_pod(requests={"cpu": "12"})
+        env.launch_node_with_pods(p1)
+        env.launch_node_with_pods(p2)
+        assert len(env.kube.list_nodes()) == 2
+        for pod in (p1, p2):
+            env.kube.delete(pod, grace=False)
+        env.node_controller.reconcile_all()
+        env.clock.step(31)
+        env.disruption.reconcile()
+        env.termination_controller.reconcile_all()
+        # budget nodes=1: exactly one node disrupted this pass, one blocked
+        assert len(env.kube.list_nodes()) == 1
+        assert env.disruption.budget_blocked.value(provisioner="default") >= 1
+        # the blocked command sleeps its backoff before retrying
+        env.clock.step(DisruptionController.BUDGET_RETRY_PERIOD + 1)
+        env.tick()  # charge released (node gone) -> the second proceeds
+        assert env.kube.list_nodes() == []
+
+    def test_do_not_disrupt_pod_makes_node_ineligible(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        pod = owned_pod(requests={"cpu": "1"}, annotations={lbl.DO_NOT_DISRUPT_ANNOTATION: "true"})
+        old = env.launch_node_with_pods(pod)[0]
+        # the commands counter family is registry-global: assert the delta
+        before = env.disruption.commands.value(method=METHOD_EXPIRATION, outcome=OUTCOME_DISRUPTED)
+        env.clock.step(3601)
+        for _ in range(3):
+            env.tick()
+        assert env.kube.get_node(old.name) is not None
+        assert env.disruption.commands.value(method=METHOD_EXPIRATION, outcome=OUTCOME_DISRUPTED) == before
+
+    def test_legacy_do_not_evict_spelling_honored(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        pod = owned_pod(requests={"cpu": "1"}, annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"})
+        old = env.launch_node_with_pods(pod)[0]
+        env.clock.step(3601)
+        env.tick()
+        assert env.kube.get_node(old.name) is not None
+
+
+class TestConsolidationSource:
+    def test_orchestrator_consolidates_empty_node(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(consolidation_enabled=True)])
+        pod = owned_pod(requests={"cpu": "1"})
+        env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.clock.step(400)
+        env.tick()
+        assert env.kube.list_nodes() == []
+        assert env.disruption.commands.value(method=METHOD_CONSOLIDATION, outcome=OUTCOME_DISRUPTED) >= 1
+
+    def test_empty_fleet_larger_than_budget_drains_without_livelock(self):
+        """Consolidation's empty path emits per-node commands, so an empty
+        fleet larger than the budget is paced through it instead of one
+        grouped command livelocking against the in-flight limit forever."""
+        env = DisruptionEnv(
+            provisioners=[make_provisioner(consolidation_enabled=True, budgets=[Budget(nodes="1")])]
+        )
+        pods = [owned_pod(requests={"cpu": "12"}) for _ in range(3)]
+        for pod in pods:
+            env.launch_node_with_pods(pod)
+        assert len(env.kube.list_nodes()) == 3
+        for pod in pods:
+            env.kube.delete(pod, grace=False)
+        env.clock.step(400)
+        for _ in range(8):
+            env.tick()
+            env.clock.step(DisruptionController.BUDGET_RETRY_PERIOD + 1)
+            if not env.kube.list_nodes():
+                break
+        assert env.kube.list_nodes() == [], "every empty node must drain through the budget"
+
+    def test_expired_uninitialized_node_is_reclaimed(self):
+        """The legacy node-controller path expired nodes regardless of
+        initialization; the expiration method must too, or a launch that
+        never initializes leaks past its TTL forever."""
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        env.kube.create(owned_pod(requests={"cpu": "1"}))
+        env.provision()  # NO node-controller pass: the node stays uninitialized
+        node = env.kube.list_nodes()[0]
+        assert node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) != "true"
+        env.clock.step(3601 + env.cluster.nomination_ttl)
+        env.disruption.reconcile()
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(node.name) is None
+
+    def test_replace_price_revalidated_non_increasing(self):
+        from karpenter_tpu.cloudprovider.types import Offering
+
+        od = [Offering(capacity_type="on-demand", zone="test-zone-1")]
+        env = DisruptionEnv(
+            provisioners=[make_provisioner(consolidation_enabled=True)],
+            instance_types_list=[
+                instance_type("big", cpu=16, memory="32Gi", price=10.0, offerings=od),
+                instance_type("small", cpu=2, memory="4Gi", price=1.0, offerings=od),
+            ],
+        )
+        pod = owned_pod(requests={"cpu": "8"})
+        env.launch_node_with_pods(pod)
+        pod.spec.containers[0].resources.requests["cpu"] = 0.5
+        env.kube.update(pod)
+        env.clock.step(400)
+        from karpenter_tpu.controllers.disruption.eligibility import PDBLimits
+
+        env.disruption._propose(PDBLimits(env.kube))
+        commands = list(env.disruption._queue)
+        assert len(commands) == 1 and commands[0].replacements
+        # the market moved between decision and execution: the recorded
+        # candidate price now undercuts every replacement option
+        commands[0].candidate_price = 0.01
+        env.disruption._drain_queue(PDBLimits(env.kube))
+        assert env.disruption.commands.value(method=METHOD_CONSOLIDATION, outcome=OUTCOME_INVALIDATED) >= 1
+        assert len(env.kube.list_nodes()) == 1  # nothing launched or drained
+
+
+class TestPostWaitRevalidation:
+    def test_veto_arriving_during_replacement_wait_voids_the_command(self):
+        """The initialization wait can last minutes: a do-not-disrupt pod
+        landing on the still-schedulable candidate must void the command
+        (and reap the launched replacement) instead of wedging a drain."""
+        env = DisruptionEnv(provisioners=[make_provisioner(ttl_seconds_until_expired=3600)])
+        pod = owned_pod(requests={"cpu": "1"})
+        old = env.launch_node_with_pods(pod)[0]
+        env.clock.step(3601)
+        env.disruption.reconcile()  # launches the replacement, parks
+        assert env.disruption._pending is not None
+        replacement_names = list(env.disruption._pending.launched)
+        vetoed = owned_pod(
+            node_name=old.name, unschedulable=False, phase="Running",
+            annotations={lbl.DO_NOT_DISRUPT_ANNOTATION: "true"},
+        )
+        env.kube.create(vetoed)
+        before = env.disruption.commands.value(method=METHOD_EXPIRATION, outcome=OUTCOME_INVALIDATED)
+        env.tick()  # replacement initializes -> post-wait re-validation fires
+        env.termination_controller.reconcile_all()
+        assert env.kube.get_node(old.name) is not None, "the vetoed candidate must survive"
+        assert not env.kube.get_node(old.name).spec.unschedulable
+        assert env.disruption.commands.value(method=METHOD_EXPIRATION, outcome=OUTCOME_INVALIDATED) == before + 1
+        for name in replacement_names:  # the unneeded launch is reaped, not leaked
+            assert env.kube.get_node(name) is None
+        assert env.disruption.tracker.total_in_flight() == 0, "the budget charge must be released"
+
+    def test_consolidation_empty_command_rechecks_emptiness(self):
+        env = DisruptionEnv(provisioners=[make_provisioner(consolidation_enabled=True)])
+        pod = owned_pod(requests={"cpu": "1"})
+        nodes = env.launch_node_with_pods(pod)
+        env.kube.delete(pod, grace=False)
+        env.clock.step(400)
+        from karpenter_tpu.controllers.disruption.eligibility import PDBLimits
+
+        env.disruption._propose(PDBLimits(env.kube))
+        commands = list(env.disruption._queue)
+        assert len(commands) == 1 and commands[0].method == METHOD_CONSOLIDATION and commands[0].require_empty
+        # pods land before execution: the empty decision is void
+        env.kube.create(owned_pod(node_name=nodes[0].name, unschedulable=False, phase="Running"))
+        before = env.disruption.commands.value(method=METHOD_CONSOLIDATION, outcome=OUTCOME_INVALIDATED)
+        env.disruption._drain_queue(PDBLimits(env.kube))
+        assert env.disruption.commands.value(method=METHOD_CONSOLIDATION, outcome=OUTCOME_INVALIDATED) == before + 1
+        assert env.kube.get_node(nodes[0].name) is not None
+
+
+class TestEvictionVetoSurfacing:
+    def test_do_not_disrupt_surfaces_blocked_eviction(self):
+        env = DisruptionEnv()
+        nodes = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        blocked = owned_pod(
+            node_name=nodes[0].name, unschedulable=False, phase="Running",
+            annotations={lbl.DO_NOT_DISRUPT_ANNOTATION: "true"},
+        )
+        env.kube.create(blocked)
+        queue = env.termination_controller.eviction_queue
+        queue.add(blocked)
+        assert queue.drain_once() == 0
+        assert env.recorder.of("EvictionBlocked"), "veto must surface, not silently retry"
+        assert env.kube.get("Pod", blocked.name, blocked.namespace) is not None
+
+    def test_legacy_spelling_surfaces_too(self):
+        env = DisruptionEnv()
+        nodes = env.launch_node_with_pods(owned_pod(requests={"cpu": "1"}))
+        blocked = owned_pod(
+            node_name=nodes[0].name, unschedulable=False, phase="Running",
+            annotations={lbl.DO_NOT_EVICT_ANNOTATION: "true"},
+        )
+        env.kube.create(blocked)
+        queue = env.termination_controller.eviction_queue
+        queue.add(blocked)
+        assert queue.drain_once() == 0
+        assert env.recorder.of("EvictionBlocked")
+
+
+class TestTraceChain:
+    def test_drift_chain_is_one_trace(self):
+        TRACER.enable(capacity=64)
+        TRACER.reset()
+        try:
+            env = DisruptionEnv()
+            pod = owned_pod(requests={"cpu": "1"})
+            env.launch_node_with_pods(pod)
+            prov = env.kube.list_provisioners()[0]
+            prov.spec.labels["fleet-generation"] = "v2"
+            env.kube.update(prov)
+            env.disruption.reconcile()  # validate + launch-replacement (root stays open)
+            env.tick()  # initialization -> drain-handoff -> root completes
+            disrupt_traces = [t for t in TRACER.traces() if t["root"] == "disrupt"]
+            assert disrupt_traces, "the command must complete as one trace"
+            tree = TRACER.span_tree(disrupt_traces[0]["trace_id"])
+            assert tree["name"] == "disrupt"
+            children = [c["name"] for c in tree["children"]]
+            assert children == ["validate", "launch-replacement", "drain-handoff"]
+            assert tree["attributes"]["outcome"] == OUTCOME_DISRUPTED
+        finally:
+            TRACER.reset()
+            TRACER.disable()

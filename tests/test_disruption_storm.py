@@ -1,0 +1,298 @@
+"""Disruption storm tier: the budget invariant under simultaneous pressure.
+
+Marked `slow` (excluded from tier-1). 100 nodes under one provisioner with
+`disruption.budgets: [{nodes: "10%"}]`, hit simultaneously with three kinds
+of voluntary candidates — 30 empty past ttlSecondsAfterEmpty, 30 expired
+past ttlSecondsUntilExpired, 20 drifted (stale provisioner-hash) — plus a
+live spot-interruption notice injected while the budget is saturated.
+
+Contract (ISSUE 4 acceptance):
+
+  - at no point are more than 10 nodes simultaneously cordoned/deleting by
+    VOLUNTARY methods (checked every step, two ways: the orchestrator's own
+    ledger and an independent cluster scan);
+  - zero lost pods: the 70-replica workload ends fully bound to live nodes
+    (a ReplicaSet/scheduler stand-in recreates and binds, as in the
+    interruption storm);
+  - the involuntary interruption drain proceeds while the voluntary budget
+    is exhausted — it is never budget-blocked;
+  - a drifted node's full chain (disrupt -> validate -> launch-replacement
+    -> drain-handoff) completes as ONE trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import OwnerReference
+from karpenter_tpu.api.provisioner import Budget
+from karpenter_tpu.cloudprovider.fake import instance_type
+from karpenter_tpu.controllers.disruption import OUTCOME_DISRUPTED
+from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.tracing import TRACER
+from tests.helpers import make_node, make_pod, make_provisioner
+from tests.test_disruption import DisruptionEnv
+
+POD_CPU = 0.8
+# the drifted nodes run pods too big for any one-cpu node's slack, so their
+# re-simulation MUST open fresh capacity — the launch-before-drain chain is
+# exercised, not just delete-with-reuse
+BIG_POD_CPU = 1.8
+N_EMPTY = 30
+N_EXPIRED = 30
+N_DRIFTED = 20
+N_STABLE = 20
+DESIRED_SMALL = N_EXPIRED + N_STABLE  # 50 small replicas
+DESIRED_BIG = N_DRIFTED  # 20 big replicas
+DESIRED_PODS = DESIRED_SMALL + DESIRED_BIG  # 70: empty nodes hold none
+BUDGET_CAP = 10  # 10% of 100
+MAX_STEPS = 300
+
+
+def _workload_pod(node_name: str = "", big: bool = False):
+    pod = make_pod(
+        requests={"cpu": BIG_POD_CPU if big else POD_CPU},
+        labels={"app": "storm-big" if big else "storm"},
+        node_name=node_name,
+        phase="Running" if node_name else "Pending",
+        unschedulable=not node_name,
+    )
+    pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="storm-big-rs" if big else "storm-rs"))
+    return pod
+
+
+@dataclass
+class StubMessage:
+    body: dict
+    message_id: str = "storm-notice-1"
+    receipt_handle: str = "rh-1"
+
+
+@dataclass
+class StubQueue:
+    messages: list = field(default_factory=list)
+    deleted: list = field(default_factory=list)
+
+    def receive_messages(self, max_messages=10, wait_seconds=0.0):
+        out, self.messages = self.messages[:max_messages], self.messages[max_messages:]
+        return out
+
+    def delete_message(self, receipt_handle):
+        self.deleted.append(receipt_handle)
+        return True
+
+    def dead_letter_depth(self):
+        return 0
+
+
+def _live_pods(kube):
+    return [p for p in kube.list_pods() if p.status.phase not in ("Succeeded", "Failed")]
+
+
+def _stand_in_tick(env):
+    """Kubelet/scheduler/ReplicaSet stand-in: bind pending pods first-fit
+    onto schedulable live capacity, keep the workload at DESIRED_PODS."""
+    pending = [p for p in _live_pods(env.kube) if not p.spec.node_name]
+    if pending:
+        usable = []
+        for node in env.kube.list_nodes():
+            if node.spec.unschedulable or node.metadata.deletion_timestamp is not None:
+                continue
+            used = sum(
+                sum(c.resources.requests.get("cpu", 0.0) for c in p.spec.containers)
+                for p in env.kube.pods_on_node(node.name)
+            )
+            usable.append([node, node.status.allocatable.get("cpu", 0.0) - used])
+        still_pending = []
+        for pod in pending:
+            need = sum(c.resources.requests.get("cpu", 0.0) for c in pod.spec.containers)
+            for slot in usable:
+                if slot[1] >= need:
+                    env.kube.bind_pod(pod, slot[0].name)
+                    slot[1] -= need
+                    break
+            else:
+                still_pending.append(pod)
+        if still_pending:
+            # no slack anywhere: the provisioning loop's job
+            env.provision()
+            env.bind_nominated()
+    live = _live_pods(env.kube)
+    small = sum(1 for p in live if p.metadata.labels.get("app") == "storm")
+    big = sum(1 for p in live if p.metadata.labels.get("app") == "storm-big")
+    for _ in range(max(0, DESIRED_SMALL - small)):
+        env.kube.create(_workload_pod())
+    for _ in range(max(0, DESIRED_BIG - big)):
+        env.kube.create(_workload_pod(big=True))
+
+
+def _voluntary_cordons(env, interruption_victims):
+    """Independent invariant probe: nodes cordoned or deleting that are NOT
+    attributable to the involuntary interruption path."""
+    count = 0
+    for node in env.kube.list_nodes():
+        if node.name in interruption_victims:
+            continue
+        if any(t.key == lbl.TAINT_INTERRUPTION for t in node.spec.taints):
+            continue
+        if node.spec.unschedulable or node.metadata.deletion_timestamp is not None:
+            count += 1
+    return count
+
+
+@pytest.mark.slow
+def test_disruption_storm_budget_invariant():
+    env = DisruptionEnv(
+        provisioners=[
+            make_provisioner(
+                ttl_seconds_after_empty=30,
+                ttl_seconds_until_expired=3600,
+                budgets=[Budget(nodes="10%")],
+            )
+        ],
+        instance_types_list=[
+            instance_type("one-cpu", cpu=1, memory="2Gi", pods=10),
+            instance_type("two-cpu", cpu=2, memory="4Gi", pods=10),
+        ],
+    )
+    prov = env.kube.list_provisioners()[0]
+    current_hash = NodeTemplate.from_provisioner(prov).spec_hash()
+    TRACER.enable(capacity=4096)
+    TRACER.reset()
+    try:
+        # -- 100 hand-built nodes: 30 empty, 30 expired, 20 drifted, 20 stable
+        groups = [("empty", N_EMPTY), ("expired", N_EXPIRED), ("drifted", N_DRIFTED), ("stable", N_STABLE)]
+        names = {}
+        for kind, count in groups:
+            names[kind] = []
+            for i in range(count):
+                big = kind == "drifted"
+                node = make_node(
+                    name=f"{kind}-{i:03d}",
+                    labels={
+                        lbl.PROVISIONER_NAME_LABEL: prov.name,
+                        lbl.LABEL_INSTANCE_TYPE: "two-cpu" if big else "one-cpu",
+                        lbl.LABEL_CAPACITY_TYPE: "on-demand",
+                        lbl.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+                        lbl.LABEL_NODE_INITIALIZED: "true",
+                        lbl.LABEL_HOSTNAME: f"{kind}-{i:03d}",
+                    },
+                    allocatable={"cpu": 1.9 if big else 0.9, "memory": "4Gi" if big else "2Gi", "pods": 10},
+                )
+                node.metadata.annotations[lbl.PROVISIONER_HASH_ANNOTATION] = (
+                    "stale-hash" if kind == "drifted" else current_hash
+                )
+                node.spec.provider_id = f"fake:///{node.name}"
+                env.kube.create(node)
+                if kind == "expired":
+                    node.metadata.creation_timestamp = env.clock.now() - 4000  # ttl 3600: expired
+                    env.kube.update(node)
+                if kind != "empty":
+                    env.kube.create(_workload_pod(node.name, big=big))
+                names[kind].append(node.name)
+        assert len(env.kube.list_nodes()) == 100
+        assert len(_live_pods(env.kube)) == DESIRED_PODS
+
+        # the involuntary notice, injected once the voluntary budget saturates
+        queue = StubQueue()
+        interruption = InterruptionController(
+            env.kube, env.cluster, env.provisioner_controller, queue,
+            termination=env.termination_controller, recorder=env.recorder, clock=env.clock,
+        )
+        victim = names["stable"][0]
+        notice_sent = False
+        victim_drained_while_saturated = False
+        drift_chain_trace = None
+        max_voluntary_seen = 0
+
+        env.node_controller.reconcile_all()  # finalizers + emptiness stamps
+        env.clock.step(31)  # the emptiness TTL elapses
+
+        for step in range(MAX_STEPS):
+            env.node_controller.reconcile_all()
+            env.disruption.reconcile()
+            saturated = env.disruption.tracker.total_in_flight() >= BUDGET_CAP - 1
+            if not notice_sent and saturated:
+                queue.messages.append(
+                    StubMessage(body={"kind": "spot_interruption", "instance_id": victim, "deadline": env.clock.now() + 120})
+                )
+                interruption.poll_once()
+                # never budget-blocked: the victim is cordoned + handed to
+                # termination in the SAME tick the notice arrives, with the
+                # voluntary ledger at capacity
+                gone_or_draining = env.kube.get_node(victim)
+                assert gone_or_draining is None or gone_or_draining.metadata.deletion_timestamp is not None, (
+                    f"interruption drain was blocked at step {step} with voluntary in-flight="
+                    f"{env.disruption.tracker.total_in_flight()}"
+                )
+                notice_sent = True
+            env.termination_controller.reconcile_all()
+            if notice_sent and not victim_drained_while_saturated and env.kube.get_node(victim) is None:
+                victim_drained_while_saturated = True
+            _stand_in_tick(env)
+
+            # -- the invariant, every step, both probes -----------------------
+            voluntary = env.disruption.tracker.total_in_flight()
+            max_voluntary_seen = max(max_voluntary_seen, voluntary)
+            assert voluntary <= BUDGET_CAP, f"ledger exceeded the 10% budget at step {step}: {voluntary}"
+            independent = _voluntary_cordons(env, {victim})
+            assert independent <= BUDGET_CAP, f"cluster scan found {independent} voluntary cordons at step {step}"
+
+            if drift_chain_trace is None:
+                for trace in TRACER.traces():
+                    if trace["root"] != "disrupt":
+                        continue
+                    tree = TRACER.span_tree(trace["trace_id"])
+                    if tree and tree["attributes"].get("method") == "drift" and tree["attributes"].get("outcome") == OUTCOME_DISRUPTED:
+                        child_names = [c["name"] for c in tree["children"]]
+                        if "launch-replacement" in child_names and "drain-handoff" in child_names:
+                            drift_chain_trace = trace["trace_id"]
+                            break
+            env.clock.step(1)
+
+            nodes = env.kube.list_nodes()
+            # an originally-empty node that absorbed an evicted pod is a
+            # legitimate survivor; one still empty must eventually go
+            empties_settled = all(
+                n.name not in set(names["empty"]) or env.kube.pods_on_node(n.name) for n in nodes
+            )
+            done = (
+                notice_sent
+                and empties_settled
+                and not any(n.name in set(names["expired"]) | set(names["drifted"]) for n in nodes)
+                and all(p.spec.node_name for p in _live_pods(env.kube))
+                and len(_live_pods(env.kube)) == DESIRED_PODS
+                and env.disruption.tracker.total_in_flight() == 0
+                and not env.disruption._queue
+            )
+            if done:
+                break
+
+        # -- convergence ------------------------------------------------------
+        nodes = env.kube.list_nodes()
+        survivors = {n.name for n in nodes}
+        for name in survivors & set(names["empty"]):
+            assert env.kube.pods_on_node(name), f"{name} is still empty yet was never reclaimed"
+        assert not survivors & set(names["expired"]), "expired nodes must all be rotated"
+        assert not survivors & set(names["drifted"]), "drifted nodes must all be replaced"
+        assert victim not in survivors, "the interruption victim must be drained"
+        assert victim_drained_while_saturated, "the involuntary drain must complete despite the saturated budget"
+        assert max_voluntary_seen > 0, "the storm must actually exercise the budget"
+        # zero lost pods: full replica count, every pod on a live node
+        pods = _live_pods(env.kube)
+        assert len(pods) == DESIRED_PODS
+        for pod in pods:
+            assert pod.spec.node_name and env.kube.get_node(pod.spec.node_name) is not None
+        # no survivor is drifted: every node carries the CURRENT spec hash
+        for node in nodes:
+            recorded = node.metadata.annotations.get(lbl.PROVISIONER_HASH_ANNOTATION)
+            assert recorded == current_hash, f"{node.name} still drifted"
+        # the full drift chain completed as one trace (the /debug/traces view)
+        assert drift_chain_trace is not None, "no drift command completed as a single disrupt trace"
+    finally:
+        TRACER.reset()
+        TRACER.disable()

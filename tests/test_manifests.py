@@ -149,6 +149,38 @@ class TestManifestBundle:
         lease_verbs = {v for rule in role["rules"] if "leases" in rule["resources"] for v in rule["verbs"]}
         assert {"create", "update"} <= lease_verbs, "Lease leader election needs CAS writes"
 
+    def test_crd_schema_covers_disruption_budgets(self):
+        docs = render(_args())
+        crd = next(d for d in by_kind(docs, "CustomResourceDefinition") if d["metadata"]["name"] == "provisioners.karpenter.sh")
+        spec_props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        budget = spec_props["disruption"]["properties"]["budgets"]["items"]
+        assert budget["required"] == ["nodes"]
+        assert set(budget["properties"]) == {"nodes", "schedule", "duration"}
+
+    def test_check_mode_subprocess(self, tmp_path):
+        # the CI staleness gate, symmetrical to gen_docs --check: current
+        # renders exit 0; a stale committed file exits 1 naming the path
+        import pathlib
+        import shutil
+        import subprocess
+        import sys
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        current = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.cmd.gen_manifests", "--check"],
+            cwd=repo, capture_output=True, text=True,
+        )
+        assert current.returncode == 0, current.stderr
+        stale_dir = tmp_path / "deploy"
+        shutil.copytree(repo / "deploy", stale_dir)
+        (stale_dir / "karpenter-tpu.yaml").write_text("# stale\n")
+        stale = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.cmd.gen_manifests", "--check", str(stale_dir)],
+            cwd=repo, capture_output=True, text=True,
+        )
+        assert stale.returncode == 1
+        assert "karpenter-tpu.yaml is stale" in stale.stderr
+
     def test_rendered_files_in_sync(self):
         # deploy/*.yaml are the checked-in renders; regenerating must be a
         # no-op (the docgen-in-sync discipline, like METRICS.md)
