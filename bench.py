@@ -44,6 +44,26 @@ SWEEP_TYPES = 400
 PROFILE_DIR = None  # set by --profile: per-config cProfile + XLA trace artifacts
 
 
+def bench_provenance(mode: str) -> dict:
+    """The artifact identity block (karpenter_tpu/provenance.py): git SHA +
+    ISO timestamp + a hash of the grid configuration. The r2-r5 headline
+    drift stayed unbisectable because BENCH artifacts carried none of this."""
+    from karpenter_tpu.provenance import provenance_block
+
+    return provenance_block(
+        {
+            "mode": mode,
+            "headline_pods": HEADLINE_PODS,
+            "headline_types": HEADLINE_TYPES,
+            "headline_trials": HEADLINE_TRIALS,
+            "side_trials": SIDE_TRIALS,
+            "sweep_pods": list(SWEEP_PODS),
+            "sweep_types": SWEEP_TYPES,
+            "baseline_pods_per_sec": BASELINE_PODS_PER_SEC,
+        }
+    )
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -492,6 +512,7 @@ def _smoke() -> dict:
     assert attrs["depth"] == 0
     summary["interruption_queue"] = attrs
 
+    summary["provenance"] = bench_provenance("smoke")
     summary["ok"] = True
     return summary
 
@@ -638,6 +659,7 @@ def main() -> None:
                 "pods_per_sec_sweep": sweep,
                 "phases": PHASE_BREAKDOWN,
                 "cost_regret_vs_ilp": regret,
+                "provenance": bench_provenance("full"),
             }
         )
     )

@@ -40,6 +40,9 @@ Transport fault injection (for the client's retry/backoff contract):
   service.drop_next(n)       next n requests are PROCESSED, then the
                              connection closes with no response bytes —
                              the mid-CreateFleet-timeout shape
+  service.delay_next(n, s)   next n requests are held s seconds before
+                             processing — injected transport latency
+                             (slow apiserver/cloud, not a failure)
 """
 
 from __future__ import annotations
@@ -72,6 +75,8 @@ class CloudAPIService:
         self._throttle = 0
         self._fail = 0
         self._drop = 0
+        self._delay = 0
+        self._delay_seconds = 0.0
         self.requests_served = 0
         # idempotency token -> in-flight/settled record: {"event", "response",
         # "error"}. The record is inserted UNDER the lock BEFORE the launch
@@ -116,6 +121,16 @@ class CloudAPIService:
 
             def _dispatch(self, method: str) -> None:
                 service.requests_served += 1
+                # latency is orthogonal to the failure faults: a delayed
+                # request still runs its course (and may then throttle/fail)
+                with service._fault_lock:
+                    delay = service._delay_seconds if service._delay > 0 else 0.0
+                    if service._delay > 0:
+                        service._delay -= 1
+                if delay > 0:
+                    import time as _time
+
+                    _time.sleep(delay)
                 fault = self._fault()
                 if fault == "throttle":
                     self._send(429, {"error": {"code": "throttled", "message": "rate exceeded"}}, {"Retry-After": "0"})
@@ -194,6 +209,14 @@ class CloudAPIService:
     def drop_next(self, n: int) -> None:
         with self._fault_lock:
             self._drop = n
+
+    def delay_next(self, n: int, seconds: float) -> None:
+        """Hold the next n requests `seconds` before processing them —
+        transport latency injection (the scenario campaign's degraded-cloud
+        primitive on the HTTP transport)."""
+        with self._fault_lock:
+            self._delay = n
+            self._delay_seconds = max(0.0, seconds)
 
     # -- routing -------------------------------------------------------------
 

@@ -166,6 +166,11 @@ class CloudBackend:
         # fault injection
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()  # (type, zone, capacity_type)
         self.next_error: Optional[Exception] = None
+        # sustained API latency (seconds) applied to every control-plane
+        # verb (describes, price books, fleet, terminate) — the in-process
+        # analog of a degraded cloud; scenario primitives raise it mid-storm
+        # and drop it back to zero
+        self.api_latency: float = 0.0
         # call capture
         self.create_fleet_calls: List[FleetRequest] = []
         self.terminate_calls: List[str] = []
@@ -173,18 +178,31 @@ class CloudBackend:
 
     # -- describe APIs -------------------------------------------------------
 
+    def _simulate_latency(self) -> None:
+        # outside the lock: injected slowness must not serialize every caller
+        delay = self.api_latency
+        if delay > 0:
+            self.clock.sleep(delay)
+
+    def inject_api_latency(self, seconds: float) -> None:
+        """Degrade (or restore, with 0) the control plane's response time."""
+        self.api_latency = max(0.0, seconds)
+
     def describe_instance_types(self) -> List[InstanceTypeInfo]:
+        self._simulate_latency()
         with self._lock:
             self.describe_calls += 1
             return list(self.catalog)
 
     def describe_subnets(self, tag_selector: Optional[Dict[str, str]] = None) -> List[Subnet]:
+        self._simulate_latency()
         subnets = list(self.subnets)
         if tag_selector:
             subnets = [s for s in subnets if all(s.tags.get(k) == v for k, v in tag_selector.items())]
         return subnets
 
     def describe_security_groups(self, tag_selector: Optional[Dict[str, str]] = None) -> List["SecurityGroup"]:
+        self._simulate_latency()
         groups = list(self.security_groups)
         if tag_selector:
             groups = [g for g in groups if all(g.tags.get(k) == v for k, v in tag_selector.items())]
@@ -200,6 +218,7 @@ class CloudBackend:
         """Bulk price books (on-demand, spot) — one call per pricing refresh
         instead of one per (type, zone), which is what keeps the HTTP
         transport (api.py) from turning every refresh into a call storm."""
+        self._simulate_latency()
         with self._lock:
             return dict(self.od_prices), dict(self.spot_prices)
 
@@ -230,6 +249,7 @@ class CloudBackend:
         """Launch ONE instance from the cheapest available spec (the
         lowest-price / capacity-optimized strategies collapse to this in a
         simulator with explicit price books)."""
+        self._simulate_latency()
         with self._lock:
             if self.next_error is not None:
                 err, self.next_error = self.next_error, None
@@ -272,6 +292,7 @@ class CloudBackend:
             return instance
 
     def terminate_instance(self, instance_id: str) -> None:
+        self._simulate_latency()
         with self._lock:
             self.terminate_calls.append(instance_id)
             existed = self.instances.pop(instance_id, None) is not None
@@ -339,5 +360,6 @@ class CloudBackend:
         with self._lock:
             self.insufficient_capacity_pools = set()
             self.next_error = None
+            self.api_latency = 0.0
             self.create_fleet_calls = []
             self.terminate_calls = []

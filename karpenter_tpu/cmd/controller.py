@@ -88,10 +88,16 @@ def main(argv=None) -> int:
         extra_routes.update(LiveProfiler().routes())
     if options.enable_tracing:
         # decision-tracing read surface: /debug/traces (+ ?id, ?format=chrome)
-        # and /debug/decisions (+ ?pod=) on the metrics port
+        # and /debug/decisions (+ ?pod=, ?outcome=, ?limit=) on the metrics port
         from .. import tracing
 
         extra_routes.update(tracing.routes())
+    if options.enable_slo:
+        # the SLO snapshot: live pending-latency quantiles, cluster $/hr,
+        # cost-drift ratio, churn counters on the metrics port
+        from .. import slo
+
+        extra_routes.update(slo.routes())
     obs = ObservabilityServer(
         healthy=runtime.healthy,
         ready=lambda: runtime.ready() and runtime.healthy(),

@@ -43,6 +43,18 @@ class Counter(Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def values(self) -> Dict[tuple, float]:
+        """Label tuple -> value snapshot (read surfaces like /debug/slo)."""
+        with self._lock:
+            return dict(self._values)
+
+    def clear(self) -> None:
+        """Drop every series. Not a production verb (counters are
+        monotonic); per-run harnesses (scenario campaigns) reset between
+        runs so each run scores only its own observations."""
+        with self._lock:
+            self._values.clear()
+
     def collect(self):
         with self._lock:
             for key, value in self._values.items():
@@ -59,10 +71,6 @@ class Gauge(Counter):
         key = tuple(labels.get(n, "") for n in self.label_names)
         with self._lock:
             self._values.pop(key, None)
-
-    def clear(self) -> None:
-        with self._lock:
-            self._values.clear()
 
 
 class Histogram(Metric):
@@ -92,6 +100,13 @@ class Histogram(Metric):
         key = tuple(labels.get(n, "") for n in self.label_names)
         with self._lock:
             return self._sums.get(key, 0.0)
+
+    def clear(self) -> None:
+        """Drop every series (per-run harness reset; see Counter.clear)."""
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def collect(self):
         with self._lock:
@@ -127,6 +142,17 @@ class Summary(Histogram):
             if len(samples) > self.MAX_SAMPLES:
                 del samples[: len(samples) // 2]
 
+    def series(self) -> List[Dict[str, str]]:
+        """One label dict per live series (snapshot surfaces enumerate the
+        per-provisioner quantiles without knowing the label values)."""
+        with self._lock:
+            return [dict(zip(self.label_names, key)) for key in self._totals]
+
+    def clear(self) -> None:
+        super().clear()
+        with self._lock:
+            self._samples.clear()
+
     def quantile(self, q: float, **labels) -> float:
         key = tuple(labels.get(n, "") for n in self.label_names)
         with self._lock:
@@ -145,8 +171,11 @@ class Summary(Histogram):
                 if not math.isnan(value):
                     yield {**labels, "quantile": str(q)}, value, ""
             with self._lock:
-                yield labels, self._totals[key], "_count"
-                yield labels, self._sums[key], "_sum"
+                # .get, not []: clear() may race this snapshot (a campaign
+                # reset between scenarios during a concurrent /metrics
+                # scrape) — a vanished key must not kill the exposition
+                yield labels, self._totals.get(key, 0), "_count"
+                yield labels, self._sums.get(key, 0.0), "_sum"
 
 
 class _Timer:
@@ -164,6 +193,18 @@ class _Timer:
 
 
 _KINDS = {Counter: "counter", Gauge: "gauge", Histogram: "histogram", Summary: "summary"}
+
+
+def escape_help(text: str) -> str:
+    """Prometheus exposition escaping for HELP lines: backslash and newline
+    (exposition_formats.md); quotes are legal in help text unescaped."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value) -> str:
+    """Label-value escaping: backslash, double-quote, newline — unescaped,
+    any of these corrupts the whole scrape, not just one series."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 class Registry:
@@ -188,8 +229,10 @@ class Registry:
     def histogram(self, name, help="", label_names=(), buckets=None) -> Histogram:
         return self._register(Histogram(name, help, label_names, buckets))  # type: ignore[return-value]
 
-    def summary(self, name, help="", label_names=()) -> Summary:
-        return self._register(Summary(name, help, label_names))  # type: ignore[return-value]
+    def summary(self, name, help="", label_names=(), objectives=None) -> Summary:
+        if objectives is None:
+            return self._register(Summary(name, help, label_names))  # type: ignore[return-value]
+        return self._register(Summary(name, help, label_names, objectives))  # type: ignore[return-value]
 
     def get(self, name: str) -> Optional[Metric]:
         with self._lock:
@@ -208,10 +251,10 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics.values())
         for metric in metrics:
-            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# HELP {metric.name} {escape_help(metric.help)}")
             lines.append(f"# TYPE {metric.name} {_KINDS.get(type(metric), 'untyped')}")
             for labels, value, suffix in metric.collect():  # type: ignore[attr-defined]
-                label_str = ",".join(f'{k}="{v}"' for k, v in labels.items() if v != "")
+                label_str = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels.items() if v != "")
                 label_part = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{metric.name}{suffix}{label_part} {value}")
         return "\n".join(lines) + "\n"

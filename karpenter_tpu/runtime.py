@@ -32,6 +32,7 @@ from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
 from .logsetup import configure as configure_logging, get_logger, set_level
 from .metrics import REGISTRY
+from .slo import SLO
 from .tracing import TRACER
 from .utils.options import Options
 
@@ -155,6 +156,19 @@ class Runtime:
         self.pod_metrics = PodMetricsController(self.kube)
         self.provisioner_metrics = ProvisionerMetricsController(self.kube)
         self.node_metrics = NodeMetricsScraper(self.cluster)
+        # SLO accounting (slo.py): watch-driven pending/ready latency plus
+        # the cost scraper below, behind --enable-slo. The watch hooks are
+        # only attached when enabled, so a disabled runtime's bind path
+        # carries no SLO dispatch at all (disabled == free, like tracing)
+        from .controllers.metrics import SLOScraper
+
+        self.slo = SLO
+        self.slo_metrics = SLOScraper(
+            self.kube, self.cluster, self.cloud_provider, provisioner_controller=self.provisioner
+        )
+        if self.options.enable_slo:
+            SLO.enable()
+            SLO.attach(self.kube)
         import socket
         import uuid
 
@@ -268,6 +282,8 @@ class Runtime:
             self._pass("pod-metrics", self.pod_metrics.scrape)
             self._pass("provisioner-metrics", self.provisioner_metrics.scrape)
             self._pass("node-metrics", self.node_metrics.scrape)
+            if self.options.enable_slo:
+                self._pass("slo-metrics", self.slo_metrics.scrape)
 
     def _pricing_loop(self) -> None:
         while not self._stop.wait(timeout=self.options.pricing_refresh_period):
@@ -315,6 +331,8 @@ class Runtime:
         self._pass("pod-metrics", self.pod_metrics.scrape)
         self._pass("provisioner-metrics", self.provisioner_metrics.scrape)
         self._pass("node-metrics", self.node_metrics.scrape)
+        if self.options.enable_slo:
+            self._pass("slo-metrics", self.slo_metrics.scrape)
 
     def provision_once(self):
         from .profiling import maybe_profile_round

@@ -1,0 +1,41 @@
+"""Scenario campaign harness: composable load generation + SLO scoring.
+
+See primitives.py (the load/chaos primitives and Scenario composition),
+standin.py (the kubelet/scheduler/ReplicaSet stand-in), campaign.py (the
+runner emitting scored SCENARIO_*.json on both transports), and schema.py
+(the artifact validator shared with the tier-1 smoke test).
+"""
+
+from .campaign import TRANSPORTS, CampaignRunner, default_campaign, smoke_campaign
+from .primitives import (
+    Burst,
+    DiurnalRamp,
+    DriftRollout,
+    Primitive,
+    ScaleTo,
+    Scenario,
+    ScenarioContext,
+    SpotReclaimWave,
+    TransportChaos,
+)
+from .schema import scenario_doc_errors
+from .standin import WorkloadStandIn, workload_pod
+
+__all__ = [
+    "TRANSPORTS",
+    "CampaignRunner",
+    "default_campaign",
+    "smoke_campaign",
+    "Burst",
+    "DiurnalRamp",
+    "DriftRollout",
+    "Primitive",
+    "ScaleTo",
+    "Scenario",
+    "ScenarioContext",
+    "SpotReclaimWave",
+    "TransportChaos",
+    "scenario_doc_errors",
+    "WorkloadStandIn",
+    "workload_pod",
+]

@@ -1,0 +1,415 @@
+"""Campaign runner: execute scored scenarios against a live Runtime.
+
+One campaign = a list of composed scenarios (primitives.py), each run
+against a REAL Runtime — its own threads, batcher, interruption poll loop,
+disruption orchestrator — on one or both cloud transports (the in-process
+CloudBackend and the HTTP CloudAPIService/Client pair), with the workload
+stand-in (standin.py) playing the cluster around it.
+
+Each scenario emits one `SCENARIO_<name>.json` next to the BENCH_*.json
+artifacts: a provenance block (git SHA, timestamp, config hash), per-run
+scores (pending-latency p50/p95/p99 per provisioner, time-to-node-ready,
+cluster $/hr, cost-drift ratio vs the ideal fresh repack, lost pods, budget
+violations, churn counters), and a monotonic sample timeline. Every emitted
+document is self-validated against schema.py before it lands on disk, so a
+malformed artifact is a crash at emit time, not a silent gap at bisect time.
+
+    python -m karpenter_tpu.scenarios.campaign --out . --transports inprocess,http
+
+Behavioral regressions — pending latency creeping under churn, cost drift
+after a reclaim wave — are now diffable artifacts, the way solve-time
+regressions have been since bench.py grew per-phase JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import slo
+from ..api import labels as lbl
+from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
+from ..api.provisioner import Budget, Disruption, Provisioner, ProvisionerSpec
+from ..cloudprovider.simulated.backend import CloudBackend
+from ..cloudprovider.simulated.provider import SimulatedCloudProvider
+from ..controllers.disruption.budgets import allowed_disruptions
+from ..kube.cluster import KubeCluster
+from ..logsetup import get_logger
+from ..provenance import provenance_block
+from ..runtime import Runtime
+from ..utils.options import Options
+from .primitives import Burst, DiurnalRamp, DriftRollout, Scenario, ScenarioContext, SpotReclaimWave, TransportChaos
+from .schema import scenario_doc_errors
+from .standin import WorkloadStandIn, live_pods
+
+log = get_logger("campaign")
+
+TRANSPORTS = ("inprocess", "http")
+
+
+def _provisioner(scenario: Scenario) -> Provisioner:
+    disruption = None
+    if scenario.budget_nodes is not None:
+        disruption = Disruption(budgets=[Budget(nodes=scenario.budget_nodes)])
+    requirements = [
+        NodeSelectorRequirement(
+            key=lbl.LABEL_CAPACITY_TYPE,
+            operator=OP_IN,
+            values=[lbl.CAPACITY_TYPE_SPOT, lbl.CAPACITY_TYPE_ON_DEMAND],
+        )
+    ]
+    if scenario.instance_types:
+        requirements.append(
+            NodeSelectorRequirement(key=lbl.LABEL_INSTANCE_TYPE, operator=OP_IN, values=list(scenario.instance_types))
+        )
+    return Provisioner(
+        metadata=ObjectMeta(name="default", namespace=""),
+        spec=ProvisionerSpec(
+            requirements=requirements,
+            ttl_seconds_after_empty=scenario.ttl_seconds_after_empty,
+            disruption=disruption,
+        ),
+    )
+
+
+def drift_settled(ctx: ScenarioContext) -> bool:
+    """The drift scenario's extra convergence bar: every owned node carries
+    the CURRENT provisioner spec hash (no survivor is stale) and the
+    disruption ledger has drained — the rollout finished, not just paused."""
+    from ..scheduling.nodetemplate import NodeTemplate
+
+    provisioner = ctx.kube.get("Provisioner", "default", namespace="")
+    if provisioner is None:
+        return True
+    current = NodeTemplate.from_provisioner(provisioner).spec_hash()
+    for node in ctx.kube.list_nodes():
+        if node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) != provisioner.name:
+            continue
+        if node.metadata.annotations.get(lbl.PROVISIONER_HASH_ANNOTATION) != current:
+            return False
+    disruption = ctx.runtime.disruption
+    return disruption is None or disruption.tracker.total_in_flight() == 0
+
+
+def _lost_pods(ctx: ScenarioContext) -> int:
+    """Pods the cluster failed: unbound, or bound to a node whose backing
+    instance is gone / whose node object vanished."""
+    lost = 0
+    for pod in live_pods(ctx.kube):
+        if not pod.spec.node_name:
+            lost += 1
+            continue
+        node = ctx.kube.get_node(pod.spec.node_name)
+        if node is None or not ctx.backend.instance_exists(node.spec.provider_id.split("///", 1)[-1]):
+            lost += 1
+    return lost
+
+
+def _converged(ctx: ScenarioContext, scenario: Scenario) -> bool:
+    pods = live_pods(ctx.kube)
+    if len(pods) != ctx.desired or any(not p.spec.node_name for p in pods):
+        return False
+    for node in ctx.kube.list_nodes():
+        if not ctx.backend.instance_exists(node.spec.provider_id.split("///", 1)[-1]):
+            return False  # a node object survives its dead instance
+    if _lost_pods(ctx):
+        return False
+    if ctx.backend.notifications.depth() != 0:
+        return False
+    return scenario.settled is None or scenario.settled(ctx)
+
+
+class CampaignRunner:
+    def __init__(
+        self,
+        out_dir: str = ".",
+        transports=TRANSPORTS,
+        sample_period: float = 0.4,
+        convergence_timeout: float = 60.0,
+    ):
+        self.out_dir = out_dir
+        self.transports = tuple(transports)
+        self.sample_period = sample_period
+        self.convergence_timeout = convergence_timeout
+
+    # -- one scenario on one transport ----------------------------------------
+
+    def run_one(self, scenario: Scenario, transport: str) -> dict:
+        if transport not in TRANSPORTS:
+            raise ValueError(f"unknown transport {transport!r}; one of {TRANSPORTS}")
+        slo.SLO.reset()
+        kube = KubeCluster()
+        backend = CloudBackend(clock=kube.clock)
+        backend.notifications.visibility_timeout = 1.0
+        service = None
+        cloud = backend
+        if transport == "http":
+            from ..cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+            service = CloudAPIService(backend=backend).start()
+            cloud = CloudAPIClient(service.url)
+        provider = SimulatedCloudProvider(backend=cloud, kube=kube, clock=kube.clock)
+        runtime = Runtime(
+            kube=kube,
+            cloud_provider=provider,
+            options=Options(
+                leader_elect=False,
+                dense_solver_enabled=False,
+                batch_max_duration=0.3,
+                batch_idle_duration=0.05,
+                interruption_queue="interruptions",
+                interruption_poll_interval=0.2,
+                enable_slo=True,
+            ),
+        )
+        provisioner = _provisioner(scenario)
+        kube.create(provisioner)
+        ctx = ScenarioContext(kube, backend, runtime, service=service, pod_cpu=scenario.pod_cpu)
+        stand_in = WorkloadStandIn(ctx)
+        reclaim_thread = threading.Thread(
+            target=self._reclaimer, args=(ctx,), name="cloud-reclaimer", daemon=True
+        )
+        samples: List[dict] = []
+        violations = 0
+        start = time.monotonic()
+        try:
+            runtime.start()
+            stand_in.start()
+            reclaim_thread.start()
+            ctx.desired = scenario.desired
+            workers = []
+            for primitive in scenario.primitives:
+                thread = threading.Thread(
+                    target=self._run_primitive, args=(ctx, primitive), name=f"primitive-{type(primitive).__name__}", daemon=True
+                )
+                thread.start()
+                workers.append(thread)
+
+            def timeline_live() -> bool:
+                return time.monotonic() - start < scenario.duration or any(w.is_alive() for w in workers)
+
+            while timeline_live():
+                violations += self._sample(ctx, provisioner, samples, start)
+                time.sleep(self.sample_period)
+            deadline = time.monotonic() + self.convergence_timeout
+            converged = False
+            while time.monotonic() < deadline:
+                violations += self._sample(ctx, provisioner, samples, start)
+                if _converged(ctx, scenario):
+                    converged = True
+                    break
+                time.sleep(self.sample_period)
+            # final accounting: fresh cost gauges + an explicit drift solve
+            runtime.slo_metrics.scrape()
+            runtime.slo_metrics.compute_drift()
+            violations += self._sample(ctx, provisioner, samples, start)
+            snapshot = slo.SLO.snapshot()
+            pods = live_pods(kube)
+            run = {
+                "transport": transport,
+                "duration_seconds": round(time.monotonic() - start, 3),
+                "converged": converged,
+                "scores": {
+                    "pending_latency_seconds": snapshot["pod_pending_latency_seconds"],
+                    "node_ready_seconds": snapshot["node_ready_seconds"],
+                    "cost_per_hour": snapshot["cost"]["cluster_cost_per_hour"],
+                    "ideal_cost_per_hour": snapshot["cost"]["ideal_cost_per_hour"],
+                    "cost_drift_ratio": snapshot["cost"]["cost_drift_ratio"],
+                    "lost_pods": _lost_pods(ctx),
+                    "budget_violations": violations,
+                    "pods_desired": ctx.desired,
+                    "pods_bound": sum(1 for p in pods if p.spec.node_name),
+                    "nodes_churned": snapshot["churn"]["nodes_churned"],
+                    "pods_displaced": snapshot["churn"]["pods_displaced"],
+                },
+                "samples": samples,
+            }
+            log.info(
+                "[%s/%s] converged=%s pods=%d/%d lost=%d drift=%.3f violations=%d in %.1fs",
+                scenario.name, transport, converged, run["scores"]["pods_bound"], ctx.desired,
+                run["scores"]["lost_pods"], run["scores"]["cost_drift_ratio"], violations,
+                run["duration_seconds"],
+            )
+            return run
+        finally:
+            ctx.stop.set()
+            # only join threads that actually started: runtime.start() can
+            # raise before they do, and join() on an unstarted Thread raises
+            # RuntimeError — masking the real startup failure
+            for thread in (stand_in, reclaim_thread):
+                if thread.ident is not None:
+                    thread.join(timeout=3)
+            runtime.stop()
+            if service is not None:
+                service.stop()
+            # the Runtime enabled the process-wide accountant; a finished
+            # run must not leave accounting on for unrelated work (the next
+            # run_one re-enables through its own Runtime)
+            slo.SLO.disable()
+
+    @staticmethod
+    def _run_primitive(ctx: ScenarioContext, primitive) -> None:
+        if ctx.stop.wait(timeout=primitive.offset):
+            return
+        try:
+            primitive.run(ctx)
+        except Exception:  # noqa: BLE001 - one primitive must not kill the scenario
+            log.exception("primitive %s failed", type(primitive).__name__)
+
+    @staticmethod
+    def _reclaimer(ctx: ScenarioContext) -> None:
+        # the cloud makes good on its interruption warnings
+        while not ctx.stop.wait(timeout=0.2):
+            ctx.backend.reclaim_due_instances()
+
+    def _sample(self, ctx: ScenarioContext, provisioner, samples: List[dict], start: float) -> int:
+        """Append one timeline sample; returns 1 when the voluntary
+        disruption ledger exceeds the provisioner's active budget (the
+        budget-violation score), else 0."""
+        in_flight = 0
+        if ctx.runtime.disruption is not None:
+            in_flight = ctx.runtime.disruption.tracker.total_in_flight()
+        owned = sum(
+            1 for n in ctx.kube.list_nodes() if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner.name
+        )
+        limit = allowed_disruptions(provisioner, owned, ctx.kube.clock.now())
+        violated = limit is not None and in_flight > limit
+        samples.append(
+            {
+                "t": round(time.monotonic() - start, 3),
+                "pending_pods": len(ctx.kube.pending_pods()),
+                "nodes": len(ctx.kube.list_nodes()),
+                "cost_per_hour": round(slo.CLUSTER_COST.value(), 6),
+                "disrupting": in_flight,
+            }
+        )
+        return 1 if violated else 0
+
+    # -- the campaign ----------------------------------------------------------
+
+    def run(self, scenarios: List[Scenario]) -> List[dict]:
+        docs = []
+        os.makedirs(self.out_dir, exist_ok=True)
+        for scenario in scenarios:
+            doc = {
+                "scenario": scenario.name,
+                "description": scenario.description,
+                "provenance": provenance_block(scenario.config()),
+                "runs": [self.run_one(scenario, transport) for transport in self.transports],
+            }
+            errors = scenario_doc_errors(doc)
+            if errors:
+                raise AssertionError(f"scenario {scenario.name} emitted an invalid document: {errors}")
+            path = os.path.join(self.out_dir, f"SCENARIO_{scenario.name}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            log.info("wrote %s", path)
+            docs.append(doc)
+        return docs
+
+
+# -- the standard campaigns ----------------------------------------------------
+
+
+def default_campaign() -> List[Scenario]:
+    """The five composed production shapes the roadmap asked for, each
+    exercising a different Runtime subsystem end to end."""
+    return [
+        Scenario(
+            name="pod_burst",
+            desired=0,
+            duration=4.0,
+            primitives=[Burst(offset=0.2, count=28)],
+            description="cold burst: 28 replicas land at once on an empty cluster",
+        ),
+        Scenario(
+            name="diurnal_ramp",
+            desired=0,  # the ramp owns the load (its contribution starts at base)
+            duration=10.0,
+            primitives=[DiurnalRamp(offset=0.5, base=6, peak=22, period=8.0, cycles=1)],
+            description="half-cosine day: 6 -> 28 -> 6 replicas over one period",
+        ),
+        Scenario(
+            name="spot_reclaim_wave",
+            desired=24,
+            duration=9.0,
+            instance_types=["general-4x8"],  # ~7 pods/node -> a real fleet to storm
+            primitives=[SpotReclaimWave(offset=4.0, fraction=0.6, warning_seconds=1.5)],
+            description="correlated spot loss: most of the populated fleet reclaimed on a short warning",
+        ),
+        Scenario(
+            name="drift_rollout_storm",
+            desired=14,
+            duration=10.0,
+            budget_nodes="40%",
+            instance_types=["general-4x8"],  # several nodes, so 40% floors to >= 1
+            settled=drift_settled,
+            primitives=[Burst(offset=2.0, count=8), DriftRollout(offset=4.0)],
+            description="provisioner label rollout mid-burst: every node drifts, replaced under a 40% budget",
+        ),
+        Scenario(
+            name="throttled_control_plane",
+            desired=0,
+            duration=8.0,
+            primitives=[
+                Burst(offset=0.2, count=18),
+                TransportChaos(offset=0.6, latency_seconds=0.12, duration=4.0, delayed_requests=60, throttled_requests=10),
+            ],
+            description="burst under a degraded cloud API: injected latency + 429 throttling",
+        ),
+    ]
+
+
+def smoke_campaign() -> List[Scenario]:
+    """The tier-1 shape: one tiny composed scenario (burst + a one-node
+    reclaim) that still crosses every scored surface in a few seconds."""
+    return [
+        Scenario(
+            name="smoke_burst",
+            desired=0,
+            duration=2.5,
+            primitives=[Burst(offset=0.1, count=8), SpotReclaimWave(offset=1.2, fraction=0.34, warning_seconds=0.8, max_victims=1)],
+            description="tier-1 smoke: small burst + single spot reclaim",
+        )
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-campaign")
+    parser.add_argument("--out", default=".", help="directory for SCENARIO_*.json artifacts")
+    parser.add_argument("--transports", default=",".join(TRANSPORTS), help="comma-separated: inprocess,http")
+    parser.add_argument("--smoke", action="store_true", help="run the tier-1 smoke campaign instead of the full one")
+    parser.add_argument("--scenarios", default="", help="comma-separated subset of scenario names")
+    args = parser.parse_args(argv)
+    scenarios = smoke_campaign() if args.smoke else default_campaign()
+    if args.scenarios:
+        wanted = set(args.scenarios.split(","))
+        scenarios = [s for s in scenarios if s.name in wanted]
+        if not scenarios:
+            parser.error(f"no scenario matches {sorted(wanted)}")
+    runner = CampaignRunner(out_dir=args.out, transports=tuple(args.transports.split(",")))
+    docs = runner.run(scenarios)
+    summary = {
+        doc["scenario"]: {
+            run["transport"]: {
+                "converged": run["converged"],
+                "lost_pods": run["scores"]["lost_pods"],
+                "budget_violations": run["scores"]["budget_violations"],
+                "cost_drift_ratio": run["scores"]["cost_drift_ratio"],
+            }
+            for run in doc["runs"]
+        }
+        for doc in docs
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

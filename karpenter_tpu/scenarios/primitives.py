@@ -1,0 +1,231 @@
+"""Scenario primitives: composable load/chaos generators for a live Runtime.
+
+Each primitive drives ONE aspect of production pressure against the running
+Runtime + simulated cloud — traffic shape (bursts, diurnal ramps), capacity
+loss (spot reclaim waves), config churn (drift rollouts mid-storm), and
+degraded infrastructure (injected transport latency / apiserver throttling).
+A `Scenario` composes several primitives on a shared timeline; the campaign
+runner (campaign.py) executes them against a real Runtime on either
+transport and scores the outcome.
+
+These generalize the hand-rolled seams of the interruption-storm and
+disruption-storm tests: the workload stand-in (standin.py) plays kubelet /
+kube-scheduler / ReplicaSet, primitives mutate the desired replica count and
+the cloud, and the Runtime does everything else.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..api import labels as lbl
+from ..logsetup import get_logger
+
+log = get_logger("scenarios")
+
+
+class ScenarioContext:
+    """Everything a primitive may touch while a scenario runs."""
+
+    def __init__(self, kube, backend, runtime, service=None, pod_cpu: float = 0.5):
+        self.kube = kube
+        self.backend = backend  # the in-process CloudBackend (faults/reclaims)
+        self.runtime = runtime
+        self.service = service  # CloudAPIService on the http transport, else None
+        self.pod_cpu = pod_cpu
+        self.stop = threading.Event()
+        self._lock = threading.Lock()
+        self._desired = 0
+
+    @property
+    def desired(self) -> int:
+        with self._lock:
+            return self._desired
+
+    @desired.setter
+    def desired(self, value: int) -> None:
+        with self._lock:
+            self._desired = max(0, int(value))
+
+    def add_desired(self, delta: int) -> int:
+        """Atomic relative adjustment: primitives run on their own threads,
+        so `ctx.desired = ctx.desired + n` is a torn read-modify-write when
+        two of them fire together (a Burst during a DiurnalRamp step)."""
+        with self._lock:
+            self._desired = max(0, self._desired + int(delta))
+            return self._desired
+
+    def sleep(self, seconds: float) -> bool:
+        """Interruptible sleep; True when the scenario was stopped."""
+        return self.stop.wait(timeout=seconds)
+
+
+@dataclass
+class Primitive:
+    """Base: `offset` schedules the primitive on the scenario timeline."""
+
+    offset: float = 0.0
+
+    def run(self, ctx: ScenarioContext) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def config(self) -> dict:
+        return {"kind": type(self).__name__, **{k: v for k, v in vars(self).items() if not k.startswith("_")}}
+
+
+@dataclass
+class Burst(Primitive):
+    """Raise the desired replica count by `count` in one step — the sharp
+    edge of a deploy or an HPA overreaction."""
+
+    count: int = 10
+
+    def run(self, ctx: ScenarioContext) -> None:
+        log.info("burst: desired -> %d", ctx.add_desired(self.count))
+
+
+@dataclass
+class ScaleTo(Primitive):
+    """Set the desired replica count absolutely (ramp-down included)."""
+
+    count: int = 0
+
+    def run(self, ctx: ScenarioContext) -> None:
+        ctx.desired = self.count
+
+
+@dataclass
+class DiurnalRamp(Primitive):
+    """Traffic follows a half-cosine day: base -> base+peak -> base over
+    `period` seconds, re-evaluated every `step`. `cycles` repeats it.
+
+    The ramp owns only its own CONTRIBUTION to the desired count (applied
+    through atomic deltas), so composing it with a concurrent Burst adds the
+    two loads instead of the ramp's next step erasing the burst."""
+
+    base: int = 5
+    peak: int = 20
+    period: float = 8.0
+    step: float = 0.25
+    cycles: int = 1
+
+    def run(self, ctx: ScenarioContext) -> None:
+        contribution = 0
+
+        def set_contribution(value: int) -> None:
+            nonlocal contribution
+            ctx.add_desired(value - contribution)
+            contribution = value
+
+        start = time.monotonic()
+        total = self.period * self.cycles
+        while not ctx.stop.is_set():
+            t = time.monotonic() - start
+            if t >= total:
+                break
+            phase = (t % self.period) / self.period
+            set_contribution(self.base + int(round(self.peak * 0.5 * (1 - math.cos(2 * math.pi * phase)))))
+            if ctx.sleep(self.step):
+                return
+        set_contribution(self.base)
+
+
+@dataclass
+class SpotReclaimWave(Primitive):
+    """Interrupt a fraction of populated nodes at once with a short reclaim
+    window — the correlated spot-capacity loss shape. The campaign's
+    reclaimer thread makes the cloud good on the warnings."""
+
+    fraction: float = 0.5
+    warning_seconds: float = 1.5
+    max_victims: int = 8
+
+    def run(self, ctx: ScenarioContext) -> None:
+        populated = [n for n in ctx.kube.list_nodes() if ctx.kube.pods_on_node(n.name)]
+        victims = populated[: max(1, min(self.max_victims, int(len(populated) * self.fraction)))]
+        ids = [n.spec.provider_id.split("///", 1)[-1] for n in victims]
+        log.info("spot reclaim wave: interrupting %d/%d nodes", len(ids), len(populated))
+        for instance_id in ids:
+            ctx.backend.interrupt_spot_instance(instance_id, warning_seconds=self.warning_seconds)
+
+
+@dataclass
+class DriftRollout(Primitive):
+    """Mutate the provisioner spec mid-storm (a label rollout): every
+    existing node's stamped provisioner-hash goes stale, the disruption
+    orchestrator's drift method replaces them under the budget."""
+
+    provisioner: str = "default"
+    label_key: str = "rollout"
+    label_value: str = "v2"
+
+    def run(self, ctx: ScenarioContext) -> None:
+        provisioner = ctx.kube.get("Provisioner", self.provisioner, namespace="")
+        if provisioner is None:
+            log.warning("drift rollout: provisioner %s not found", self.provisioner)
+            return
+        provisioner.spec.labels[self.label_key] = self.label_value
+        ctx.kube.update(provisioner)
+        log.info("drift rollout: provisioner %s labeled %s=%s", self.provisioner, self.label_key, self.label_value)
+
+
+@dataclass
+class TransportChaos(Primitive):
+    """Degrade the cloud control plane for `duration` seconds: sustained
+    API latency on the in-process transport, plus per-request delay and 429
+    throttling on the HTTP transport (apiclient retries with backoff)."""
+
+    latency_seconds: float = 0.15
+    duration: float = 3.0
+    delayed_requests: int = 40
+    throttled_requests: int = 8
+
+    def run(self, ctx: ScenarioContext) -> None:
+        log.info("transport chaos: +%.0fms API latency for %.1fs", self.latency_seconds * 1000, self.duration)
+        ctx.backend.inject_api_latency(self.latency_seconds)
+        if ctx.service is not None:
+            ctx.service.delay_next(self.delayed_requests, self.latency_seconds)
+            ctx.service.throttle_next(self.throttled_requests)
+        ctx.sleep(self.duration)
+        ctx.backend.inject_api_latency(0.0)
+
+
+@dataclass
+class Scenario:
+    """A named composition of primitives on one timeline."""
+
+    name: str
+    desired: int  # starting replica count (the stand-in reconciles to it)
+    duration: float  # timeline length before the convergence wait begins
+    primitives: List[Primitive] = field(default_factory=list)
+    pod_cpu: float = 0.5
+    budget_nodes: Optional[str] = None  # e.g. "40%" -> spec.disruption.budgets
+    # restricting the provisioner to small shapes spreads the workload over
+    # several nodes — what makes percentage budgets and reclaim fractions
+    # meaningful (22 pods on one 96-cpu node give a 30% budget of zero)
+    instance_types: Optional[List[str]] = None
+    ttl_seconds_after_empty: Optional[float] = 2.0
+    # extra convergence condition beyond "every pod bound to live capacity"
+    # (e.g. the drift scenario waits until no node carries a stale spec
+    # hash); not part of the config hash — predicates describe WHEN the run
+    # may stop, not WHAT it did
+    settled: Optional[Callable[[ScenarioContext], bool]] = None
+    description: str = ""
+
+    def config(self) -> dict:
+        """The provenance config-hash payload: everything that shapes the
+        run, so two SCENARIO artifacts are comparable iff hashes match."""
+        return {
+            "name": self.name,
+            "desired": self.desired,
+            "duration": self.duration,
+            "pod_cpu": self.pod_cpu,
+            "budget_nodes": self.budget_nodes,
+            "instance_types": self.instance_types,
+            "ttl_seconds_after_empty": self.ttl_seconds_after_empty,
+            "primitives": [p.config() for p in self.primitives],
+        }

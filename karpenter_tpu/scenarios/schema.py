@@ -1,0 +1,109 @@
+"""SCENARIO_*.json schema: the structural gate behavioral artifacts must pass.
+
+BENCH_*.json regressions became bisectable once their shape was pinned;
+SCENARIO artifacts get the same treatment from day one. `scenario_doc_errors`
+is the single validator shared by the campaign runner (every emitted file is
+self-checked before it lands on disk) and the tier-1 smoke test — required
+keys, a well-formed provenance block, monotonic sample timestamps, and the
+scored invariants being the right types.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..provenance import provenance_errors
+
+RUN_KEYS = ("transport", "duration_seconds", "converged", "scores", "samples")
+SCORE_KEYS = (
+    "pending_latency_seconds",
+    "node_ready_seconds",
+    "cost_per_hour",
+    "ideal_cost_per_hour",
+    "cost_drift_ratio",
+    "lost_pods",
+    "budget_violations",
+    "pods_desired",
+    "pods_bound",
+    "nodes_churned",
+)
+QUANTILE_KEYS = ("p50", "p95", "p99", "count")
+SAMPLE_KEYS = ("t", "pending_pods", "nodes", "cost_per_hour", "disrupting")
+
+
+def _quantile_errors(block, where: str) -> List[str]:
+    errs = []
+    if not isinstance(block, dict):
+        return [f"{where} must be a dict of per-provisioner quantiles"]
+    for provisioner, entry in block.items():
+        if not isinstance(entry, dict):
+            # a non-dict entry would make `key not in entry` raise (int) or
+            # substring-match (str) — report the malformation instead
+            errs.append(f"{where}[{provisioner!r}] must be a dict, got {type(entry).__name__}")
+            continue
+        for key in QUANTILE_KEYS:
+            if key not in entry:
+                errs.append(f"{where}[{provisioner!r}] missing {key!r}")
+    return errs
+
+
+def run_errors(run, where: str = "run") -> List[str]:
+    errs: List[str] = []
+    if not isinstance(run, dict):
+        return [f"{where} must be a dict"]
+    for key in RUN_KEYS:
+        if key not in run:
+            errs.append(f"{where} missing key {key!r}")
+    scores = run.get("scores")
+    if isinstance(scores, dict):
+        for key in SCORE_KEYS:
+            if key not in scores:
+                errs.append(f"{where}.scores missing key {key!r}")
+        for field in ("lost_pods", "budget_violations"):
+            value = scores.get(field)
+            if value is not None and not isinstance(value, int):
+                errs.append(f"{where}.scores.{field} must be an int, got {type(value).__name__}")
+        errs.extend(_quantile_errors(scores.get("pending_latency_seconds", {}), f"{where}.scores.pending_latency_seconds"))
+    elif scores is not None:
+        errs.append(f"{where}.scores must be a dict")
+    samples = run.get("samples")
+    if isinstance(samples, list):
+        if not samples:
+            errs.append(f"{where}.samples must be non-empty")
+        last_t = None
+        for i, sample in enumerate(samples):
+            if not isinstance(sample, dict):
+                errs.append(f"{where}.samples[{i}] must be a dict")
+                continue
+            for key in SAMPLE_KEYS:
+                if key not in sample:
+                    errs.append(f"{where}.samples[{i}] missing {key!r}")
+            t = sample.get("t")
+            if isinstance(t, (int, float)):
+                if last_t is not None and t < last_t:
+                    errs.append(f"{where}.samples[{i}].t={t} goes backwards (prev {last_t}): timestamps must be monotonic")
+                last_t = t
+    elif samples is not None:
+        errs.append(f"{where}.samples must be a list")
+    return errs
+
+
+def scenario_doc_errors(doc) -> List[str]:
+    """All structural problems with one SCENARIO_*.json document; empty
+    means valid."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document must be a JSON object"]
+    for key in ("scenario", "provenance", "runs"):
+        if key not in doc:
+            errs.append(f"missing top-level key {key!r}")
+    errs.extend(provenance_errors(doc.get("provenance", {})))
+    runs = doc.get("runs")
+    if isinstance(runs, list):
+        if not runs:
+            errs.append("runs must be non-empty")
+        for i, run in enumerate(runs):
+            errs.extend(run_errors(run, where=f"runs[{i}]"))
+    elif runs is not None:
+        errs.append("runs must be a list")
+    return errs

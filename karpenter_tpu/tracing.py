@@ -497,10 +497,20 @@ class DecisionLog:
         with self._lock:
             return [r.to_dict() for r in self._ring if r.pod == pod]
 
-    def recent(self, limit: int = 100) -> List[dict]:
+    def recent(self, limit: int = 100, outcome: Optional[str] = None) -> List[dict]:
+        """Newest-first records, bounded by `limit`; `outcome` filters to one
+        outcome class BEFORE bounding (so ?outcome=failed&limit=50 is the
+        last 50 failures, not the failures among the last 50 records)."""
         with self._lock:
-            out = list(self._ring)[-limit:]
-        return [r.to_dict() for r in reversed(out)]
+            records = list(self._ring)
+        out = []
+        for record in reversed(records):
+            if outcome is not None and record.outcome != outcome:
+                continue
+            out.append(record.to_dict())
+            if len(out) >= limit:
+                break
+        return out
 
     def __len__(self) -> int:
         with self._lock:
@@ -544,14 +554,46 @@ def _traces_route(query: dict) -> tuple:
     return _json(200, {"trace_id": trace_id, "root": tree})
 
 
+_VALID_OUTCOMES = (OUTCOME_PLACED_EXISTING, OUTCOME_PLACED_NEW, OUTCOME_FAILED)
+
+# the index listing is bounded: an unbounded ?limit= would serialize the
+# whole 4096-record ring into one response on a busy cluster
+_DECISIONS_DEFAULT_LIMIT = 100
+_DECISIONS_MAX_LIMIT = 1000
+
+
 def _decisions_route(query: dict) -> tuple:
     pod = (query.get("pod") or [None])[0]
+    outcome = (query.get("outcome") or [None])[0]
+    if outcome is not None and outcome not in _VALID_OUTCOMES:
+        return _json(
+            404,
+            {"error": f"unknown outcome {outcome!r}; one of {list(_VALID_OUTCOMES)}", "status": 404},
+        )
+    raw_limit = (query.get("limit") or [None])[0]
+    limit = _DECISIONS_DEFAULT_LIMIT
+    if raw_limit is not None:
+        try:
+            limit = int(raw_limit)
+        except ValueError:
+            return _json(404, {"error": f"limit {raw_limit!r} is not an integer", "status": 404})
+        limit = max(1, min(limit, _DECISIONS_MAX_LIMIT))
     if pod is None:
-        return _json(200, {"enabled": TRACER.enabled, "records": DECISIONS.recent()})
+        records = DECISIONS.recent(limit=limit, outcome=outcome)
+        payload = {"enabled": TRACER.enabled, "records": records, "limit": limit}
+        if outcome is not None:
+            payload["outcome"] = outcome
+        return _json(200, payload)
     records = DECISIONS.for_pod(pod)
+    if outcome is not None:
+        records = [r for r in records if r["outcome"] == outcome]
     if not records:
-        return _json(404, {"error": f"no decision records for pod {pod!r}", "status": 404})
-    return _json(200, {"pod": pod, "records": records})
+        suffix = f" with outcome {outcome!r}" if outcome is not None else ""
+        return _json(404, {"error": f"no decision records for pod {pod!r}{suffix}", "status": 404})
+    # same bound and ordering as the index: newest first, one hot pod can
+    # accumulate hundreds of ring entries
+    records.reverse()
+    return _json(200, {"pod": pod, "records": records[:limit]})
 
 
 def routes() -> dict:

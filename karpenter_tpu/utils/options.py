@@ -30,6 +30,11 @@ class Options:
     # the metrics port. Off by default — disabled tracing is a true no-op
     enable_tracing: bool = False
     trace_ring_size: int = 256  # completed traces retained (bounded ring)
+    # SLO accounting (slo.py): pod-pending-latency / time-to-ready summaries,
+    # cluster $/hr + cost-drift gauges, churn counters, served on /debug/slo
+    # over the metrics port. Off by default — disabled SLO accounting is a
+    # true no-op on the watch hot path (same bar as tracing)
+    enable_slo: bool = False
     leader_elect: bool = True
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -108,6 +113,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--kube-client-burst", type=int, default=_env("KUBE_CLIENT_BURST", defaults.kube_client_burst))
     parser.add_argument("--enable-profiling", action="store_true", default=_env("ENABLE_PROFILING", defaults.enable_profiling))
     parser.add_argument("--enable-tracing", action="store_true", default=_env("ENABLE_TRACING", defaults.enable_tracing))
+    parser.add_argument("--enable-slo", action="store_true", default=_env("ENABLE_SLO", defaults.enable_slo))
     parser.add_argument("--trace-ring-size", type=int, default=_env("TRACE_RING_SIZE", defaults.trace_ring_size))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))

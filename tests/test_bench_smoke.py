@@ -14,9 +14,16 @@ from __future__ import annotations
 
 def test_bench_smoke():
     import bench
+    from karpenter_tpu.provenance import provenance_errors
 
     summary = bench.smoke()
     assert summary.pop("ok") is True
+    # provenance block (the r2-r5 drift lesson): git SHA + ISO timestamp +
+    # config hash identify the tree and grid that produced the artifact
+    provenance = summary.pop("provenance")
+    assert provenance_errors(provenance) == [], provenance
+    assert {"git_sha", "timestamp", "config_hash"} <= set(provenance)
+    assert len(provenance["config_hash"]) == 16
     # every config ran and reported its structural counters
     queue_attrs = summary.pop("interruption_queue")
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od"}

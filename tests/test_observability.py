@@ -222,6 +222,69 @@ class TestTracingRoutes:
         code, body = self._get(port, "/debug/decisions")
         assert code == 200 and json.loads(body)["records"][0]["pod"] == "pod-x"
 
+    def test_decisions_outcome_filter(self, server):
+        import json
+
+        from karpenter_tpu import tracing
+
+        _, port = server
+        tracing.DECISIONS.record(tracing.DecisionRecord(pod="ok-pod", outcome="placed-new", node="node-1"))
+        tracing.DECISIONS.record(tracing.DecisionRecord(pod="sad-pod", outcome="failed", error="no capacity"))
+        tracing.DECISIONS.record(tracing.DecisionRecord(pod="warm-pod", outcome="placed-existing", node="node-2"))
+
+        code, body = self._get(port, "/debug/decisions?outcome=failed")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["outcome"] == "failed"
+        assert [r["pod"] for r in payload["records"]] == ["sad-pod"]
+
+        # pod + outcome compose; an empty intersection is the 404 JSON shape
+        code, body = self._get(port, "/debug/decisions?pod=ok-pod&outcome=failed")
+        assert code == 404 and json.loads(body)["status"] == 404
+
+        # an unknown outcome value follows the tracing routes' 404-shaped
+        # JSON convention (not a 500, not an HTML error page)
+        code, body = self._get(port, "/debug/decisions?outcome=exploded")
+        assert code == 404
+        payload = json.loads(body)
+        assert payload["status"] == 404 and "exploded" in payload["error"]
+
+    def test_decisions_index_is_bounded(self, server):
+        import json
+
+        from karpenter_tpu import tracing
+
+        _, port = server
+        for i in range(150):
+            tracing.DECISIONS.record(tracing.DecisionRecord(pod=f"p{i}", outcome="failed"))
+
+        code, body = self._get(port, "/debug/decisions")
+        payload = json.loads(body)
+        assert code == 200 and len(payload["records"]) == 100, "default index listing is bounded"
+        assert payload["limit"] == 100
+        assert payload["records"][0]["pod"] == "p149", "newest first"
+
+        code, body = self._get(port, "/debug/decisions?limit=5&outcome=failed")
+        payload = json.loads(body)
+        assert len(payload["records"]) == 5
+        assert [r["pod"] for r in payload["records"]] == ["p149", "p148", "p147", "p146", "p145"]
+
+        # limits clamp instead of serializing the whole ring / erroring on 0
+        code, body = self._get(port, "/debug/decisions?limit=999999")
+        assert code == 200 and len(json.loads(body)["records"]) == 150
+        code, body = self._get(port, "/debug/decisions?limit=0")
+        assert code == 200 and len(json.loads(body)["records"]) == 1
+
+        code, body = self._get(port, "/debug/decisions?limit=nope")
+        assert code == 404 and json.loads(body)["status"] == 404
+
+        # the per-pod path honors the same bound (one hot pod can hold
+        # hundreds of ring entries)
+        for _ in range(4):
+            tracing.DECISIONS.record(tracing.DecisionRecord(pod="hot", outcome="failed"))
+        code, body = self._get(port, "/debug/decisions?pod=hot&limit=2")
+        assert code == 200 and len(json.loads(body)["records"]) == 2
+
 
 class TestWebhookSelfRegistration:
     def test_registration_completes_applied_configurations(self):

@@ -123,6 +123,33 @@ class TestMetricsRegistry:
         b = registry.counter("same_name")
         assert a is b
 
+    def test_export_text_escapes_label_values_and_help(self):
+        """Prometheus exposition escaping: backslash/newline in help text,
+        backslash/quote/newline in label values. Unescaped, any of these
+        corrupts the whole scrape (regression: satellite of ISSUE 6)."""
+        registry = Registry()
+        registry.counter("escaped_total", 'help with \\backslash and\nnewline "quotes" stay', ("path",)).inc(
+            path='C:\\temp\n"dir"'
+        )
+        text = registry.export_text()
+        assert '# HELP escaped_total help with \\\\backslash and\\nnewline "quotes" stay' in text
+        assert 'escaped_total{path="C:\\\\temp\\n\\"dir\\""} 1.0' in text
+        # the raw (unescaped) value must not survive anywhere: a literal
+        # newline or lone backslash inside a sample line splits the scrape
+        assert "C:\\temp\n" not in text
+        sample_lines = [l for l in text.splitlines() if l.startswith("escaped_total{")]
+        assert sample_lines == ['escaped_total{path="C:\\\\temp\\n\\"dir\\""} 1.0']
+
+    def test_summary_objectives_and_series(self):
+        registry = Registry()
+        summary = registry.summary("objective_summary", "help", ("provisioner",), objectives=(0.5, 0.95, 0.99))
+        for i in range(100):
+            summary.observe(i / 100, provisioner="default")
+        assert summary.series() == [{"provisioner": "default"}]
+        assert 0.9 < summary.quantile(0.95, provisioner="default") <= 1.0
+        summary.clear()
+        assert summary.series() == [] and summary.count(provisioner="default") == 0
+
 
 class TestScrapers:
     def test_node_and_pod_and_provisioner_scrape(self):

@@ -1,0 +1,159 @@
+"""Scenario campaign harness: schema validation + live scored runs.
+
+Tier-1: the smoke campaign (a small composed burst + single spot reclaim)
+runs against a LIVE Runtime on both transports and the emitted
+SCENARIO_*.json must validate against the schema — required keys, monotonic
+sample timestamps, provenance block — with zero lost pods and zero budget
+violations. The full five-scenario campaign (ramps, reclaim waves, drift
+rollouts, throttled control plane) runs in the slow tier.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+import pytest
+
+from karpenter_tpu.scenarios import CampaignRunner, default_campaign, scenario_doc_errors, smoke_campaign
+from karpenter_tpu.slo import SLO
+
+
+@pytest.fixture(autouse=True)
+def _slo_teardown():
+    yield
+    SLO.disable()
+    SLO.reset()
+
+
+class TestSchemaValidator:
+    def _valid_doc(self):
+        from karpenter_tpu.provenance import provenance_block
+
+        return {
+            "scenario": "unit",
+            "provenance": provenance_block({"unit": True}),
+            "runs": [
+                {
+                    "transport": "inprocess",
+                    "duration_seconds": 1.0,
+                    "converged": True,
+                    "scores": {
+                        "pending_latency_seconds": {"default": {"p50": 0.1, "p95": 0.2, "p99": 0.3, "count": 4}},
+                        "node_ready_seconds": {},
+                        "cost_per_hour": 1.0,
+                        "ideal_cost_per_hour": 1.0,
+                        "cost_drift_ratio": 1.0,
+                        "lost_pods": 0,
+                        "budget_violations": 0,
+                        "pods_desired": 4,
+                        "pods_bound": 4,
+                        "nodes_churned": {},
+                    },
+                    "samples": [
+                        {"t": 0.0, "pending_pods": 4, "nodes": 0, "cost_per_hour": 0.0, "disrupting": 0},
+                        {"t": 0.5, "pending_pods": 0, "nodes": 1, "cost_per_hour": 1.0, "disrupting": 0},
+                    ],
+                }
+            ],
+        }
+
+    def test_valid_doc_passes(self):
+        assert scenario_doc_errors(self._valid_doc()) == []
+
+    def test_missing_provenance_and_score_keys_named(self):
+        doc = self._valid_doc()
+        del doc["provenance"]["git_sha"]
+        del doc["runs"][0]["scores"]["cost_drift_ratio"]
+        errors = scenario_doc_errors(doc)
+        assert any("git_sha" in e for e in errors)
+        assert any("cost_drift_ratio" in e for e in errors)
+
+    def test_backwards_timestamps_rejected(self):
+        doc = self._valid_doc()
+        doc["runs"][0]["samples"][1]["t"] = -1.0
+        errors = scenario_doc_errors(doc)
+        assert any("monotonic" in e for e in errors)
+
+    def test_non_integer_invariants_rejected(self):
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["lost_pods"] = "zero"
+        assert any("lost_pods" in e for e in scenario_doc_errors(doc))
+
+    def test_empty_runs_rejected(self):
+        doc = self._valid_doc()
+        doc["runs"] = []
+        assert any("runs" in e for e in scenario_doc_errors(doc))
+
+    def test_tampered_copy_differs_from_original(self):
+        doc = self._valid_doc()
+        tampered = copy.deepcopy(doc)
+        tampered["runs"][0]["samples"].append({"t": 0.2})
+        assert scenario_doc_errors(doc) == []
+        assert scenario_doc_errors(tampered) != []
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "http"])
+def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
+    """Tier-1 gate: the smoke scenario against the LIVE Runtime on one
+    transport — real threads, real interruption queue — emits a schema-valid
+    SCENARIO_*.json with the acceptance invariants."""
+    runner = CampaignRunner(out_dir=str(tmp_path), transports=(transport,), convergence_timeout=40.0)
+    docs = runner.run(smoke_campaign())
+    assert len(docs) == 1
+    path = tmp_path / "SCENARIO_smoke_burst.json"
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert scenario_doc_errors(doc) == []
+    (run,) = doc["runs"]
+    assert run["transport"] == transport
+    assert run["converged"] is True, f"smoke scenario did not converge: {run['scores']}"
+    scores = run["scores"]
+    assert scores["lost_pods"] == 0
+    assert scores["budget_violations"] == 0
+    assert scores["pods_bound"] == scores["pods_desired"] == 8
+    # the burst actually flowed through the SLO layer: every pod's pending
+    # latency observed against the default provisioner
+    pending = scores["pending_latency_seconds"]["default"]
+    assert pending["count"] >= 8
+    assert pending["p50"] is not None and pending["p50"] >= 0
+    assert pending["p99"] >= pending["p50"]
+    # capacity was provisioned and priced
+    assert scores["cost_per_hour"] > 0
+    assert scores["cost_drift_ratio"] > 0
+    # the reclaim primitive exercised churn accounting
+    assert sum(scores["nodes_churned"].values()) >= 1
+    # samples cover the whole run with monotonic timestamps (also schema-
+    # checked) and the final sample sees the converged cluster
+    assert len(run["samples"]) >= 3
+    assert run["samples"][-1]["pending_pods"] == 0
+
+
+@pytest.mark.slow
+def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
+    """The acceptance run: >= 5 distinct composed scenarios against the live
+    Runtime on BOTH transports, each emitting a scored artifact with zero
+    lost pods and zero budget violations."""
+    runner = CampaignRunner(out_dir=str(tmp_path), convergence_timeout=90.0)
+    scenarios = default_campaign()
+    assert len(scenarios) >= 5
+    docs = runner.run(scenarios)
+    assert len(docs) == len(scenarios)
+    by_name = {doc["scenario"]: doc for doc in docs}
+    for doc in docs:
+        assert scenario_doc_errors(doc) == [], doc["scenario"]
+        assert {run["transport"] for run in doc["runs"]} == {"inprocess", "http"}
+        for run in doc["runs"]:
+            scores = run["scores"]
+            where = f"{doc['scenario']}/{run['transport']}"
+            assert run["converged"], f"{where}: did not converge ({scores})"
+            assert scores["lost_pods"] == 0, where
+            assert scores["budget_violations"] == 0, where
+            assert scores["cost_drift_ratio"] > 0, where
+            assert scores["pending_latency_seconds"], where
+    # the composed primitives actually happened
+    for run in by_name["spot_reclaim_wave"]["runs"]:
+        assert run["scores"]["nodes_churned"].get("interruption", 0) >= 1, "reclaim wave must churn nodes"
+    for run in by_name["drift_rollout_storm"]["runs"]:
+        churned = run["scores"]["nodes_churned"]
+        assert churned.get("drift", 0) >= 1, f"drift rollout must replace nodes: {churned}"

@@ -1,0 +1,376 @@
+"""SLO accounting layer: pending/ready latency, cost drift, churn, routes.
+
+Covers the watch-driven accountant (slo.py) — including the pendingPods
+semantics a pod deleted while still Pending must follow (no observation, no
+leak, mirroring controllers/metrics/pod.py) — the cost scraper's ideal
+fresh-repack drift ratio (controllers/metrics/slo.py), the /debug/slo read
+surface, and the disabled-is-free guarantee at the same bar as tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import slo
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.slo import SLO, SLOAccountant
+from karpenter_tpu.utils.clock import FakeClock
+from tests.helpers import make_node, make_pod, make_provisioner
+
+
+@pytest.fixture
+def accountant():
+    """Enable the process-wide accountant for one test, restoring the
+    disabled default (and clearing every SLO family) afterwards."""
+    SLO.enable()
+    SLO.reset()
+    yield SLO
+    SLO.disable()
+    SLO.reset()
+
+
+def _cluster():
+    clock = FakeClock()
+    kube = KubeCluster(clock=clock)
+    return kube, clock
+
+
+def _ready_node(name="node-ready-1", provisioner="default"):
+    return make_node(
+        name=name,
+        labels={lbl.PROVISIONER_NAME_LABEL: provisioner, lbl.LABEL_INSTANCE_TYPE: "fake-it-1"},
+        allocatable={"cpu": 16, "memory": "32Gi", "pods": 100},
+    )
+
+
+class TestPendingLatency:
+    def test_bind_observes_creation_to_bind_per_provisioner(self, accountant):
+        kube, clock = _cluster()
+        accountant.attach(kube)
+        node = _ready_node()
+        kube.create(node)
+        pod = make_pod()
+        kube.create(pod)
+        assert accountant.pending_count() == 1
+        clock.step(2.5)
+        kube.bind_pod(pod, node.name)
+        assert accountant.pending_count() == 0
+        assert slo.PENDING_LATENCY.count(provisioner="default") == 1
+        assert slo.PENDING_LATENCY.quantile(0.5, provisioner="default") == pytest.approx(2.5)
+
+    def test_pod_deleted_while_pending_observes_nothing_and_leaks_nothing(self, accountant):
+        """The pendingPods semantics (controllers/metrics/pod.py): a pod that
+        dies Pending is not a latency sample — and its uid must not pin the
+        pending set forever on churning unschedulable workloads."""
+        kube, clock = _cluster()
+        accountant.attach(kube)
+        doomed = [make_pod() for _ in range(5)]
+        for pod in doomed:
+            kube.create(pod)
+        assert accountant.pending_count() == 5
+        clock.step(10)
+        for pod in doomed:
+            kube.delete(pod, grace=False)
+        assert accountant.pending_count() == 0, "deleted-while-pending pods must not leak"
+        assert slo.PENDING_LATENCY.series() == [], "no observation may be recorded"
+        assert slo.PENDING_PODS.value() == 0
+
+    def test_pod_failing_terminal_while_pending_is_discarded(self, accountant):
+        kube, _ = _cluster()
+        accountant.attach(kube)
+        pod = make_pod()
+        kube.create(pod)
+        pod.status.phase = "Failed"
+        kube.update(pod)
+        assert accountant.pending_count() == 0
+        assert slo.PENDING_LATENCY.series() == []
+
+    def test_bind_without_known_pending_start_is_skipped(self, accountant):
+        """Attach mid-flight: a pod first seen already bound must not record
+        a bogus day-old latency."""
+        kube, _ = _cluster()
+        node = _ready_node()
+        kube.create(node)
+        pod = make_pod(node_name=node.name, phase="Running", unschedulable=False)
+        kube.create(pod)  # never seen Pending before attach
+        accountant.attach(kube)
+        kube.update(pod)
+        assert slo.PENDING_LATENCY.series() == []
+
+
+class TestNodeReadyLatency:
+    def test_not_ready_node_observes_on_ready_flip(self, accountant):
+        kube, clock = _cluster()
+        accountant.attach(kube)
+        node = make_node(name="slow-boot", labels={lbl.PROVISIONER_NAME_LABEL: "default"}, ready=False, allocatable={"cpu": 4})
+        kube.create(node)
+        clock.step(3.0)
+        from karpenter_tpu.api.objects import NodeCondition
+
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        kube.update(node)
+        assert slo.NODE_READY.count(provisioner="default") == 1
+        assert slo.NODE_READY.quantile(0.5, provisioner="default") == pytest.approx(3.0)
+        # a second Ready update must not double-observe
+        kube.update(node)
+        assert slo.NODE_READY.count(provisioner="default") == 1
+
+    def test_born_ready_node_observes_zero(self, accountant):
+        kube, _ = _cluster()
+        accountant.attach(kube)
+        kube.create(_ready_node())
+        assert slo.NODE_READY.count(provisioner="default") == 1
+        assert slo.NODE_READY.quantile(0.5, provisioner="default") == pytest.approx(0.0)
+
+
+class TestChurnCounters:
+    def test_node_deletions_classified_by_reason(self, accountant):
+        from karpenter_tpu.api.objects import Taint
+
+        kube, _ = _cluster()
+        accountant.attach(kube)
+        interrupted = _ready_node(name="chrn-interrupted")
+        interrupted.spec.taints.append(Taint(key=lbl.TAINT_INTERRUPTION, value="interrupting", effect="NoSchedule"))
+        drifted = _ready_node(name="chrn-drifted")
+        drifted.metadata.annotations[lbl.DRIFTED_ANNOTATION] = "true"
+        empty = _ready_node(name="chrn-empty")
+        empty.metadata.annotations[lbl.EMPTINESS_TIMESTAMP_ANNOTATION] = "123"
+        plain = _ready_node(name="chrn-plain")
+        for node in (interrupted, drifted, empty, plain):
+            kube.create(node)
+            kube.delete(node, grace=False)
+        assert slo.NODES_CHURNED.value(reason="interruption") == 1
+        assert slo.NODES_CHURNED.value(reason="drift") == 1
+        assert slo.NODES_CHURNED.value(reason="emptiness") == 1
+        assert slo.NODES_CHURNED.value(reason="other") == 1
+
+    def test_pod_displaced_off_dying_capacity_counts(self, accountant):
+        kube, _ = _cluster()
+        accountant.attach(kube)
+        node = _ready_node(name="chrn-cordoned")
+        kube.create(node)
+        victim = make_pod()
+        kube.create(victim)
+        kube.bind_pod(victim, node.name)
+        node.spec.unschedulable = True
+        kube.update(node)
+        kube.delete(victim, grace=False)
+        assert slo.PODS_DISPLACED.value() == 1
+        # a bound pod deleted off a healthy node is scale-down, not fallout
+        healthy = _ready_node(name="chrn-healthy")
+        kube.create(healthy)
+        normal = make_pod()
+        kube.create(normal)
+        kube.bind_pod(normal, healthy.name)
+        kube.delete(normal, grace=False)
+        assert slo.PODS_DISPLACED.value() == 1
+
+
+class TestCostDrift:
+    def _scraped_env(self):
+        from karpenter_tpu.controllers.metrics.slo import SLOScraper
+        from tests.env import Environment
+
+        env = Environment()
+        env.kube.create(make_provisioner())
+        scraper = SLOScraper(
+            env.kube, env.cluster, env.provider, provisioner_controller=env.provisioner_controller, accountant=SLO
+        )
+        return env, scraper
+
+    def test_fresh_cluster_has_unit_drift(self, accountant):
+        env, scraper = self._scraped_env()
+        for _ in range(6):
+            env.kube.create(make_pod(requests={"cpu": 1, "memory": "1Gi"}))
+        env.provision()
+        env.bind_nominated()
+        scraper.scrape()
+        assert slo.CLUSTER_COST.value() > 0
+        assert slo.IDEAL_COST.value() > 0
+        assert slo.COST_DRIFT.value() == pytest.approx(1.0, rel=0.25), "a fresh pack should cost ~the ideal"
+
+    def test_leftover_capacity_raises_the_drift_ratio(self, accountant):
+        env, scraper = self._scraped_env()
+        for _ in range(4):
+            env.kube.create(make_pod(requests={"cpu": 1, "memory": "1Gi"}))
+        env.provision()
+        env.bind_nominated()
+        scraper.scrape()
+        base = slo.COST_DRIFT.value()
+        # an empty leftover node: pure cost, no workload — drift must rise
+        leftover = make_node(
+            labels={
+                lbl.PROVISIONER_NAME_LABEL: "default",
+                lbl.LABEL_INSTANCE_TYPE: "default-instance-type",
+                lbl.LABEL_NODE_INITIALIZED: "true",
+            },
+            allocatable={"cpu": 15, "memory": "120Gi", "pods": 110},
+        )
+        env.kube.create(leftover)
+        scraper.scrape()
+        assert slo.COST_DRIFT.value() > base
+
+    def test_empty_workload_reports_neutral_drift(self, accountant):
+        env, scraper = self._scraped_env()
+        scraper.scrape()
+        assert slo.COST_DRIFT.value() == 1.0
+        assert slo.IDEAL_COST.value() == 0.0
+
+    def test_scrape_is_noop_when_disabled(self):
+        assert not SLO.enabled
+        env, scraper = self._scraped_env()
+        env.kube.create(make_pod(requests={"cpu": 1, "memory": "1Gi"}))
+        env.provision()
+        scraper.scrape()
+        assert slo.CLUSTER_COST.value() == 0.0
+
+
+class TestDisabledIsFree:
+    def test_disabled_accountant_allocates_nothing(self):
+        """The acceptance bar (same as tracing): with SLO accounting off,
+        the watch hot path keeps no per-pod state and records nothing."""
+        fresh = SLOAccountant()
+        kube, clock = _cluster()
+        fresh.attach(kube)
+        node = _ready_node()
+        kube.create(node)
+        for _ in range(10):
+            pod = make_pod()
+            kube.create(pod)
+            kube.bind_pod(pod, node.name)
+            kube.delete(pod, grace=False)
+        assert fresh._pending is None, "disabled accountant must not allocate its pending set"
+        assert fresh._nodes_becoming_ready is None
+        assert fresh.pending_count() == 0
+
+    def test_enabled_overhead_within_bound(self, accountant):
+        """Regression tripwire, not a microbenchmark: SLO accounting on the
+        create/bind/delete hot path must stay within the tracing bar."""
+        def churn_once(with_slo: bool) -> float:
+            kube, _ = _cluster()
+            if with_slo:
+                SLO.attach(kube)
+            node = _ready_node()
+            kube.create(node)
+            start = time.perf_counter()
+            for _ in range(300):
+                pod = make_pod()
+                kube.create(pod)
+                kube.bind_pod(pod, node.name)
+                kube.delete(pod, grace=False)
+            return time.perf_counter() - start
+
+        untraced, traced = [], []
+        for _ in range(3):
+            SLO.disable()
+            untraced.append(churn_once(False))
+            SLO.enable()
+            traced.append(churn_once(True))
+        base, with_slo = min(untraced), min(traced)
+        assert with_slo <= base * 3.0 + 0.25, (
+            f"SLO overhead too high: {with_slo * 1000:.1f}ms enabled vs {base * 1000:.1f}ms disabled"
+        )
+
+
+class TestSnapshotAndRoute:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    def test_snapshot_shape(self, accountant):
+        kube, clock = _cluster()
+        accountant.attach(kube)
+        node = _ready_node()
+        kube.create(node)
+        pod = make_pod()
+        kube.create(pod)
+        clock.step(1.0)
+        kube.bind_pod(pod, node.name)
+        snap = accountant.snapshot()
+        assert snap["enabled"] is True
+        entry = snap["pod_pending_latency_seconds"]["default"]
+        assert entry["count"] == 1 and entry["p50"] == pytest.approx(1.0)
+        assert {"p50", "p95", "p99"} <= set(entry)
+        assert set(snap["cost"]) == {"cluster_cost_per_hour", "ideal_cost_per_hour", "cost_drift_ratio"}
+        json.dumps(snap)  # strictly serializable (no NaN leaks)
+
+    def test_debug_slo_route_serves_live_snapshot(self, accountant):
+        from karpenter_tpu.observability import ObservabilityServer
+
+        kube, clock = _cluster()
+        accountant.attach(kube)
+        server = ObservabilityServer(
+            healthy=lambda: True,
+            ready=lambda: True,
+            health_port=None,
+            metrics_port=0,
+            host="127.0.0.1",
+            registry=Registry(),
+            extra_routes=slo.routes(),
+        )
+        server.start()
+        (port,) = server.ports
+        try:
+            node = _ready_node()
+            kube.create(node)
+            pod = make_pod()
+            kube.create(pod)
+            clock.step(0.5)
+            kube.bind_pod(pod, node.name)
+            code, body = self._get(port, "/debug/slo")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["enabled"] is True
+            assert payload["pod_pending_latency_seconds"]["default"]["count"] == 1
+        finally:
+            server.stop()
+
+    def test_slo_route_absent_by_default(self):
+        from karpenter_tpu.observability import ObservabilityServer
+
+        server = ObservabilityServer(
+            healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=0, host="127.0.0.1", registry=Registry()
+        )
+        server.start()
+        (port,) = server.ports
+        try:
+            assert self._get(port, "/debug/slo")[0] == 404, "SLO routes are opt-in (--enable-slo)"
+        finally:
+            server.stop()
+
+    def test_runtime_wires_slo_behind_option(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        SLO.reset()
+        try:
+            kube = KubeCluster(clock=FakeClock())
+            rt = Runtime(
+                kube=kube,
+                cloud_provider=FakeCloudProvider(instance_types(2)),
+                options=Options(leader_elect=False, dense_solver_enabled=False, enable_slo=True),
+            )
+            try:
+                assert SLO.enabled
+                kube.create(make_provisioner())
+                kube.create(make_pod(requests={"cpu": 1, "memory": "1Gi"}))
+                rt.provision_once()
+                rt.reconcile_once()  # includes the slo-metrics pass
+                assert slo.CLUSTER_COST.value() > 0
+            finally:
+                rt.stop()
+                LeaderElector._leader = None
+        finally:
+            SLO.disable()
+            SLO.reset()
