@@ -35,6 +35,19 @@ PROVISIONER_HASH_ANNOTATION = GROUP + "/provisioner-hash"
 # set by the disruption controller's drift method when the recorded hash no
 # longer matches the Provisioner + launch template
 DRIFTED_ANNOTATION = GROUP + "/drifted"
+# durable crash-consistency markers (the disruption ledger is in-memory, so
+# a restarted controller reconstructs it from these):
+#  - disrupting: stamped (value = the disruption method) on a candidate the
+#    moment its budget charge lands, cleared when the command unwinds; a node
+#    carrying it WITH a deletion timestamp is mid-voluntary-drain and must be
+#    re-charged on restart, WITHOUT one it was stranded pre-drain by a crash
+#    and must be released (uncordoned + cleared)
+#  - replacement-for: stamped on replacement nodes at launch (value = the
+#    comma-joined candidate names); on restart an uninitialized replacement
+#    whose candidates still exist is reaped (its command died with the old
+#    process), one whose candidates are gone is adopted
+DISRUPTING_ANNOTATION = GROUP + "/disrupting"
+REPLACEMENT_FOR_ANNOTATION = GROUP + "/replacement-for"
 TERMINATION_FINALIZER = GROUP + "/termination"
 
 # Node lifecycle taints (mirrors k8s well-known taints)

@@ -29,20 +29,24 @@ Error taxonomy is structured, not stringly: a failed CreateFleet returns
 client maps back to the typed exceptions the provider's ICE/negative-cache
 handling consumes — the per-item error extraction of instance.go:133-208.
 
-CreateFleet is idempotent under client tokens: the service remembers
-{token -> response} and replays it, so a client retrying a request whose
-RESPONSE was lost (mid-call timeout) can never double-launch — EC2's
-ClientToken contract.
+CreateFleet is idempotent under client tokens: the token rides the
+FleetRequest down into the BACKEND, which remembers {token -> instance} and
+replays it, so a client retrying a request whose RESPONSE was lost
+(mid-call timeout) can never double-launch — EC2's ClientToken contract.
+Dedup living in the backend (not here) means BOTH transports share one
+contract; the backend lock serializes a retry racing the original call.
 
 Transport fault injection (for the client's retry/backoff contract):
-  service.throttle_next(n)   next n requests get 429 + Retry-After
-  service.fail_next(n)       next n requests get 500
-  service.drop_next(n)       next n requests are PROCESSED, then the
-                             connection closes with no response bytes —
-                             the mid-CreateFleet-timeout shape
-  service.delay_next(n, s)   next n requests are held s seconds before
-                             processing — injected transport latency
-                             (slow apiserver/cloud, not a failure)
+  service.throttle_next(n)        next n requests get 429 + Retry-After
+  service.fail_next(n)            next n requests get 500 BEFORE processing
+  service.drop_response_next(n)   next n requests are PROCESSED, then the
+                                  connection closes with no response bytes —
+                                  the mid-CreateFleet-timeout shape fail_next
+                                  cannot exercise (drop_next is the legacy
+                                  alias)
+  service.delay_next(n, s)        next n requests are held s seconds before
+                                  processing — injected transport latency
+                                  (slow apiserver/cloud, not a failure)
 """
 
 from __future__ import annotations
@@ -78,13 +82,6 @@ class CloudAPIService:
         self._delay = 0
         self._delay_seconds = 0.0
         self.requests_served = 0
-        # idempotency token -> in-flight/settled record: {"event", "response",
-        # "error"}. The record is inserted UNDER the lock BEFORE the launch
-        # runs, so a timeout-retry arriving while the original handler is
-        # still executing waits for the settled outcome instead of launching
-        # a second instance (the ClientToken contract the docstring claims)
-        self._fleet_lock = threading.Lock()
-        self.fleet_tokens: Dict[str, dict] = {}
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -206,9 +203,17 @@ class CloudAPIService:
         with self._fault_lock:
             self._fail = n
 
-    def drop_next(self, n: int) -> None:
+    def drop_response_next(self, n: int) -> None:
+        """The next n requests are fully PROCESSED — a CreateFleet launches
+        its instance — but the connection closes before any response bytes,
+        so the client sees a dead socket and must retry with its idempotency
+        token. fail_next rejects BEFORE processing and cannot exercise the
+        lost-response path; this fault exists precisely for it."""
         with self._fault_lock:
             self._drop = n
+
+    # legacy spelling, kept for callers predating the rename
+    drop_next = drop_response_next
 
     def delay_next(self, n: int, seconds: float) -> None:
         """Hold the next n requests `seconds` before processing them —
@@ -251,38 +256,17 @@ class CloudAPIService:
                 be.delete_launch_template(parts[2])
                 return 200, {}
         if parts[:2] == ["v1", "fleet"] and method == "POST":
+            # the token rides into the backend, which owns the dedup: a
+            # retry racing the still-executing original serializes on the
+            # backend lock and replays the settled instance
             request = FleetRequest(
                 specs=[FleetInstanceSpec(**spec) for spec in body.get("specs", [])],
                 capacity_type=body.get("capacity_type", ""),
+                client_token=body.get("idempotency_token", ""),
             )
-            token = body.get("idempotency_token", "")
-            if not token:
-                return 200, asdict(be.create_fleet(request))
-            with self._fleet_lock:
-                entry = self.fleet_tokens.get(token)
-                owner = entry is None
-                if owner:
-                    entry = {"event": threading.Event(), "response": None, "error": None}
-                    self.fleet_tokens[token] = entry
-            if not owner:
-                # a concurrent retry of the same logical launch: wait for the
-                # original attempt's outcome and replay it verbatim
-                entry["event"].wait(timeout=30.0)
-                if entry["response"] is not None:
-                    return 200, entry["response"]
-                if entry["error"] is not None:
-                    raise entry["error"]
-                return 500, {"error": {"code": "internal", "message": "idempotent launch still in flight"}}
-            try:
-                response = asdict(be.create_fleet(request))
-            except Exception as err:
-                entry["error"] = err
-                raise
-            else:
-                entry["response"] = response
-                return 200, response
-            finally:
-                entry["event"].set()
+            return 200, asdict(be.create_fleet(request))
+        if parts == ["v1", "instances"] and method == "GET":
+            return 200, {"items": [asdict(i) for i in be.list_instances()]}
         if parts[:2] == ["v1", "instances"] and len(parts) == 3:
             if method == "GET":
                 if be.instance_exists(parts[2]):

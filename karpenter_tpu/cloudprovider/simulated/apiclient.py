@@ -10,8 +10,13 @@ the protocol), mirroring the reference's remote-API client obligations
   - bearer-token auth and a connectivity dry-run (`verify()`, the session
     GetCallerIdentity analog) so a misconfigured endpoint fails at startup,
     not mid-provisioning;
-  - retry with exponential backoff + decorrelated jitter on 429 (honoring
-    Retry-After), 5xx, and transport errors, bounded by max_attempts;
+  - retry with exponential backoff + FULL jitter (the aws-sdk recipe:
+    sleep ~ uniform(0, min(cap, base * 2^attempt))) on 429 (honoring a
+    throttle's Retry-After as the floor), 5xx, and transport errors, bounded
+    by max_attempts AND a per-request deadline so one logical call can never
+    stall its controller loop longer than the budget;
+  - observability: karpenter_cloudapi_retries_total{code} counts every
+    retried attempt by the failure class that caused it;
   - pagination for the instance-type catalog;
   - a typed error taxonomy: structured error bodies map back to
     InsufficientCapacityError (with per-pool extraction) and
@@ -34,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import quote, urlparse
 
 from ...logsetup import get_logger
+from ...metrics import REGISTRY
 from ...utils.clock import Clock
 from .backend import (
     FleetInstance,
@@ -52,6 +58,10 @@ MAX_ATTEMPTS = 6
 BACKOFF_BASE = 0.05
 BACKOFF_CAP = 2.0
 PAGE_SIZE = 50
+# total time budget for ONE logical call (all attempts + backoffs, judged on
+# the client's clock): a degraded cloud must surface as a typed error within
+# the budget, not stall a controller loop across minutes of backoff
+REQUEST_DEADLINE = 30.0
 
 
 class CloudAPIError(RuntimeError):
@@ -76,6 +86,7 @@ class CloudAPIClient:
         max_attempts: int = MAX_ATTEMPTS,
         backoff_base: float = BACKOFF_BASE,
         timeout: float = 10.0,
+        request_deadline: float = REQUEST_DEADLINE,
         sleep=None,
     ):
         parsed = urlparse(base_url)
@@ -86,12 +97,18 @@ class CloudAPIClient:
         self.max_attempts = max_attempts
         self.backoff_base = backoff_base
         self.timeout = timeout
+        self.request_deadline = request_deadline
         # backoff sleeps through the clock (FakeClock advances virtually) so
         # fake-clocked suites never burn real wall time on retries; an
         # explicit `sleep` hook wins (tests capture the schedule)
         self._sleep = sleep if sleep is not None else self.clock.sleep
         self._rng = random.Random(0x5EED)
         self.retries = 0  # observable: total retried attempts
+        self.retries_total = REGISTRY.counter(
+            "karpenter_cloudapi_retries_total",
+            "Cloud API attempts retried, by the failure class that caused the retry",
+            ("code",),
+        )
 
     # -- transport -----------------------------------------------------------
 
@@ -110,26 +127,34 @@ class CloudAPIClient:
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         """One logical API call: retries transport errors, 429 (honoring
-        Retry-After), and 5xx with exponential backoff + decorrelated
-        jitter; maps structured errors to the typed taxonomy."""
+        Retry-After), and 5xx with exponential backoff + full jitter, bounded
+        by max_attempts AND the per-request deadline; maps structured errors
+        to the typed taxonomy."""
+        started = self.clock.now()
         last_error: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             if attempt:
                 self.retries += 1
+            if attempt and self.clock.now() - started >= self.request_deadline:
+                raise CloudAPIError(
+                    f"{method} {path} exceeded the {self.request_deadline:.1f}s request deadline: {last_error}",
+                    status=getattr(last_error, "status", None),
+                    code="deadline_exceeded",
+                )
             try:
                 status, parsed, headers = self._once(method, path, body)
             except OSError as err:  # connection refused/reset, timeout
                 last_error = err
-                self._backoff(attempt, None)
+                self._backoff(attempt, None, started, code="transport")
                 continue
             if status == 429:
                 last_error = CloudAPIError("throttled", status=429, code="throttled")
-                self._backoff(attempt, headers.get("Retry-After"))
+                self._backoff(attempt, headers.get("Retry-After"), started, code="throttled")
                 continue
             if status >= 500:
                 message = (parsed.get("error") or {}).get("message", "internal error")
                 last_error = CloudAPIError(message, status=status, code="internal")
-                self._backoff(attempt, None)
+                self._backoff(attempt, None, started, code="internal")
                 continue
             if status == 401:
                 raise AuthError("unauthorized: check the cloud API bearer token", status=401, code="unauthorized")
@@ -150,17 +175,23 @@ class CloudAPIClient:
             code=getattr(last_error, "code", None) or "exhausted",
         )
 
-    def _backoff(self, attempt: int, retry_after: Optional[str]) -> None:
+    def _backoff(self, attempt: int, retry_after: Optional[str], started: float, code: str = "transport") -> None:
+        """Sleep before the retry the caller is about to make: exponential
+        cap with FULL jitter (uniform over [0, cap] — the aws-sdk
+        FullJitter recipe that decorrelates a thundering herd better than
+        any fixed fraction), a throttle's Retry-After as the floor, and the
+        whole thing clamped to the remaining request deadline."""
+        self.retries_total.inc(code=code)
+        cap = min(BACKOFF_CAP, self.backoff_base * (2**attempt))
+        delay = self._rng.uniform(0.0, cap)
         if retry_after is not None:
             try:
                 hint = float(retry_after)
             except ValueError:
                 hint = 0.0
-            delay = max(hint, self.backoff_base)
-        else:
-            # decorrelated jitter, capped (aws-sdk backoff idiom)
-            delay = min(BACKOFF_CAP, self.backoff_base * (2**attempt)) * (0.5 + self._rng.random() / 2)
-        self._sleep(delay)
+            delay = max(hint, delay)
+        remaining = self.request_deadline - (self.clock.now() - started)
+        self._sleep(max(0.0, min(delay, remaining)))
 
     # -- connectivity dry-run -----------------------------------------------
 
@@ -222,8 +253,12 @@ class CloudAPIClient:
         self._call("DELETE", f"/v1/launch-templates/{quote(name)}")
 
     def create_fleet(self, request: FleetRequest) -> FleetInstance:
+        # the request's own client token wins (callers like the fleet
+        # batcher coin one per LOGICAL launch, so an application-level retry
+        # dedupes too); a token-less request still gets a per-call token so
+        # the transport retry inside _call can never double-launch
         body = {
-            "idempotency_token": uuid.uuid4().hex,
+            "idempotency_token": request.client_token or uuid.uuid4().hex,
             "capacity_type": request.capacity_type,
             "specs": [
                 {
@@ -251,6 +286,10 @@ class CloudAPIClient:
             return True
         except _RemoteNotFound:
             return False
+
+    def list_instances(self) -> List[FleetInstance]:
+        page = self._call("GET", "/v1/instances")
+        return [FleetInstance(**item) for item in page.get("items", [])]
 
     # -- notification queue (notifications.py over the wire) -----------------
 

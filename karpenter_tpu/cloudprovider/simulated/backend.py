@@ -66,6 +66,12 @@ class FleetInstanceSpec:
 class FleetRequest:
     specs: List[FleetInstanceSpec]
     capacity_type: str
+    # client idempotency token (the EC2 ClientToken analog): the backend
+    # remembers {token -> instance} and REPLAYS the original launch for any
+    # retry carrying the same token, so a caller whose response was lost
+    # (mid-call timeout, process crash after the launch ran) can retry
+    # without double-launching. Empty = no dedup (every call launches).
+    client_token: str = ""
 
 
 @dataclass
@@ -75,6 +81,10 @@ class FleetInstance:
     zone: str
     capacity_type: str
     subnet_id: str = ""
+    # launch instant on the owning clock: the GC sweep's registration grace
+    # period is judged against this (an instance with no node object older
+    # than the grace is an orphan)
+    launched_at: float = 0.0
 
 
 class LaunchTemplateNotFoundError(RuntimeError):
@@ -91,6 +101,17 @@ class InsufficientCapacityError(RuntimeError):
     def __init__(self, pools):
         super().__init__(f"insufficient capacity for {pools}")
         self.pools = pools
+
+
+class TransientCloudError(RuntimeError):
+    """A transport-shaped failure the caller may retry (with the same client
+    token) — the operation's outcome is UNKNOWN to the caller."""
+
+
+class ResponseLostError(TransientCloudError):
+    """The request was fully processed but the response never arrived — the
+    in-process analog of the mid-CreateFleet connection loss the HTTP
+    service injects with drop_response_next()."""
 
 
 def default_catalog() -> List[InstanceTypeInfo]:
@@ -163,9 +184,18 @@ class CloudBackend:
             for info in self.catalog
             for subnet in self.subnets
         }
+        # idempotency: settled launches by client token, bounded (insertion
+        # order == age; an ordered-dict cap like the interruption
+        # controller's TTL maps). Only SUCCESSFUL launches are recorded —
+        # a failed create may be retried with the same token, EC2-style.
+        self.fleet_tokens: Dict[str, FleetInstance] = {}
+        self._fleet_token_cap = 4096
         # fault injection
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()  # (type, zone, capacity_type)
         self.next_error: Optional[Exception] = None
+        # next n create_fleet calls EXECUTE, then lose their response
+        # (ResponseLostError) — the in-process drop_response_next analog
+        self._drop_response = 0
         # sustained API latency (seconds) applied to every control-plane
         # verb (describes, price books, fleet, terminate) — the in-process
         # analog of a degraded cloud; scenario primitives raise it mid-storm
@@ -245,12 +275,28 @@ class CloudBackend:
 
     # -- fleet ---------------------------------------------------------------------
 
+    def drop_response_next(self, n: int) -> None:
+        """The next n create_fleet calls run to completion — the instance
+        launches — but raise ResponseLostError instead of returning, so the
+        caller cannot tell a launch happened. A retry with the same client
+        token replays the settled launch; a token-less retry double-launches
+        (which is exactly what the idempotency tests prove)."""
+        with self._lock:
+            self._drop_response = max(0, n)
+
     def create_fleet(self, request: FleetRequest) -> FleetInstance:
         """Launch ONE instance from the cheapest available spec (the
         lowest-price / capacity-optimized strategies collapse to this in a
-        simulator with explicit price books)."""
+        simulator with explicit price books). Idempotent under client
+        tokens: a token seen before replays the original instance without
+        launching (EC2 ClientToken semantics); the lock serializes a retry
+        racing the original call."""
         self._simulate_latency()
         with self._lock:
+            if request.client_token:
+                settled = self.fleet_tokens.get(request.client_token)
+                if settled is not None:
+                    return settled
             if self.next_error is not None:
                 err, self.next_error = self.next_error, None
                 raise err
@@ -287,8 +333,18 @@ class CloudBackend:
                 subnet_id=spec.subnet_id,
                 zone=spec.zone,
                 capacity_type=spec.capacity_type,
+                launched_at=self.clock.now(),
             )
             self.instances[instance.instance_id] = instance
+            if request.client_token:
+                while len(self.fleet_tokens) >= self._fleet_token_cap:
+                    del self.fleet_tokens[next(iter(self.fleet_tokens))]
+                self.fleet_tokens[request.client_token] = instance
+            if self._drop_response > 0:
+                # the launch HAPPENED (and its token is settled above); only
+                # the response is lost — a tokened retry replays it
+                self._drop_response -= 1
+                raise ResponseLostError(f"create_fleet response lost (instance {instance.instance_id} launched)")
             return instance
 
     def terminate_instance(self, instance_id: str) -> None:
@@ -303,6 +359,13 @@ class CloudBackend:
     def instance_exists(self, instance_id: str) -> bool:
         with self._lock:
             return instance_id in self.instances
+
+    def list_instances(self) -> List[FleetInstance]:
+        """Every live instance — the DescribeInstances sweep the GC
+        controller reconciles against node objects."""
+        self._simulate_latency()
+        with self._lock:
+            return list(self.instances.values())
 
     # -- lifecycle notifications (the EventBridge-rule analogs) --------------
     # Fault-injection seams: tests and chaos drivers call these to make the
@@ -360,6 +423,7 @@ class CloudBackend:
         with self._lock:
             self.insufficient_capacity_pools = set()
             self.next_error = None
+            self._drop_response = 0
             self.api_latency = 0.0
             self.create_fleet_calls = []
             self.terminate_calls = []

@@ -4,24 +4,37 @@ Mirrors pkg/cloudprovider/aws/createfleetbatcher.go:40-197 — concurrent
 create() calls for the same launch configuration collapse into one backend
 call whose results fan out to the waiters, cutting API pressure during
 launch storms.
+
+Each waiter's OWN client token rides its launch (a waiter with no token
+gets one coined at join), and the waiter receives exactly the instance
+launched under its token — so an application-level retry of any one
+logical launch, even one that joined a batch as a follower, replays its
+token and dedupes at the backend. A call whose RESPONSE is lost
+(ResponseLostError / a dead transport mid-call) is retried with the same
+token for the same reason: a lost response never double-launches and never
+loses the instance it paid for.
 """
 
 from __future__ import annotations
 
 import threading
+import uuid
+from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
-from .backend import CloudBackend, FleetInstance, FleetRequest
+from .backend import CloudBackend, FleetInstance, FleetRequest, TransientCloudError
 
 BATCH_WINDOW_SECONDS = 0.05
+# attempts per backend call when the response is lost; each retry replays
+# the same client token, so the worst case is one launch + N-1 replays
+LOST_RESPONSE_ATTEMPTS = 3
 
 
 class _Batch:
-    def __init__(self, request: FleetRequest):
-        self.request = request
-        self.waiters = 1
+    def __init__(self):
+        self.tokens: List[str] = []  # one per waiter, index == waiter slot
         self.done = threading.Event()
-        self.results: List[FleetInstance] = []
+        self.results: Dict[int, FleetInstance] = {}  # waiter slot -> its instance
         self.error: Optional[Exception] = None
 
 
@@ -39,38 +52,51 @@ class CreateFleetBatcher:
         self._lock = threading.Lock()
         self._pending: Dict[Tuple, _Batch] = {}
 
+    def _create_one(self, request: FleetRequest, token: str) -> FleetInstance:
+        """One instance launch, idempotent under retry: the waiter's token
+        rides the call and is replayed verbatim when the response is lost."""
+        tokened = replace(request, client_token=token)
+        last: Optional[Exception] = None
+        for _ in range(LOST_RESPONSE_ATTEMPTS):
+            try:
+                return self.backend.create_fleet(tokened)
+            except TransientCloudError as err:
+                last = err  # outcome unknown: replay the same token
+        raise last
+
     def create_fleet(self, request: FleetRequest) -> FleetInstance:
         key = _request_key(request)
+        token = request.client_token or uuid.uuid4().hex
         with self._lock:
             batch = self._pending.get(key)
-            if batch is not None:
-                batch.waiters += 1
-                leader = False
-            else:
-                batch = _Batch(request)
+            leader = batch is None
+            if leader:
+                batch = _Batch()
                 self._pending[key] = batch
-                leader = True
+            slot = len(batch.tokens)
+            batch.tokens.append(token)
         if leader:
             # the leader waits out the window for followers to pile on, then
-            # issues one backend call per waiter (one instance each) in a
-            # single burst
+            # issues one backend call per waiter — each under THAT waiter's
+            # token — in a single burst
             threading.Event().wait(self.window)
             with self._lock:
                 del self._pending[key]
-                waiters = batch.waiters
+                tokens = list(batch.tokens)
             try:
-                for _ in range(waiters):
-                    batch.results.append(self.backend.create_fleet(request))
+                for i, waiter_token in enumerate(tokens):
+                    batch.results[i] = self._create_one(request, waiter_token)
             except Exception as e:  # noqa: BLE001
                 # partial burst: instances already launched still go to
-                # waiters (no orphaned capacity); only the shortfall errors
+                # their waiters (no orphaned capacity); only the shortfall
+                # errors
                 batch.error = e
             batch.done.set()
         else:
             batch.done.wait()
-        with self._lock:
-            if batch.results:
-                return batch.results.pop()
+        instance = batch.results.get(slot)
+        if instance is not None:
+            return instance
         if batch.error is not None:
             raise batch.error
         raise RuntimeError("fleet batch returned no instance")

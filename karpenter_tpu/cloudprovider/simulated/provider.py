@@ -297,8 +297,15 @@ class SimulatedCloudProvider(CloudProvider):
         # prefer spot when allowed (lowest-price strategy picks it anyway)
         capacity_type = lbl.CAPACITY_TYPE_SPOT if lbl.CAPACITY_TYPE_SPOT in capacity_types else lbl.CAPACITY_TYPE_ON_DEMAND
 
+        import uuid
+
         try:
-            instance = self.fleet_batcher.create_fleet(FleetRequest(specs=specs, capacity_type=capacity_type))
+            # one client token per LOGICAL launch: the batcher derives its
+            # per-waiter tokens from it and replays them on lost responses,
+            # so a transport failure mid-CreateFleet can never double-launch
+            instance = self.fleet_batcher.create_fleet(
+                FleetRequest(specs=specs, capacity_type=capacity_type, client_token=uuid.uuid4().hex)
+            )
         except InsufficientCapacityError as err:
             # feed the negative cache so the next solve avoids these pools
             for type_name, zone, ct in err.pools:
@@ -350,3 +357,14 @@ class SimulatedCloudProvider(CloudProvider):
         if not node.spec.provider_id.startswith("sim:///"):
             return None  # not ours to answer for
         return self.backend.instance_exists(node.spec.provider_id.split("///", 1)[1])
+
+    def list_instances(self):
+        """Every live cloud instance (id, launch time) — the GC sweep's
+        source of truth for the orphan direction. Works on both transports:
+        CloudBackend and CloudAPIClient each expose list_instances()."""
+        return self.backend.list_instances()
+
+    def terminate_instance(self, instance_id: str) -> None:
+        """Terminate by raw instance id (the GC sweep holds no Node object
+        for an orphan — that is what makes it an orphan)."""
+        self.backend.terminate_instance(instance_id)

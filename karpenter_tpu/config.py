@@ -97,7 +97,7 @@ def parse_duration(value: str) -> float:
     return float(text)
 
 
-def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: Optional[str] = None) -> None:
+def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: Optional[str] = None):
     """Subscribe the Config to the settings ConfigMap.
 
     Mirrors the reference watcher (config.go:84-170): a content hash
@@ -107,6 +107,9 @@ def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: Op
     watch time — i.e. CLI flags/env stay authoritative until the ConfigMap
     explicitly sets a key (three-tier config: flags < live ConfigMap);
     deleting the ConfigMap restores them.
+
+    Returns an unsubscribe callable: a stopped/crashed Runtime must detach
+    its watcher or the dead Config keeps re-leveling logs on every update.
     """
     from .logsetup import get_logger
 
@@ -166,3 +169,4 @@ def watch_config(kube, config: Config, name: str = CONFIGMAP_NAME, namespace: Op
         config.update(**updates)
 
     kube.watch("ConfigMap", on_event)
+    return lambda: kube.unwatch("ConfigMap", on_event)

@@ -130,6 +130,78 @@ class DisruptionController:
             "Nodes currently charged against their provisioner's disruption budget",
             ("provisioner",),
         )
+        self.recoveries = REGISTRY.counter(
+            "karpenter_disruption_recoveries_total",
+            "Crash-restart reconstruction actions, by what the recovered marker required",
+            ("action",),
+        )
+
+    # -- restart reconstruction ------------------------------------------------
+
+    def recover(self) -> dict:
+        """Rebuild crash-lost in-memory state from the durable node markers
+        (labels.py DISRUPTING/REPLACEMENT_FOR): the budget ledger is
+        re-charged for nodes mid-voluntary-drain, candidates stranded
+        cordoned-but-undeleted are released, and orphaned replacement
+        launches are reaped or adopted. Run ONCE at startup, before any
+        reconcile pass — so a restart mid-disruption neither exceeds budgets
+        nor strands capacity. Returns an action->nodes summary."""
+        summary = {"recharged": [], "released": [], "reaped": [], "adopted": []}
+        for node in list(self.kube.list_nodes()):
+            method = node.metadata.annotations.get(lbl.DISRUPTING_ANNOTATION)
+            provisioner_name = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, "")
+            if method:
+                if node.metadata.deletion_timestamp is not None:
+                    # mid-drain: the charge must survive the restart or a
+                    # fresh pass could exceed the budget while this drain is
+                    # still in flight (release happens, as always, when the
+                    # node object is gone)
+                    self.tracker.try_charge(provisioner_name, node.name, None)
+                    self.recoveries.inc(action="recharged")
+                    summary["recharged"].append(node.name)
+                else:
+                    # crashed between charge and delete: the command died
+                    # with the process. Release the node — clear the marker
+                    # and the cordon — and let the method re-propose it.
+                    del node.metadata.annotations[lbl.DISRUPTING_ANNOTATION]
+                    if node.spec.unschedulable and not any(
+                        t.key in (lbl.TAINT_INTERRUPTION, lbl.TAINT_NODE_UNSCHEDULABLE) for t in node.spec.taints
+                    ):
+                        node.spec.unschedulable = False
+                    self.kube.update(node)
+                    self.recoveries.inc(action="released")
+                    summary["released"].append(node.name)
+                continue
+            targets = node.metadata.annotations.get(lbl.REPLACEMENT_FOR_ANNOTATION)
+            if targets is None:
+                continue
+            candidates_alive = any(
+                (fresh := self.kube.get_node(name)) is not None and fresh.metadata.deletion_timestamp is None
+                for name in targets.split(",")
+                if name
+            )
+            initialized = node.metadata.labels.get(lbl.LABEL_NODE_INITIALIZED) == "true"
+            if candidates_alive and not initialized:
+                # its command is gone and its candidates are still whole: the
+                # re-proposed command will launch its own replacement — this
+                # one would leak as empty nominated capacity
+                self.kube.delete(node)
+                self.recoveries.inc(action="reaped")
+                summary["reaped"].append(node.name)
+            else:
+                # the drain finished (or the node is already real capacity):
+                # adopt it — clear the marker, keep it protected briefly
+                del node.metadata.annotations[lbl.REPLACEMENT_FOR_ANNOTATION]
+                self.kube.update(node)
+                self.cluster.nominate_node_for_pod(node.name)
+                self.recoveries.inc(action="adopted")
+                summary["adopted"].append(node.name)
+        if any(summary.values()):
+            log.info(
+                "disruption restart recovery: recharged=%s released=%s reaped=%s adopted=%s",
+                summary["recharged"], summary["released"], summary["reaped"], summary["adopted"],
+            )
+        return summary
 
     # -- the pass -------------------------------------------------------------
 
@@ -309,6 +381,9 @@ class DisruptionController:
             cmd.trace_span = cmd.trace_ctx = None
             self._block_on_budget(cmd)
             return
+        # the charge is durable from here: stamp the candidates so a restart
+        # can reconstruct the ledger (mid-drain) or release them (pre-drain)
+        self._stamp_disrupting(cmd)
         if cmd.replacements and not cmd.launched:
             if not self._launch_replacements(cmd):
                 return
@@ -316,6 +391,23 @@ class DisruptionController:
             self._pending_deadline = self.clock.now() + self.REPLACE_READY_TIMEOUT
             return
         self._disrupt(cmd)
+
+    def _stamp_disrupting(self, cmd: DisruptionCommand) -> None:
+        for stale in cmd.nodes:
+            node = self.kube.get_node(stale.name)
+            if node is not None and node.metadata.annotations.get(lbl.DISRUPTING_ANNOTATION) != cmd.method:
+                node.metadata.annotations[lbl.DISRUPTING_ANNOTATION] = cmd.method
+                self.kube.update(node)
+
+    def _clear_disrupting(self, cmd: DisruptionCommand) -> None:
+        """Unwind the durable marker when a command fails AFTER its charges
+        landed — the candidates survive, so the marker must not outlive the
+        charge (a restart would misread it as a stranded disruption)."""
+        for stale in cmd.nodes:
+            node = self.kube.get_node(stale.name)
+            if node is not None and lbl.DISRUPTING_ANNOTATION in node.metadata.annotations:
+                del node.metadata.annotations[lbl.DISRUPTING_ANNOTATION]
+                self.kube.update(node)
 
     def _validate(self, cmd: DisruptionCommand, pdb: PDBLimits) -> Optional[str]:
         """The just-before-execution re-validation: candidates still exist
@@ -371,6 +463,10 @@ class DisruptionController:
                     node = self.cloud_provider.create(
                         NodeRequest(template=vn.template, instance_type_options=vn.instance_type_options)
                     )
+                    # durable link to the candidates: a restarted controller
+                    # reaps this launch if they still exist (its command died
+                    # with the process) or adopts it if they are gone
+                    node.metadata.annotations[lbl.REPLACEMENT_FOR_ANNOTATION] = ",".join(cmd.node_names())
                     self.kube.create(node)
                     # protect the replacement from other methods while it warms
                     self.cluster.nominate_node_for_pod(node.name)
@@ -383,6 +479,7 @@ class DisruptionController:
                         self.kube.delete(ghost)
                 for name in cmd.node_names():
                     self.tracker.release(cmd.provisioner_name, name)
+                self._clear_disrupting(cmd)
                 self._finish(cmd, OUTCOME_LAUNCH_FAILED, f"replacement launch failed: {err}")
                 return False
             cmd.launched = launched
@@ -433,15 +530,24 @@ class DisruptionController:
 
     def _fail_replacement(self, cmd: DisruptionCommand, outcome: str, reason: str) -> None:
         # candidates were never cordoned (launch-before-cordon), so failure
-        # needs no unwind beyond releasing the budget charges
+        # needs no unwind beyond releasing the budget charges + their
+        # durable markers
         for name in cmd.node_names():
             self.tracker.release(cmd.provisioner_name, name)
+        self._clear_disrupting(cmd)
         log.warning("disruption %s of %s abandoned: %s", cmd.method, ", ".join(cmd.node_names()), reason)
         self._finish(cmd, outcome, reason)
 
     def _disrupt(self, cmd: DisruptionCommand) -> None:
         """Cordon + delete the candidates: the termination controller owns
         the drain from here (it is the sole drain executor)."""
+        # the replacements are real capacity now: drop their durable link so
+        # a later restart adopts them as ordinary nodes
+        for name in cmd.launched:
+            replacement = self.kube.get_node(name)
+            if replacement is not None and lbl.REPLACEMENT_FOR_ANNOTATION in replacement.metadata.annotations:
+                del replacement.metadata.annotations[lbl.REPLACEMENT_FOR_ANNOTATION]
+                self.kube.update(replacement)
         with TRACER.span("drain-handoff", parent=cmd.trace_ctx, nodes=",".join(cmd.node_names())):
             for stale in cmd.nodes:
                 node = self.kube.get_node(stale.name)

@@ -1,0 +1,3 @@
+from .controller import GarbageCollectionController
+
+__all__ = ["GarbageCollectionController"]

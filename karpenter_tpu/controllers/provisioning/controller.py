@@ -18,6 +18,11 @@ class ProvisioningReconciler:
         self.provisioner = provisioner
         kube.watch("Pod", self._on_pod_event)
 
+    def detach(self) -> None:
+        """Stop triggering the batcher: a stopped Runtime's reconciler must
+        not keep firing on the shared cluster's pod events."""
+        self.kube.unwatch("Pod", self._on_pod_event)
+
     def _on_pod_event(self, event: WatchEvent) -> None:
         if event.type == DELETED:
             return

@@ -111,6 +111,14 @@ class Cluster:
         kube.watch("Node", self._on_node_event)
         kube.watch("Pod", self._on_pod_event)
 
+    def detach(self) -> None:
+        """Deregister this cache's watch handlers. Watches dispatch
+        synchronously on the mutating thread, so a cache belonging to a
+        stopped/crashed Runtime would otherwise keep mirroring (and paying
+        for) every write for the life of the KubeCluster."""
+        self.kube.unwatch("Node", self._on_node_event)
+        self.kube.unwatch("Pod", self._on_pod_event)
+
     # -- event ingestion -----------------------------------------------------
 
     def _on_node_event(self, event: WatchEvent) -> None:
@@ -320,6 +328,14 @@ class Cluster:
                 return False
             if expiry < self.clock.now():
                 del self._nominated[node_name]
+                # expiry IS a consolidation-relevant state change: a node
+                # that was protected is now a candidate. Without the bump,
+                # a cluster that settles while its launches are still
+                # nominated evaluates consolidation exactly once (against
+                # the nomination wall), the epoch never moves again, and
+                # post-ramp capacity strands forever — the 4.5x diurnal
+                # cost-drift finding
+                self._bump_epoch()
                 return False
             return True
 
@@ -337,6 +353,30 @@ class Cluster:
 
     def last_node_creation_time(self) -> float:
         return self._last_node_creation
+
+    # -- restart reconstruction ---------------------------------------------------
+
+    def resync(self) -> int:
+        """Rebuild the mirror from a LIST of the API's current state — the
+        informer re-list a restarted controller performs after its watches
+        are established. Watch registration replays existing objects at
+        construction time; this re-list closes the remaining gap (writes
+        landing between that replay and the end of runtime assembly, and
+        handlers registered replay=False) so a successor process starts
+        from the API's truth, not a partial mirror. Idempotent: nodes/pods
+        already mirrored are refreshed in place. Returns objects ingested."""
+        count = 0
+        for node in self.kube.list_nodes():
+            with self._lock:
+                self._update_node(node)
+            count += 1
+        for pod in self.kube.list_pods():
+            if podutils.is_terminal(pod):
+                continue
+            with self._lock:
+                self._update_pod(pod)
+            count += 1
+        return count
 
     # -- consistency guard --------------------------------------------------------
 

@@ -103,6 +103,7 @@ class HttpKubeClient:
         # same default as KubeCluster: consumers dereference kube.clock.now()
         self.clock = clock or Clock()
         self._watch_threads: List[threading.Thread] = []
+        self._watch_cancels: List[tuple] = []  # (kind, handler, cancel Event)
         self._stop = threading.Event()
         self._local = threading.local()  # per-thread persistent connection
 
@@ -261,17 +262,28 @@ class HttpKubeClient:
     # -- watches (ListAndWatch informer) -------------------------------------
 
     def watch(self, kind: str, handler: Callable[[WatchEvent], None], replay: bool = True) -> None:
+        cancel = threading.Event()
         thread = threading.Thread(
-            target=self._watch_loop, args=(kind, handler, replay), daemon=True, name=f"watch-{kind.lower()}"
+            target=self._watch_loop, args=(kind, handler, replay, cancel), daemon=True, name=f"watch-{kind.lower()}"
         )
         self._watch_threads.append(thread)
+        self._watch_cancels.append((kind, handler, cancel))
         thread.start()
 
-    def _watch_loop(self, kind: str, handler: Callable[[WatchEvent], None], replay: bool) -> None:
+    def unwatch(self, kind: str, handler: Callable[[WatchEvent], None]) -> None:
+        """Cancel the watch registered for (kind, handler): the informer
+        loop exits at its next reconnect/poll boundary. The KubeCluster
+        parity seam a stopped/crashed Runtime uses to detach its caches."""
+        for entry in list(self._watch_cancels):
+            if entry[0] == kind and entry[1] is handler:
+                entry[2].set()
+                self._watch_cancels.remove(entry)
+
+    def _watch_loop(self, kind: str, handler: Callable[[WatchEvent], None], replay: bool, cancel=None) -> None:
         known: Dict[str, object] = {}  # uid -> last object delivered to the handler
         rv = 0
         first = True
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not (cancel is not None and cancel.is_set()):
             try:
                 if first or rv == 0:
                     # list to (re)sync, then stream from the list version
@@ -293,14 +305,14 @@ class HttpKubeClient:
                                 handler(WatchEvent(DELETED, o))
                     known = current
                     first = False
-                rv = self._stream(kind, rv, handler, known)
+                rv = self._stream(kind, rv, handler, known, cancel)
             except Exception as exc:  # noqa: BLE001 - reconnect like an informer
-                if self._stop.is_set():
+                if self._stop.is_set() or (cancel is not None and cancel.is_set()):
                     return
                 log.debug("watch %s: reconnecting after %s", kind, exc)
                 time.sleep(0.05)
 
-    def _stream(self, kind: str, rv: int, handler: Callable[[WatchEvent], None], known: Dict[str, object]) -> int:
+    def _stream(self, kind: str, rv: int, handler: Callable[[WatchEvent], None], known: Dict[str, object], cancel=None) -> int:
         conn = self._new_connection(timeout=300)
         try:
             conn.request("GET", rest_path(kind) + f"?watch=true&resourceVersion={rv}", headers=self._auth_headers())
@@ -309,7 +321,7 @@ class HttpKubeClient:
                 return 0  # journal compacted: relist
             if resp.status >= 400:
                 raise ApiStatusError(resp.status, {})
-            while not self._stop.is_set():
+            while not self._stop.is_set() and not (cancel is not None and cancel.is_set()):
                 line = resp.readline()
                 if not line:
                     return rv  # server closed: reconnect from rv

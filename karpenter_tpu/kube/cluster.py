@@ -166,6 +166,19 @@ class KubeCluster:
         for obj in existing:
             handler(WatchEvent(ADDED, obj))
 
+    def unwatch(self, kind: str, handler: Callable[[WatchEvent], None]) -> None:
+        """Deregister a watch handler. Dispatch is synchronous on the
+        mutating thread, so a handler that outlives its owner (a stopped or
+        crashed Runtime's state cache) would keep executing on every write
+        forever — restartable components must detach what they attach."""
+        with self._lock:
+            handlers = self._watchers.get(kind)
+            if handlers is not None:
+                try:
+                    handlers.remove(handler)
+                except ValueError:
+                    pass
+
     def _dispatch(self, kind: str, event: WatchEvent) -> None:
         for handler in list(self._watchers.get(kind, [])):
             handler(event)

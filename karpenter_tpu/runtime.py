@@ -82,8 +82,10 @@ class Runtime:
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree
         self.config.on_change(lambda cfg: set_level(cfg.log_level))
-        # live settings from the karpenter-global-settings ConfigMap
-        watch_config(self.kube, self.config)
+        # live settings from the karpenter-global-settings ConfigMap; keep
+        # the unsubscriber — watches dispatch synchronously on the shared
+        # cluster, so a stopped/crashed Runtime must detach what it attached
+        self._config_unwatch = watch_config(self.kube, self.config)
         self.recorder = DedupeRecorder(Recorder(), clock=self.kube.clock)
         self.cloud_provider = decorate(self.cloud_provider)
         webhooks.register(self.kube, self.cloud_provider)
@@ -117,6 +119,15 @@ class Runtime:
         )
         self.termination = TerminationController(self.kube, self.cloud_provider, self.recorder, clock=self.kube.clock)
         self.counter = CounterController(self.kube, self.cluster)
+        # the crash-consistency sweep (controllers/gc): cloud instances vs
+        # node objects, both directions, at startup and on an interval — the
+        # reconciliation that makes a restart-without-leaking possible
+        from .controllers.gc import GarbageCollectionController
+
+        self.gc = GarbageCollectionController(
+            self.kube, self.cluster, self.cloud_provider, termination=self.termination,
+            clock=self.kube.clock, registration_grace=self.options.gc_registration_grace,
+        )
         self.consolidation = ConsolidationController(
             self.kube, self.cluster, self.cloud_provider, self.provisioner, self.recorder, clock=self.kube.clock
         )
@@ -169,6 +180,12 @@ class Runtime:
         if self.options.enable_slo:
             SLO.enable()
             SLO.attach(self.kube)
+        # restart state reconstruction, phase 1: re-list the API into the
+        # state cache (the informer re-list) — closes the gap between the
+        # watch-registration replay at Cluster construction and the end of
+        # runtime assembly, so a successor process starts from the API's
+        # truth
+        self.cluster.resync()
         import socket
         import uuid
 
@@ -227,8 +244,18 @@ class Runtime:
             self.cloud_provider.name(), self.dense_solver is not None,
             self.config.batch_idle_duration, self.config.batch_max_duration,
         )
+        # restart reconstruction, phases 2+3, leader-only (followers hold no
+        # ledger and must not race the leader's sweep): rebuild the
+        # disruption ledger / reap-or-adopt from durable markers, then run
+        # the startup GC sweep so crash leftovers reconcile BEFORE the
+        # control loops resume
+        if self.disruption is not None:
+            self._pass("disruption-recovery", self.disruption.recover)
+        self._pass("gc", self.gc.reconcile)
         self.provisioner.start()
         self._spawn(self._lifecycle_loop, "node-lifecycle")
+        if self.options.gc_interval > 0:
+            self._spawn(self._gc_loop, "gc")
         if self.disruption is not None:
             # the orchestrator loop REPLACES the consolidation loop: the
             # consolidation controller still evaluates, but as a candidate
@@ -254,6 +281,41 @@ class Runtime:
         for thread in self._threads:
             thread.join(timeout=5)
         self.elector.stop(release=True)
+        self._detach_watchers()
+
+    def crash(self) -> None:
+        """Simulated process death: every loop halts with NO graceful
+        cleanup — in-memory state (the budget ledger, the command queue, the
+        interruption dedupe memory, nominations) is simply gone, exactly
+        what a kill -9 leaves behind. The lease is NOT released (a real
+        crash can't); a successor waits out the lease or, in the
+        leader_elect=False harnesses, starts immediately. Recovery is the
+        next Runtime's startup reconstruction, not this method.
+
+        Watch handlers ARE detached: in a real crash the process (and its
+        in-memory subscriptions) dies with it — leaving them registered on
+        the shared in-memory cluster would be a dead process still
+        executing, not a crash."""
+        self._stop.set()
+        self.provisioner.stop()
+        if self.provisioner.remote_solver is not None:
+            self.provisioner.remote_solver.close()
+        for thread in self._threads:
+            thread.join(timeout=5)
+        self.elector.stop(release=False)
+        self._detach_watchers()
+
+    def _detach_watchers(self) -> None:
+        """Deregister every watch handler this Runtime's components attached
+        to the shared KubeCluster. Dispatch is synchronous on the mutating
+        thread, so handlers surviving their Runtime would keep mirroring —
+        and charging every kube write for — a dead control plane, growing
+        linearly with each crash/restart cycle."""
+        self.cluster.detach()
+        self.reconciler.detach()
+        if self._config_unwatch is not None:
+            self._config_unwatch()
+            self._config_unwatch = None
 
     def _spawn(self, target, name: str) -> None:
         thread = threading.Thread(target=target, name=name, daemon=True)
@@ -276,6 +338,10 @@ class Runtime:
 
         while not self._stop.wait(timeout=DisruptionController.POLL_INTERVAL):
             self._pass("disruption", self.disruption.reconcile)
+
+    def _gc_loop(self) -> None:
+        while not self._stop.wait(timeout=self.options.gc_interval):
+            self._pass("gc", self.gc.reconcile)
 
     def _metrics_loop(self) -> None:
         while not self._stop.wait(timeout=5.0):
@@ -324,6 +390,7 @@ class Runtime:
         self._pass("node", self.node_controller.reconcile_all)
         self._pass("termination", self.termination.reconcile_all)
         self._pass("counter", self.counter.reconcile_all)
+        self._pass("gc", self.gc.reconcile)
         if self.disruption is not None:
             self._pass("disruption", self.disruption.reconcile)
         elif self.consolidation.should_run():

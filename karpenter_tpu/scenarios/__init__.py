@@ -12,6 +12,7 @@ from .primitives import (
     DiurnalRamp,
     DriftRollout,
     Primitive,
+    ProcessCrash,
     ScaleTo,
     Scenario,
     ScenarioContext,
@@ -30,6 +31,7 @@ __all__ = [
     "DiurnalRamp",
     "DriftRollout",
     "Primitive",
+    "ProcessCrash",
     "ScaleTo",
     "Scenario",
     "ScenarioContext",

@@ -32,7 +32,7 @@ from typing import Dict, List, Optional
 from .. import slo
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
-from ..api.provisioner import Budget, Disruption, Provisioner, ProvisionerSpec
+from ..api.provisioner import Budget, Consolidation, Disruption, Provisioner, ProvisionerSpec
 from ..cloudprovider.simulated.backend import CloudBackend
 from ..cloudprovider.simulated.provider import SimulatedCloudProvider
 from ..controllers.disruption.budgets import allowed_disruptions
@@ -41,7 +41,7 @@ from ..logsetup import get_logger
 from ..provenance import provenance_block
 from ..runtime import Runtime
 from ..utils.options import Options
-from .primitives import Burst, DiurnalRamp, DriftRollout, Scenario, ScenarioContext, SpotReclaimWave, TransportChaos
+from .primitives import Burst, DiurnalRamp, DriftRollout, ProcessCrash, Scenario, ScenarioContext, SpotReclaimWave, TransportChaos
 from .schema import scenario_doc_errors
 from .standin import WorkloadStandIn, live_pods
 
@@ -69,7 +69,9 @@ def _provisioner(scenario: Scenario) -> Provisioner:
         metadata=ObjectMeta(name="default", namespace=""),
         spec=ProvisionerSpec(
             requirements=requirements,
-            ttl_seconds_after_empty=scenario.ttl_seconds_after_empty,
+            # admission rejects consolidation + ttlSecondsAfterEmpty together
+            ttl_seconds_after_empty=None if scenario.consolidation else scenario.ttl_seconds_after_empty,
+            consolidation=Consolidation(enabled=True) if scenario.consolidation else None,
             disruption=disruption,
         ),
     )
@@ -94,6 +96,31 @@ def drift_settled(ctx: ScenarioContext) -> bool:
     return disruption is None or disruption.tracker.total_in_flight() == 0
 
 
+def _leaked_instances(ctx: ScenarioContext) -> int:
+    """Cloud instances minus registered capacity: anything running at the
+    cloud that no node object points at is paid-for capacity the cluster
+    cannot use — the crash-between-launch-and-bind failure shape the GC
+    sweep exists to reconcile away."""
+    registered = {
+        node.spec.provider_id.rsplit("/", 1)[-1] for node in ctx.kube.list_nodes() if node.spec.provider_id
+    }
+    return sum(1 for instance_id in list(ctx.backend.instances) if instance_id not in registered)
+
+
+def consolidated_settled(ctx: ScenarioContext) -> bool:
+    """The consolidation-on diurnal's convergence bar: the disruption ledger
+    has drained AND an explicit drift re-solve prices the surviving fleet
+    within 1.5x of the ideal fresh repack — ramp-down capacity was actually
+    consolidated away, not merely left stranded (the PR 6 finding scored
+    4.5x here with consolidation off)."""
+    disruption = ctx.runtime.disruption
+    if disruption is not None and disruption.tracker.total_in_flight() > 0:
+        return False
+    ctx.runtime.slo_metrics.scrape()
+    ratio = ctx.runtime.slo_metrics.compute_drift()
+    return ratio is not None and ratio <= 1.5
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -115,6 +142,8 @@ def _converged(ctx: ScenarioContext, scenario: Scenario) -> bool:
     for node in ctx.kube.list_nodes():
         if not ctx.backend.instance_exists(node.spec.provider_id.split("///", 1)[-1]):
             return False  # a node object survives its dead instance
+    if _leaked_instances(ctx):
+        return False  # an instance survives with no node pointing at it
     if _lost_pods(ctx):
         return False
     if ctx.backend.notifications.depth() != 0:
@@ -152,22 +181,35 @@ class CampaignRunner:
             service = CloudAPIService(backend=backend).start()
             cloud = CloudAPIClient(service.url)
         provider = SimulatedCloudProvider(backend=cloud, kube=kube, clock=kube.clock)
-        runtime = Runtime(
-            kube=kube,
-            cloud_provider=provider,
-            options=Options(
-                leader_elect=False,
-                dense_solver_enabled=False,
-                batch_max_duration=0.3,
-                batch_idle_duration=0.05,
-                interruption_queue="interruptions",
-                interruption_poll_interval=0.2,
-                enable_slo=True,
-            ),
-        )
+
+        def runtime_factory() -> Runtime:
+            # each (re)boot is a FRESH control plane over the same cluster +
+            # cloud: new state cache, new ledger, new loops — recovery is the
+            # startup reconstruction, never shared memory. gc runs on a tight
+            # interval with a short registration grace so crash leftovers
+            # reconcile within the scenario's convergence window
+            return Runtime(
+                kube=kube,
+                cloud_provider=provider,
+                options=Options(
+                    leader_elect=False,
+                    dense_solver_enabled=False,
+                    batch_max_duration=0.3,
+                    batch_idle_duration=0.05,
+                    interruption_queue="interruptions",
+                    interruption_poll_interval=0.2,
+                    enable_slo=True,
+                    gc_interval=1.0,
+                    gc_registration_grace=3.0,
+                ),
+            )
+
+        runtime = runtime_factory()
         provisioner = _provisioner(scenario)
         kube.create(provisioner)
-        ctx = ScenarioContext(kube, backend, runtime, service=service, pod_cpu=scenario.pod_cpu)
+        ctx = ScenarioContext(
+            kube, backend, runtime, service=service, pod_cpu=scenario.pod_cpu, runtime_factory=runtime_factory
+        )
         stand_in = WorkloadStandIn(ctx)
         reclaim_thread = threading.Thread(
             target=self._reclaimer, args=(ctx,), name="cloud-reclaimer", daemon=True
@@ -203,8 +245,10 @@ class CampaignRunner:
                     break
                 time.sleep(self.sample_period)
             # final accounting: fresh cost gauges + an explicit drift solve
-            runtime.slo_metrics.scrape()
-            runtime.slo_metrics.compute_drift()
+            # (through ctx.runtime — a crash scenario's live control plane is
+            # the latest successor, not the Runtime this frame started with)
+            ctx.runtime.slo_metrics.scrape()
+            ctx.runtime.slo_metrics.compute_drift()
             violations += self._sample(ctx, provisioner, samples, start)
             snapshot = slo.SLO.snapshot()
             pods = live_pods(kube)
@@ -219,19 +263,21 @@ class CampaignRunner:
                     "ideal_cost_per_hour": snapshot["cost"]["ideal_cost_per_hour"],
                     "cost_drift_ratio": snapshot["cost"]["cost_drift_ratio"],
                     "lost_pods": _lost_pods(ctx),
+                    "leaked_instances": _leaked_instances(ctx),
                     "budget_violations": violations,
                     "pods_desired": ctx.desired,
                     "pods_bound": sum(1 for p in pods if p.spec.node_name),
                     "nodes_churned": snapshot["churn"]["nodes_churned"],
                     "pods_displaced": snapshot["churn"]["pods_displaced"],
+                    "restarts": ctx.restarts,
                 },
                 "samples": samples,
             }
             log.info(
-                "[%s/%s] converged=%s pods=%d/%d lost=%d drift=%.3f violations=%d in %.1fs",
+                "[%s/%s] converged=%s pods=%d/%d lost=%d leaked=%d drift=%.3f violations=%d restarts=%d in %.1fs",
                 scenario.name, transport, converged, run["scores"]["pods_bound"], ctx.desired,
-                run["scores"]["lost_pods"], run["scores"]["cost_drift_ratio"], violations,
-                run["duration_seconds"],
+                run["scores"]["lost_pods"], run["scores"]["leaked_instances"], run["scores"]["cost_drift_ratio"],
+                violations, ctx.restarts, run["duration_seconds"],
             )
             return run
         finally:
@@ -242,7 +288,7 @@ class CampaignRunner:
             for thread in (stand_in, reclaim_thread):
                 if thread.ident is not None:
                     thread.join(timeout=3)
-            runtime.stop()
+            ctx.runtime.stop()  # the latest successor, if a crash primitive rotated it
             if service is not None:
                 service.stop()
             # the Runtime enabled the process-wide accountant; a finished
@@ -266,22 +312,28 @@ class CampaignRunner:
             ctx.backend.reclaim_due_instances()
 
     def _sample(self, ctx: ScenarioContext, provisioner, samples: List[dict], start: float) -> int:
-        """Append one timeline sample; returns 1 when the voluntary
-        disruption ledger exceeds the provisioner's active budget (the
-        budget-violation score), else 0."""
+        """Append one timeline sample; returns 1 when voluntary disruption
+        exceeds the provisioner's active budget (the budget-violation
+        score), else 0. The check is TWO-WITNESS: the in-memory ledger AND
+        an independent scan of the API for nodes carrying the durable
+        karpenter.sh/disrupting marker mid-drain — so a restart that lost
+        the ledger (or rebuilt it wrong) cannot hide an over-budget drain."""
         in_flight = 0
         if ctx.runtime.disruption is not None:
             in_flight = ctx.runtime.disruption.tracker.total_in_flight()
-        owned = sum(
-            1 for n in ctx.kube.list_nodes() if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner.name
+        nodes = ctx.kube.list_nodes()
+        scanned = sum(
+            1 for n in nodes
+            if lbl.DISRUPTING_ANNOTATION in n.metadata.annotations and n.metadata.deletion_timestamp is not None
         )
+        owned = sum(1 for n in nodes if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner.name)
         limit = allowed_disruptions(provisioner, owned, ctx.kube.clock.now())
-        violated = limit is not None and in_flight > limit
+        violated = limit is not None and max(in_flight, scanned) > limit
         samples.append(
             {
                 "t": round(time.monotonic() - start, 3),
                 "pending_pods": len(ctx.kube.pending_pods()),
-                "nodes": len(ctx.kube.list_nodes()),
+                "nodes": len(nodes),
                 "cost_per_hour": round(slo.CLUSTER_COST.value(), 6),
                 "disrupting": in_flight,
             }
@@ -350,6 +402,41 @@ def default_campaign() -> List[Scenario]:
             settled=drift_settled,
             primitives=[Burst(offset=2.0, count=8), DriftRollout(offset=4.0)],
             description="provisioner label rollout mid-burst: every node drifts, replaced under a 40% budget",
+        ),
+        Scenario(
+            name="diurnal_ramp_consolidated",
+            desired=0,
+            duration=10.0,
+            consolidation=True,
+            ttl_seconds_after_empty=None,  # mutually exclusive with consolidation
+            instance_types=["general-4x8"],  # several small nodes: consolidation has bins to merge
+            settled=consolidated_settled,
+            primitives=[DiurnalRamp(offset=0.5, base=6, peak=22, period=8.0, cycles=1)],
+            description=(
+                "the PR 6 diurnal finding, closed: same half-cosine day with consolidation enabled — "
+                "post-ramp stranded capacity is consolidated away until cost drift is pinned <= 1.5x"
+            ),
+        ),
+        Scenario(
+            name="crash_storm",
+            desired=16,
+            duration=12.0,
+            budget_nodes="40%",
+            instance_types=["general-4x8"],
+            settled=drift_settled,
+            primitives=[
+                Burst(offset=0.3, count=10),
+                ProcessCrash(offset=0.9),  # mid-provision: the burst is still launching
+                SpotReclaimWave(offset=3.0, fraction=0.5, warning_seconds=1.5),
+                DriftRollout(offset=4.5),
+                ProcessCrash(offset=5.5),  # mid-disruption: the rollout is mid-replacement
+                ProcessCrash(offset=8.0, times=1),
+            ],
+            description=(
+                "burst + reclaim wave + drift rollout with the control plane kill -9'd three times "
+                "mid-provision/mid-disruption: startup reconstruction + the GC sweep must converge to "
+                "zero leaked instances, zero lost pods, budgets intact"
+            ),
         ),
         Scenario(
             name="throttled_control_plane",

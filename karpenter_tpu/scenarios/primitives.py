@@ -31,15 +31,36 @@ log = get_logger("scenarios")
 class ScenarioContext:
     """Everything a primitive may touch while a scenario runs."""
 
-    def __init__(self, kube, backend, runtime, service=None, pod_cpu: float = 0.5):
+    def __init__(self, kube, backend, runtime, service=None, pod_cpu: float = 0.5, runtime_factory=None):
         self.kube = kube
         self.backend = backend  # the in-process CloudBackend (faults/reclaims)
         self.runtime = runtime
         self.service = service  # CloudAPIService on the http transport, else None
         self.pod_cpu = pod_cpu
+        # crash/restart seam: builds a FRESH (un-started) Runtime over the
+        # same kube + cloud — what the ProcessCrash primitive restarts into
+        self.runtime_factory = runtime_factory
+        self.restarts = 0
         self.stop = threading.Event()
         self._lock = threading.Lock()
         self._desired = 0
+
+    def crash_runtime(self) -> None:
+        """Kill the live control plane and boot a successor: the old
+        Runtime's threads halt with no graceful cleanup (its ledger, command
+        queue, and dedupe memory die with it), then a new Runtime runs its
+        startup reconstruction — resync, ledger recovery, GC sweep — against
+        whatever the crash left behind."""
+        if self.runtime_factory is None:
+            raise RuntimeError("scenario context has no runtime_factory; crash/restart unavailable")
+        old = self.runtime
+        old.crash()
+        successor = self.runtime_factory()
+        self.runtime = successor
+        successor.start()
+        with self._lock:
+            self.restarts += 1
+        log.info("process crash #%d: control plane restarted", self.restarts)
 
     @property
     def desired(self) -> int:
@@ -195,6 +216,26 @@ class TransportChaos(Primitive):
 
 
 @dataclass
+class ProcessCrash(Primitive):
+    """Kill -9 the control plane `times` times, `interval` seconds apart,
+    starting at `offset` — timed by the composer to land mid-provision or
+    mid-disruption. Each crash tears down the live Runtime with no graceful
+    cleanup and boots a successor through its startup reconstruction
+    (cluster resync, disruption-ledger recovery, GC sweep). Everything the
+    scenario scores — zero leaked instances, zero lost pods, budget
+    invariants — must hold ACROSS the restarts, which is the whole point."""
+
+    times: int = 1
+    interval: float = 2.0
+
+    def run(self, ctx: ScenarioContext) -> None:
+        for i in range(self.times):
+            if i and ctx.sleep(self.interval):
+                return
+            ctx.crash_runtime()
+
+
+@dataclass
 class Scenario:
     """A named composition of primitives on one timeline."""
 
@@ -209,6 +250,10 @@ class Scenario:
     # meaningful (22 pods on one 96-cpu node give a 30% budget of zero)
     instance_types: Optional[List[str]] = None
     ttl_seconds_after_empty: Optional[float] = 2.0
+    # spec.consolidation.enabled on the provisioner (mutually exclusive with
+    # ttlSecondsAfterEmpty — set that to None when enabling this): the
+    # consolidation-on diurnal variant pins the post-ramp cost drift
+    consolidation: bool = False
     # extra convergence condition beyond "every pod bound to live capacity"
     # (e.g. the drift scenario waits until no node carries a stale spec
     # hash); not part of the config hash — predicates describe WHEN the run
@@ -227,5 +272,6 @@ class Scenario:
             "budget_nodes": self.budget_nodes,
             "instance_types": self.instance_types,
             "ttl_seconds_after_empty": self.ttl_seconds_after_empty,
+            "consolidation": self.consolidation,
             "primitives": [p.config() for p in self.primitives],
         }

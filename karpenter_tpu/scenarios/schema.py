@@ -22,10 +22,12 @@ SCORE_KEYS = (
     "ideal_cost_per_hour",
     "cost_drift_ratio",
     "lost_pods",
+    "leaked_instances",
     "budget_violations",
     "pods_desired",
     "pods_bound",
     "nodes_churned",
+    "restarts",
 )
 QUANTILE_KEYS = ("p50", "p95", "p99", "count")
 SAMPLE_KEYS = ("t", "pending_pods", "nodes", "cost_per_hour", "disrupting")
@@ -59,7 +61,7 @@ def run_errors(run, where: str = "run") -> List[str]:
         for key in SCORE_KEYS:
             if key not in scores:
                 errs.append(f"{where}.scores missing key {key!r}")
-        for field in ("lost_pods", "budget_violations"):
+        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts"):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
                 errs.append(f"{where}.scores.{field} must be an int, got {type(value).__name__}")

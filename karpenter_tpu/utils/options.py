@@ -68,6 +68,14 @@ class Options:
     # simulation backend; set (or KUBERNETES_APISERVER_URL) = the real-protocol
     # HTTP client (kube/client.py) with the QPS/burst budget above
     apiserver_url: str = ""
+    # period of the GC reconciliation sweep (controllers/gc): cloud instances
+    # vs node objects, both directions; the first sweep runs at startup so a
+    # restarted controller reconciles crash leftovers before provisioning
+    # resumes. <= 0 disables the loop (the startup sweep still runs)
+    gc_interval: float = 15.0
+    # how long a launched instance may exist unregistered before the sweep
+    # treats it as an orphan (the legitimate launch->register window)
+    gc_registration_grace: float = 30.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -83,6 +91,8 @@ class Options:
             errs.append("pricing refresh period must be positive")
         if self.interruption_poll_interval <= 0:
             errs.append("interruption poll interval must be positive")
+        if self.gc_registration_grace < 0:
+            errs.append("gc registration grace must be non-negative")
         if self.trace_ring_size <= 0:
             errs.append("trace ring size must be positive")
         from ..logsetup import is_valid_level
@@ -129,6 +139,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--interruption-poll-interval", type=float, default=_env("INTERRUPTION_POLL_INTERVAL", defaults.interruption_poll_interval))
     parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
+    parser.add_argument("--gc-interval", type=float, default=_env("GC_INTERVAL", defaults.gc_interval))
+    parser.add_argument("--gc-registration-grace", type=float, default=_env("GC_REGISTRATION_GRACE", defaults.gc_registration_grace))
     namespace = parser.parse_args(argv)
     options = Options(**vars(namespace))
     errs = options.validate()

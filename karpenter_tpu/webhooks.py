@@ -44,15 +44,25 @@ def register(kube: KubeCluster, cloud_provider=None) -> None:
     validation (core rule set + provider hooks); every other kind is offered
     to the provider's validate_object hook (how provider-owned CRs like the
     simulated NodeClass — the AWSNodeTemplate analog — get admission, same
-    seam as the reference's AWSNodeTemplate webhook)."""
+    seam as the reference's AWSNodeTemplate webhook).
+
+    Idempotent per cluster: a second register (a restarted Runtime over the
+    same KubeCluster) swaps the provider in place instead of stacking
+    another wrapper around the already-wrapped verbs."""
+    if getattr(kube, "_admission_registered", False):
+        kube._admission_provider = cloud_provider
+        return
+    kube._admission_registered = True
+    kube._admission_provider = cloud_provider
     original_create, original_update = kube.create, kube.update
 
     def _admit(obj):
+        provider = kube._admission_provider
         if isinstance(obj, Provisioner):
-            default_provisioner(obj, cloud_provider)
-            validate_or_raise(obj, cloud_provider)
+            default_provisioner(obj, provider)
+            validate_or_raise(obj, provider)
             return
-        hook = getattr(cloud_provider, "validate_object", None)
+        hook = getattr(provider, "validate_object", None)
         if hook is not None:
             errs = hook(obj) or ()
             if errs:

@@ -142,14 +142,38 @@ class TestTypedErrorTaxonomy:
 
 class TestIdempotentCreateFleet:
     def test_dropped_response_retry_launches_exactly_once(self, service, backend, client):
-        """Mid-CreateFleet connection loss: the service processes the launch
-        but the response never arrives; the client's retry replays the same
-        idempotency token and must receive the ORIGINAL instance."""
-        service.drop_next(1)
+        """Mid-CreateFleet connection loss (drop_response_next: the request
+        is PROCESSED, the response bytes never arrive — the path fail_next's
+        reject-before-processing cannot exercise): the client's retry replays
+        the same idempotency token and must receive the ORIGINAL instance."""
+        service.drop_response_next(1)
         instance = client.create_fleet(_fleet_request(backend))
         assert client.retries >= 1
         assert len(backend.instances) == 1, "a lost response must never double-launch"
         assert instance.instance_id in backend.instances
+
+    def test_client_token_rides_the_fleet_request(self, service, backend, client):
+        """An application-level token (the fleet batcher's per-launch token)
+        is forwarded verbatim, so a HIGHER-level retry — a new HTTP call, not
+        just a transport retry — still dedupes at the backend."""
+        request = _fleet_request(backend)
+        request.client_token = "tok-app-level"
+        first = client.create_fleet(request)
+        second = client.create_fleet(request)  # a fresh call, same token
+        assert first.instance_id == second.instance_id
+        assert len(backend.instances) == 1
+
+    def test_request_deadline_bounds_the_retry_budget(self, service, backend, clock):
+        """A persistently failing endpoint must surface a typed error within
+        the per-request deadline, not grind through the full attempt budget:
+        backoff sleeps advance the (fake) clock past the deadline and the
+        next retry refuses to run."""
+        c = CloudAPIClient(service.url, clock=clock, max_attempts=100, request_deadline=0.2)
+        service.fail_next(100)
+        with pytest.raises(CloudAPIError) as err:
+            c.describe_subnets()
+        assert err.value.code == "deadline_exceeded"
+        assert c.retries < 99, "the deadline, not the attempt cap, must stop the retry loop"
 
     def test_concurrent_same_token_launches_once(self, service, backend, client):
         """A retry racing the still-executing original (the server stalled
@@ -211,6 +235,65 @@ class TestIdempotentCreateFleet:
         b = client.create_fleet(_fleet_request(backend))
         assert a.instance_id != b.instance_id
         assert len(backend.instances) == 2
+
+
+class TestInProcessIdempotency:
+    """The same ClientToken contract WITHOUT the HTTP hop: dedup lives in
+    the backend, so the in-process transport (and anything above it, like
+    the fleet batcher) shares it."""
+
+    def test_backend_replays_settled_token(self, backend):
+        request = _fleet_request(backend)
+        request.client_token = "tok-1"
+        first = backend.create_fleet(request)
+        second = backend.create_fleet(request)
+        assert first is second
+        assert len(backend.instances) == 1
+
+    def test_tokenless_requests_never_dedupe(self, backend):
+        a = backend.create_fleet(_fleet_request(backend))
+        b = backend.create_fleet(_fleet_request(backend))
+        assert a.instance_id != b.instance_id
+
+    def test_backend_drop_response_executes_then_raises(self, backend):
+        from karpenter_tpu.cloudprovider.simulated.backend import ResponseLostError
+
+        request = _fleet_request(backend)
+        request.client_token = "tok-lost"
+        backend.drop_response_next(1)
+        with pytest.raises(ResponseLostError):
+            backend.create_fleet(request)
+        assert len(backend.instances) == 1, "the operation executed; only the response was lost"
+        # the retry with the same token replays the settled launch
+        replay = backend.create_fleet(request)
+        assert len(backend.instances) == 1
+        assert replay.instance_id in backend.instances
+
+    def test_fleet_batcher_retries_lost_response_with_same_token(self, backend):
+        """The batcher's own retry loop: a lost response mid-call replays
+        the per-waiter token, so the caller gets the one instance that
+        actually launched — exactly once, no leak, no double-launch."""
+        from karpenter_tpu.cloudprovider.simulated.fleet import CreateFleetBatcher
+
+        batcher = CreateFleetBatcher(backend, window=0.0)
+        backend.drop_response_next(1)
+        instance = batcher.create_fleet(_fleet_request(backend))
+        assert len(backend.instances) == 1
+        assert instance.instance_id in backend.instances
+
+    def test_provider_create_survives_lost_response(self, backend, clock):
+        """End to end through the provider: a lost CreateFleet response
+        mid-provision yields exactly one instance and one node."""
+        kube = KubeCluster()
+        provider = SimulatedCloudProvider(backend=backend, kube=kube, clock=clock)
+        provisioner = make_provisioner()
+        kube.create(provisioner)
+        template = NodeTemplate.from_provisioner(provisioner)
+        options = provider.get_instance_types(provisioner)[:3]
+        backend.drop_response_next(1)
+        node = provider.create(NodeRequest(template=template, instance_type_options=options))
+        assert len(backend.instances) == 1
+        assert node.spec.provider_id.split("///", 1)[1] in backend.instances
 
 
 class TestProviderOverSockets:

@@ -1,0 +1,238 @@
+"""Crash-recovery smoke (tier-1): hard-stop mid-provision, restart, reconcile.
+
+The fast-tier shape of the crash-storm acceptance (the full storm stays in
+the slow tier, tests/test_crash_storm.py): a LIVE Runtime provisions real
+capacity, an instance leaks mid-provision (launched at the cloud, the
+process dies before the node object registers) and another node goes ghost
+(its instance terminated out-of-band), the control plane is hard-stopped
+with Runtime.crash() — no graceful cleanup — and a successor Runtime boots
+over the same cluster + cloud. Startup reconstruction (cluster resync,
+disruption-ledger recovery, the startup GC sweep + interval loop) must
+converge to zero leaked instances and zero ghost nodes without touching the
+healthy node or its pod, on BOTH transports.
+
+The deterministic recover() unit tests below pin the ledger/marker
+reconstruction outcomes pass-free (no threads, no sleeps).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeCondition, NodeSelectorRequirement, OP_IN, OwnerReference
+from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend, FleetInstanceSpec, FleetRequest
+from karpenter_tpu.cloudprovider.simulated.provider import SimulatedCloudProvider
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import LeaderElector, Runtime
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_node, make_pod, make_provisioner
+
+
+def _requirements():
+    return [NodeSelectorRequirement(key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=["spot", "on-demand"])]
+
+
+def _rs_pod():
+    pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+    pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+    return pod
+
+
+def _leak_instance(backend: CloudBackend) -> str:
+    template = backend.ensure_launch_template("crash-leak", "img", [], "")
+    return backend.create_fleet(
+        FleetRequest(
+            specs=[
+                FleetInstanceSpec(
+                    instance_type=backend.catalog[0].name,
+                    zone="zone-a",
+                    capacity_type="on-demand",
+                    launch_template_id=template.template_id,
+                )
+            ],
+            capacity_type="on-demand",
+        )
+    ).instance_id
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "http"])
+def test_crash_restart_reconciles_leak_and_ghost(transport):
+    kube = KubeCluster()
+    backend = CloudBackend(clock=kube.clock)
+    service = None
+    cloud = backend
+    if transport == "http":
+        from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+        service = CloudAPIService(backend=backend).start()
+        cloud = CloudAPIClient(service.url)
+    provider = SimulatedCloudProvider(backend=cloud, kube=kube, clock=kube.clock)
+
+    def factory() -> Runtime:
+        return Runtime(
+            kube=kube,
+            cloud_provider=provider,
+            options=Options(
+                leader_elect=False,
+                dense_solver_enabled=False,
+                batch_max_duration=0.3,
+                batch_idle_duration=0.05,
+                gc_interval=0.3,
+                gc_registration_grace=0.8,
+            ),
+        )
+
+    kube.create(make_provisioner(requirements=_requirements()))
+    runtime = factory()
+    successor = None
+    try:
+        runtime.start()
+        pod = _rs_pod()
+        kube.create(pod)
+        runtime.provision_once()
+        node = kube.list_nodes()[0]
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        kube.update(node)
+        kube.bind_pod(pod, node.name)
+        healthy_instance = node.spec.provider_id.split("///", 1)[1]
+        # a second node that will go ghost: provision for a throwaway pod
+        pod2 = _rs_pod()
+        kube.create(pod2)
+        runtime.provision_once()
+        ghost = next(n for n in kube.list_nodes() if n.name != node.name)
+        kube.delete(pod2, grace=False)
+        # mid-provision crash artifacts: an instance launched with no node...
+        leaked = _leak_instance(backend)
+        # ...and the ghost's instance dies out-of-band
+        backend.terminate_instance(ghost.spec.provider_id.split("///", 1)[1])
+        time.sleep(0.9)  # age the leak past the registration grace
+        runtime.crash()  # kill -9: no graceful cleanup, loops just stop
+
+        successor = factory()
+        successor.start()  # startup reconstruction: resync + recovery + GC sweep
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            registered = {
+                n.spec.provider_id.split("///", 1)[1] for n in kube.list_nodes() if n.spec.provider_id
+            }
+            if (
+                not backend.instance_exists(leaked)
+                and kube.get_node(ghost.name) is None
+                and set(backend.instances) == registered
+            ):
+                break
+            time.sleep(0.1)
+        assert not backend.instance_exists(leaked), "the mid-provision leak must be terminated"
+        assert kube.get_node(ghost.name) is None, "the ghost node must be finalized"
+        # zero leaked instances: cloud inventory == registered capacity
+        registered = {n.spec.provider_id.split("///", 1)[1] for n in kube.list_nodes() if n.spec.provider_id}
+        assert set(backend.instances) == registered
+        # the healthy node and its pod survived the crash + sweep untouched
+        survivor = kube.get_node(node.name)
+        assert survivor is not None and survivor.metadata.deletion_timestamp is None
+        assert backend.instance_exists(healthy_instance)
+        fresh_pod = kube.get("Pod", pod.metadata.name, namespace=pod.metadata.namespace)
+        assert fresh_pod is not None and fresh_pod.spec.node_name == node.name
+        # the successor's resync made it READY (a cacheless restart would
+        # block synchronized() forever)
+        assert successor.ready()
+    finally:
+        if successor is not None:
+            successor.stop()
+        else:
+            runtime.stop()
+        if service is not None:
+            service.stop()
+        LeaderElector._leader = None
+
+
+class TestRecoverLedgerReconstruction:
+    """Deterministic recover(): one un-started Runtime over hand-crafted
+    durable markers; no threads, no clock stepping."""
+
+    def _runtime(self):
+        clock = FakeClock()
+        kube = KubeCluster(clock=clock)
+        provider = SimulatedCloudProvider(backend=CloudBackend(clock=clock), kube=kube, clock=clock)
+        runtime = Runtime(
+            kube=kube,
+            cloud_provider=provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False),
+        )
+        kube.create(make_provisioner(requirements=_requirements()))
+        return runtime, kube
+
+    def _owned_node(self, kube, name=None, annotations=None, initialized=True, unschedulable=False):
+        labels = {lbl.PROVISIONER_NAME_LABEL: "default"}
+        if initialized:
+            labels[lbl.LABEL_NODE_INITIALIZED] = "true"
+        node = make_node(name=name or "", labels=labels, allocatable={"cpu": "4"})
+        node.metadata.annotations.update(annotations or {})
+        node.metadata.finalizers.append(lbl.TERMINATION_FINALIZER)
+        node.spec.unschedulable = unschedulable
+        kube.create(node)
+        return node
+
+    def test_mid_drain_node_recharges_the_ledger(self):
+        runtime, kube = self._runtime()
+        node = self._owned_node(kube, annotations={lbl.DISRUPTING_ANNOTATION: "drift"})
+        kube.delete(node)  # deletion timestamp set; the finalizer holds it
+        summary = runtime.disruption.recover()
+        assert summary["recharged"] == [node.name]
+        assert runtime.disruption.tracker.is_charged("default", node.name)
+        assert runtime.disruption.tracker.total_in_flight() == 1
+
+    def test_stranded_pre_drain_node_is_released_and_uncordoned(self):
+        runtime, kube = self._runtime()
+        node = self._owned_node(
+            kube, annotations={lbl.DISRUPTING_ANNOTATION: "consolidation"}, unschedulable=True
+        )
+        summary = runtime.disruption.recover()
+        assert summary["released"] == [node.name]
+        fresh = kube.get_node(node.name)
+        assert lbl.DISRUPTING_ANNOTATION not in fresh.metadata.annotations
+        assert not fresh.spec.unschedulable, "a stranded cordon must not outlive the crash"
+        assert runtime.disruption.tracker.total_in_flight() == 0
+
+    def test_uninitialized_replacement_with_live_candidate_is_reaped(self):
+        runtime, kube = self._runtime()
+        candidate = self._owned_node(kube)
+        replacement = self._owned_node(
+            kube, annotations={lbl.REPLACEMENT_FOR_ANNOTATION: candidate.name}, initialized=False
+        )
+        summary = runtime.disruption.recover()
+        assert summary["reaped"] == [replacement.name]
+        reaped = kube.get_node(replacement.name)
+        assert reaped is None or reaped.metadata.deletion_timestamp is not None
+        assert kube.get_node(candidate.name).metadata.deletion_timestamp is None
+
+    def test_replacement_whose_candidate_is_gone_is_adopted(self):
+        runtime, kube = self._runtime()
+        replacement = self._owned_node(
+            kube, annotations={lbl.REPLACEMENT_FOR_ANNOTATION: "node-that-drained-away"}, initialized=False
+        )
+        summary = runtime.disruption.recover()
+        assert summary["adopted"] == [replacement.name]
+        fresh = kube.get_node(replacement.name)
+        assert fresh is not None and lbl.REPLACEMENT_FOR_ANNOTATION not in fresh.metadata.annotations
+        assert runtime.cluster.is_node_nominated(replacement.name), "adopted capacity stays protected briefly"
+
+    def test_initialized_replacement_is_adopted_even_with_live_candidate(self):
+        runtime, kube = self._runtime()
+        candidate = self._owned_node(kube)
+        replacement = self._owned_node(
+            kube, annotations={lbl.REPLACEMENT_FOR_ANNOTATION: candidate.name}, initialized=True
+        )
+        summary = runtime.disruption.recover()
+        assert summary["adopted"] == [replacement.name]
+        assert kube.get_node(replacement.name) is not None
+
+    def test_clean_cluster_recovers_nothing(self):
+        runtime, kube = self._runtime()
+        self._owned_node(kube)
+        summary = runtime.disruption.recover()
+        assert summary == {"recharged": [], "released": [], "reaped": [], "adopted": []}

@@ -1,0 +1,176 @@
+"""GC reconciliation sweep: orphaned instances and ghost nodes, both ways.
+
+The sweep (controllers/gc) reconciles the cloud's instance inventory against
+node objects: instances with no node past the registration grace are
+terminated (a crash between CreateFleet and kube.create leaks exactly this
+shape), nodes whose instance vanished are finalized and their pods drained
+onto live capacity. Providers without an instance inventory (the fake
+provider's fixture nodes) are never swept — the cloud's own word is the only
+admissible evidence for deleting capacity.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeCondition, NodeSelectorRequirement, OP_IN, OwnerReference
+from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend, FleetInstanceSpec, FleetRequest
+from karpenter_tpu.cloudprovider.simulated.provider import SimulatedCloudProvider
+from karpenter_tpu.controllers.gc import GarbageCollectionController
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import LeaderElector, Runtime
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_node, make_pod, make_provisioner
+
+
+class GCEnv:
+    def __init__(self):
+        self.clock = FakeClock()
+        self.kube = KubeCluster(clock=self.clock)
+        self.backend = CloudBackend(clock=self.clock)
+        self.provider = SimulatedCloudProvider(backend=self.backend, kube=self.kube, clock=self.clock)
+        self.runtime = Runtime(
+            kube=self.kube,
+            cloud_provider=self.provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False, gc_registration_grace=30.0),
+        )
+        self.gc = self.runtime.gc
+        self.kube.create(
+            make_provisioner(
+                requirements=[
+                    NodeSelectorRequirement(key=lbl.LABEL_CAPACITY_TYPE, operator=OP_IN, values=["spot", "on-demand"])
+                ]
+            )
+        )
+
+    def close(self):
+        LeaderElector._leader = None
+
+    def launch_node(self, pod_count: int = 0):
+        pods = []
+        for _ in range(pod_count):
+            pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+            pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+            pods.append(pod)
+            self.kube.create(pod)
+        if not pods:
+            # provision needs at least one pending pod; use a throwaway
+            pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+            pod.metadata.owner_references.append(OwnerReference(kind="ReplicaSet", name="rs"))
+            self.kube.create(pod)
+        self.runtime.provision_once()
+        node = self.kube.list_nodes()[-1]
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        self.kube.update(node)
+        for pod in pods:
+            self.kube.bind_pod(pod, node.name)
+        if not pods:
+            self.kube.delete(pod, grace=False)  # the throwaway: node ends up empty
+        return node, pods
+
+    def instance_id(self, node) -> str:
+        return node.spec.provider_id.split("///", 1)[1]
+
+    def leak_instance(self) -> str:
+        """An instance with no node: the crash-between-launch-and-bind shape."""
+        template = self.backend.ensure_launch_template("gc-leak", "img", [], "")
+        instance = self.backend.create_fleet(
+            FleetRequest(
+                specs=[
+                    FleetInstanceSpec(
+                        instance_type=self.backend.catalog[0].name,
+                        zone="zone-a",
+                        capacity_type="on-demand",
+                        launch_template_id=template.template_id,
+                    )
+                ],
+                capacity_type="on-demand",
+            )
+        )
+        return instance.instance_id
+
+
+@pytest.fixture()
+def env():
+    e = GCEnv()
+    yield e
+    e.close()
+
+
+class TestOrphanSweep:
+    def test_orphan_terminated_after_grace(self, env):
+        node, _ = env.launch_node()
+        leaked = env.leak_instance()
+        before = env.gc.collected.value(direction="orphaned-instance")  # the registry is process-global
+        env.clock.step(31)  # past the registration grace
+        result = env.gc.reconcile()
+        assert result["orphans"] == [leaked]
+        assert not env.backend.instance_exists(leaked)
+        # the registered node's instance is untouched
+        assert env.backend.instance_exists(env.instance_id(node))
+        assert env.gc.collected.value(direction="orphaned-instance") == before + 1
+
+    def test_fresh_launch_spared_inside_grace(self, env):
+        leaked = env.leak_instance()
+        env.clock.step(5)  # the launch->register window is still open
+        result = env.gc.reconcile()
+        assert result["orphans"] == []
+        assert env.backend.instance_exists(leaked)
+        # ...but the grace only defers: the next sweep past it collects
+        env.clock.step(26)
+        assert env.gc.reconcile()["orphans"] == [leaked]
+
+
+class TestGhostSweep:
+    def test_ghost_node_finalized_and_pods_drained(self, env):
+        node, pods = env.launch_node(pod_count=2)
+        before = env.gc.collected.value(direction="ghost-node")  # the registry is process-global
+        env.backend.terminate_instance(env.instance_id(node))
+        result = env.gc.reconcile()
+        assert result["ghosts"] == [node.name]
+        assert env.kube.get_node(node.name) is None, "ghost node finalized (drained + finalizer stripped)"
+        # the evicted pods are pending again: their ReplicaSet reschedules them
+        for pod in pods:
+            fresh = env.kube.get("Pod", pod.metadata.name, namespace=pod.metadata.namespace)
+            assert fresh is None or not fresh.spec.node_name
+        assert env.gc.collected.value(direction="ghost-node") == before + 1
+
+    def test_live_node_untouched(self, env):
+        node, _ = env.launch_node(pod_count=1)
+        result = env.gc.reconcile()
+        assert result == {"orphans": [], "ghosts": []}
+        assert env.kube.get_node(node.name) is not None
+
+    def test_already_terminating_node_left_to_termination(self, env):
+        node, _ = env.launch_node(pod_count=1)
+        env.backend.terminate_instance(env.instance_id(node))
+        self_deleted = env.kube.get_node(node.name)
+        env.kube.delete(self_deleted)  # termination already owns it
+        before = env.gc.collected.value(direction="ghost-node")
+        env.gc.reconcile()
+        assert env.gc.collected.value(direction="ghost-node") == before
+
+
+class TestSweepScoping:
+    def test_provider_without_inventory_never_sweeps(self):
+        """Fixture nodes against a provider with no list_instances (the fake
+        provider shape) must never be reaped: without the cloud's own
+        inventory there is no admissible evidence of death."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+
+        kube = KubeCluster(clock=FakeClock())
+        provider = FakeCloudProvider(instance_types(2))
+        gc = GarbageCollectionController(kube, cluster=None, cloud_provider=provider, clock=kube.clock)
+        node = make_node(labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": "4"})
+        kube.create(node)
+        assert gc.reconcile() == {"orphans": [], "ghosts": []}
+        assert kube.get_node(node.name) is not None
+
+    def test_node_without_provider_id_unknowable(self, env):
+        fixture = make_node(labels={lbl.PROVISIONER_NAME_LABEL: "default"}, allocatable={"cpu": "4"})
+        env.kube.create(fixture)
+        result = env.gc.reconcile()
+        assert fixture.name not in result["ghosts"]
+        assert env.kube.get_node(fixture.name) is not None

@@ -323,6 +323,50 @@ class TestInterruptionCatalog:
         assert len(env.kube.list_nodes()) == queue_nodes, "redelivery must not double-provision"
         assert queue.depth() == 0, "the redelivered copy is deleted by its fresh handle"
 
+    def test_receiver_crash_redelivery_new_controller_is_idempotent(self, env_local):
+        """The crash-consistency contract: the controller RECEIVES a notice,
+        performs the action, and dies before DeleteMessage. The visibility
+        timeout redelivers the message to the RESTARTED controller — a fresh
+        instance with none of the dead one's duplicate-suppression or
+        one-solve-per-victim memory — and the replay must be idempotent
+        because the idempotency lives in durable state (the cordon, the
+        deletion timestamp), not in process memory."""
+        from karpenter_tpu.controllers.interruption import InterruptionController
+
+        env = env_local
+        node, pods = env.launch_node_with_pods(2)
+        queue = env.backend.notifications
+        queue.send({"kind": "spot_interruption", "instance_id": env.instance_id(node), "deadline": env.clock.now() + 120.0})
+        # the receiver crashes between the action and the delete: fail the
+        # delete verb itself (the process died holding the receipt handle)
+        original_delete = queue.delete_message
+        queue.delete_message = lambda handle: (_ for _ in ()).throw(ConnectionError("receiver died before delete"))
+        try:
+            env.interruption.poll_once()
+        finally:
+            queue.delete_message = original_delete
+        # the acted-on notice is in flight, undeleted (the victim's own
+        # instance_terminated echo is also queued — visible, not in flight)
+        assert queue.in_flight() == 1, "the crash left the handled message undeleted"
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert len(replacements) == 1, "the first delivery provisioned the replacement"
+        instances_after_crash = set(env.backend.instances)
+        # 'restart': a brand-new controller over the same queue, no memory
+        restarted = InterruptionController(
+            env.kube, env.runtime.cluster, env.runtime.provisioner, env.interruption.queue,
+            termination=env.runtime.termination, clock=env.clock,
+        )
+        env.clock.step(31)  # past the visibility timeout: redelivery due
+        for _ in range(4):  # drain the at-least-once echo chain to quiescence
+            restarted.poll_once()
+            env.runtime.termination.reconcile_all()
+        assert queue.depth() == 0, "the restarted controller deleted the redelivered copy"
+        assert set(env.backend.instances) == instances_after_crash, "replay must not double-launch"
+        replacements = [n for n in env.kube.list_nodes() if n.name != node.name]
+        assert len(replacements) == 1, "replay must not re-provision a second replacement"
+        fresh = env.kube.get_node(node.name)
+        assert fresh is None or fresh.metadata.deletion_timestamp is not None, "the victim stays handed to termination"
+
     def test_unknown_instance_tolerated(self, env_local):
         env = env_local
         env.backend.notifications.send(

@@ -45,10 +45,12 @@ class TestSchemaValidator:
                         "ideal_cost_per_hour": 1.0,
                         "cost_drift_ratio": 1.0,
                         "lost_pods": 0,
+                        "leaked_instances": 0,
                         "budget_violations": 0,
                         "pods_desired": 4,
                         "pods_bound": 4,
                         "nodes_churned": {},
+                        "restarts": 0,
                     },
                     "samples": [
                         {"t": 0.0, "pending_pods": 4, "nodes": 0, "cost_per_hour": 0.0, "disrupting": 0},
@@ -110,6 +112,9 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     assert run["converged"] is True, f"smoke scenario did not converge: {run['scores']}"
     scores = run["scores"]
     assert scores["lost_pods"] == 0
+    # cloud instances minus registered capacity: zero at convergence, the
+    # crash-consistency acceptance invariant (instances == bound capacity)
+    assert scores["leaked_instances"] == 0
     assert scores["budget_violations"] == 0
     assert scores["pods_bound"] == scores["pods_desired"] == 8
     # the burst actually flowed through the SLO layer: every pod's pending
@@ -148,6 +153,7 @@ def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
             where = f"{doc['scenario']}/{run['transport']}"
             assert run["converged"], f"{where}: did not converge ({scores})"
             assert scores["lost_pods"] == 0, where
+            assert scores["leaked_instances"] == 0, where
             assert scores["budget_violations"] == 0, where
             assert scores["cost_drift_ratio"] > 0, where
             assert scores["pending_latency_seconds"], where
@@ -157,3 +163,10 @@ def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
     for run in by_name["drift_rollout_storm"]["runs"]:
         churned = run["scores"]["nodes_churned"]
         assert churned.get("drift", 0) >= 1, f"drift rollout must replace nodes: {churned}"
+    # the PR 6 diurnal finding is closed: consolidation pins post-ramp drift
+    for run in by_name["diurnal_ramp_consolidated"]["runs"]:
+        ratio = run["scores"]["cost_drift_ratio"]
+        assert ratio <= 1.5, f"consolidated diurnal must pin cost drift <= 1.5x, got {ratio}"
+    # the crash storm actually stormed: >= 3 restarts, invariants held anyway
+    for run in by_name["crash_storm"]["runs"]:
+        assert run["scores"]["restarts"] >= 3, "crash storm must restart the control plane >= 3 times"
