@@ -1,0 +1,28 @@
+"""Rule registry for the static-analysis framework (analysis/core.py).
+
+Each rule is a callable `List[Module] -> List[Finding]`. Adding a rule here
+is the ONLY registration step: the CLI, the baseline machinery, and the
+fixture-test harness all iterate ALL_RULES.
+"""
+
+from __future__ import annotations
+
+from . import hygiene, jaxcheck, lockcheck
+
+ALL_RULES = (
+    lockcheck.check,
+    jaxcheck.check,
+    hygiene.check_swallow,
+    hygiene.check_clock,
+    hygiene.check_threads,
+)
+
+RULE_NAMES = (
+    lockcheck.RULE,
+    jaxcheck.RULE,
+    hygiene.SWALLOW_RULE,
+    hygiene.CLOCK_RULE,
+    hygiene.THREADS_RULE,
+)
+
+__all__ = ["ALL_RULES", "RULE_NAMES", "lockcheck", "jaxcheck", "hygiene"]

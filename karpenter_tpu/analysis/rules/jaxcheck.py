@@ -1,0 +1,215 @@
+"""jaxcheck: no host-sync constructs inside jit-reachable code.
+
+The dense solver's <200 ms SLO died once already to an unattributable
+host-side stall (ROADMAP "Net state"); CvxCluster-style incremental solving
+only pays off while the jitted path stays free of accidental device->host
+round trips. This rule finds the silent ones at lint time:
+
+- `.item()` / `.tolist()` / `jax.device_get` / `.block_until_ready()` —
+  explicit host syncs;
+- `np.asarray` / `np.array` on values flowing through a jitted function —
+  a device fetch that disguises itself as a type conversion;
+- builtin `float()` / `int()` / `bool()` on non-constant values — forces
+  concretization of a traced value;
+- wall-clock (`time.*`) and host RNG (`random.*`, `np.random.*`) calls —
+  trace-time constants masquerading as runtime values, plus a recompile
+  hazard;
+- Python `if`/`while` on a traced parameter of a directly-jitted function
+  (parameters named in `static_argnames` are exempt) — array truthiness.
+
+Scope: functions REACHABLE from jit entry points in `solver/`, `ops/`, and
+`parallel/`. Entry points are functions decorated `@jax.jit` / `@jit` /
+`@partial(jax.jit, ...)` / `@pjit` / `@jax.pmap`, plus any function passed
+to a `jax.jit(...)`-shaped call. Reachability follows plain-name and
+`self.<name>` references transitively across the scanned modules — host-side
+orchestration code (e.g. solver/dense.py's dispatch loop) that merely CALLS
+jitted kernels is deliberately out of scope; it is allowed to sync.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Module, decorator_name, dotted_name
+
+RULE = "jaxcheck"
+
+SCOPE_PREFIXES = ("karpenter_tpu/solver/", "karpenter_tpu/ops/", "karpenter_tpu/parallel/")
+
+_JIT_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_SYNC = {"np.asarray", "np.array", "onp.asarray", "onp.array", "numpy.asarray", "numpy.array"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def _is_jit_expr(node: ast.AST) -> Tuple[bool, Set[str]]:
+    """(is this expression a jit wrapper?, static_argnames if readable)."""
+    name = dotted_name(node.func) if isinstance(node, ast.Call) else dotted_name(node)
+    if name.rsplit(".", 1)[-1] in _JIT_NAMES:
+        return True, set()
+    # partial(jax.jit, static_argnames=(...)) / functools.partial(jit, ...)
+    if isinstance(node, ast.Call) and decorator_name(node) == "partial" and node.args:
+        inner = dotted_name(node.args[0])
+        if inner.rsplit(".", 1)[-1] in _JIT_NAMES:
+            static: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    if isinstance(kw.value, (ast.Tuple, ast.List)):
+                        static = {e.value for e in kw.value.elts if isinstance(e, ast.Constant)}
+                    elif isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        static = {kw.value.value}
+            return True, static
+    return False, set()
+
+
+class _FunctionIndexer(ast.NodeVisitor):
+    """Collects every function definition (by simple name) plus the jit
+    entry set for one module."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.functions: Dict[str, ast.AST] = {}
+        self.entries: Dict[str, Set[str]] = {}  # name -> static_argnames
+        self._jit_wrapped_names: Set[str] = set()
+
+    def _visit_function(self, node) -> None:
+        self.functions.setdefault(node.name, node)
+        for dec in node.decorator_list:
+            jitted, static = _is_jit_expr(dec)
+            if jitted:
+                self.entries.setdefault(node.name, set()).update(static)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # fn = jax.jit(impl) / dispatch = pjit(impl, ...) forms
+        jitted, _ = _is_jit_expr(node)
+        if jitted:
+            for arg in node.args:
+                name = dotted_name(arg)
+                if name and "." not in name:
+                    self._jit_wrapped_names.add(name)
+                elif name.startswith("self."):
+                    self._jit_wrapped_names.add(name[5:])
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        for name in self._jit_wrapped_names:
+            if name in self.functions:
+                self.entries.setdefault(name, set())
+
+
+def _referenced_functions(fn: ast.AST, known: Set[str]) -> Set[str]:
+    """Simple names referenced in a function body that name known functions
+    (call targets AND bare references like a kernel handed to pallas_call)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in known:
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute) and node.attr in known:
+            base = dotted_name(node.value)
+            if base in ("self", "cls"):
+                out.add(node.attr)
+    return out
+
+
+class _HostSyncChecker(ast.NodeVisitor):
+    def __init__(self, module: Module, fn, scope: str, traced_params: Set[str]):
+        self.module = module
+        self.fn = fn
+        self.scope = scope
+        self.traced_params = traced_params
+        self.findings: List[Finding] = []
+
+    def _flag(self, node: ast.AST, key: str, message: str) -> None:
+        self.findings.append(
+            Finding(rule=RULE, path=self.module.path, line=node.lineno, scope=self.scope, key=key, message=message)
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            self._flag(node, node.func.attr, f".{node.func.attr}() forces a device->host sync inside a jitted path")
+        elif name in _NP_SYNC:
+            self._flag(node, name, f"{name}() on a traced value is a hidden device->host transfer")
+        elif name == "jax.device_get":
+            self._flag(node, name, "jax.device_get inside a jitted path is an explicit host sync")
+        elif name in _CONCRETIZERS and node.args and not isinstance(node.args[0], ast.Constant):
+            self._flag(node, name, f"builtin {name}() concretizes a traced value (host sync) inside a jitted path")
+        elif name.startswith("time.") or (leaf in ("time", "monotonic", "perf_counter", "sleep") and name.split(".")[0] == "time"):
+            self._flag(node, "wall-clock", f"{name}() inside a jitted path is a trace-time constant, not a runtime clock")
+        elif (name.split(".", 1)[0] == "random" or ".random." in f".{name}") and name.split(".", 1)[0] != "jax":
+            # jax.random.* is the CORRECT in-jit RNG; stdlib random and
+            # np.random are the host-side hazards
+            self._flag(node, "host-rng", f"{name}() is host RNG inside a jitted path; use jax.random with an explicit key")
+        self.generic_visit(node)
+
+    def _check_truthiness(self, node) -> None:
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Name) and sub.id in self.traced_params:
+                self._flag(
+                    node, "truthiness",
+                    f"Python branch on traced parameter {sub.id!r} (array truthiness); "
+                    f"use lax.cond/jnp.where or mark it static",
+                )
+                return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truthiness(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truthiness(node)
+        self.generic_visit(node)
+
+
+def check(modules: List[Module]) -> List[Finding]:
+    scanned = [m for m in modules if m.path.startswith(SCOPE_PREFIXES)]
+    indexers: List[_FunctionIndexer] = []
+    known: Set[str] = set()
+    for module in scanned:
+        indexer = _FunctionIndexer(module)
+        indexer.visit(module.tree)
+        indexer.finish()
+        indexers.append(indexer)
+        known.update(indexer.functions)
+
+    # reachability: entry functions, then every known function they reference
+    reachable: Dict[Tuple[str, str], Tuple[Module, ast.AST, Set[str], bool]] = {}
+    worklist: List[Tuple[str, bool, Set[str]]] = []  # (name, is_entry, static_argnames)
+    for indexer in indexers:
+        for name, static in indexer.entries.items():
+            worklist.append((name, True, static))
+    seen: Set[str] = set()
+    while worklist:
+        name, is_entry, static = worklist.pop()
+        if name in seen and not is_entry:
+            continue
+        seen.add(name)
+        for indexer in indexers:
+            fn = indexer.functions.get(name)
+            if fn is None:
+                continue
+            key = (indexer.module.path, name)
+            if key not in reachable or is_entry:
+                reachable[key] = (indexer.module, fn, static, is_entry)
+            for ref in _referenced_functions(fn, known):
+                if ref not in seen:
+                    worklist.append((ref, False, set()))
+
+    findings: List[Finding] = []
+    for (path, name), (module, fn, static, is_entry) in sorted(reachable.items()):
+        traced: Set[str] = set()
+        if is_entry:
+            args = fn.args
+            params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+            traced = {p for p in params if p not in static and p not in ("self", "cls")}
+        checker = _HostSyncChecker(module, fn, scope=name, traced_params=traced)
+        for stmt in fn.body:
+            checker.visit(stmt)
+        findings.extend(checker.findings)
+    return findings

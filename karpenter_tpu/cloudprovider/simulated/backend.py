@@ -11,9 +11,10 @@ templates, and full call capture for tests.
 from __future__ import annotations
 
 import itertools
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ...analysis import WITNESS, guarded_by
 
 
 @dataclass(frozen=True)
@@ -142,13 +143,29 @@ def default_catalog() -> List[InstanceTypeInfo]:
     return unique
 
 
+@guarded_by(
+    "_lock",
+    "instances",
+    "fleet_tokens",
+    "pending_reclaims",
+    "launch_templates",
+    "od_prices",
+    "spot_prices",
+    "create_fleet_calls",
+    "terminate_calls",
+    "describe_calls",
+    "insufficient_capacity_pools",
+    "next_error",
+    "_drop_response",
+    "api_latency",
+)
 class CloudBackend:
     def __init__(self, catalog: Optional[List[InstanceTypeInfo]] = None, zones: Sequence[str] = ("zone-a", "zone-b", "zone-c"), clock=None):
         from ...utils.clock import Clock
         from .notifications import NotificationQueue
 
         self.clock = clock or Clock()
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("cloud.backend")
         # the SQS-analog interruption feed (notifications.py): every
         # lifecycle event below lands here; consumers poll it in-process or
         # over the HTTP transport (api.py /v1/queue routes)
@@ -209,14 +226,16 @@ class CloudBackend:
     # -- describe APIs -------------------------------------------------------
 
     def _simulate_latency(self) -> None:
-        # outside the lock: injected slowness must not serialize every caller
-        delay = self.api_latency
+        with self._lock:
+            delay = self.api_latency
+        # sleep OUTSIDE the lock: injected slowness must not serialize every caller
         if delay > 0:
             self.clock.sleep(delay)
 
     def inject_api_latency(self, seconds: float) -> None:
         """Degrade (or restore, with 0) the control plane's response time."""
-        self.api_latency = max(0.0, seconds)
+        with self._lock:
+            self.api_latency = max(0.0, seconds)
 
     def describe_instance_types(self) -> List[InstanceTypeInfo]:
         self._simulate_latency()
@@ -239,9 +258,17 @@ class CloudBackend:
         return groups
 
     def get_on_demand_price(self, type_name: str) -> Optional[float]:
-        return self.od_prices.get(type_name)
+        with self._lock:
+            return self._od_price_locked(type_name)
 
     def get_spot_price(self, type_name: str, zone: str) -> Optional[float]:
+        with self._lock:
+            return self._spot_price_locked(type_name, zone)
+
+    def _od_price_locked(self, type_name: str) -> Optional[float]:
+        return self.od_prices.get(type_name)
+
+    def _spot_price_locked(self, type_name: str, zone: str) -> Optional[float]:
         return self.spot_prices.get((type_name, zone))
 
     def describe_prices(self) -> Tuple[Dict[str, float], Dict[Tuple[str, str], float]]:
@@ -317,9 +344,9 @@ class CloudBackend:
                     unavailable.append(pool)
                     continue
                 if spec.capacity_type == "spot":
-                    price = self.get_spot_price(spec.instance_type, spec.zone)
+                    price = self._spot_price_locked(spec.instance_type, spec.zone)
                 else:
-                    price = self.get_on_demand_price(spec.instance_type)
+                    price = self._od_price_locked(spec.instance_type)
                 if price is None:
                     continue
                 if best is None or price < best[0]:

@@ -22,6 +22,7 @@ import uuid
 from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
+from ...analysis import WITNESS, guarded_by
 from .backend import CloudBackend, FleetInstance, FleetRequest, TransientCloudError
 
 BATCH_WINDOW_SECONDS = 0.05
@@ -45,11 +46,12 @@ def _request_key(request: FleetRequest) -> Tuple:
     )
 
 
+@guarded_by("_lock", "_pending")
 class CreateFleetBatcher:
     def __init__(self, backend: CloudBackend, window: float = BATCH_WINDOW_SECONDS):
         self.backend = backend
         self.window = window
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("cloud.fleetbatcher")
         self._pending: Dict[Tuple, _Batch] = {}
 
     def _create_one(self, request: FleetRequest, token: str) -> FleetInstance:

@@ -38,6 +38,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...analysis import WITNESS, guarded_by
+
 DEFAULT_VISIBILITY_TIMEOUT = 30.0
 DEFAULT_MAX_RECEIVE_COUNT = 3
 # retention bound (the SQS message-retention-period analog, expressed as a
@@ -71,6 +73,16 @@ class ReceivedMessage:
     body: dict = field(default_factory=dict)
 
 
+@guarded_by(
+    "_lock",
+    "_messages",
+    "_dead_letters",
+    "sent_total",
+    "deleted_total",
+    "redelivered_total",
+    "expired_total",
+    aliases=("_arrival",),
+)
 class NotificationQueue:
     def __init__(
         self,
@@ -85,7 +97,7 @@ class NotificationQueue:
         self.visibility_timeout = visibility_timeout
         self.max_receive_count = max_receive_count
         self.max_depth = max_depth
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("cloud.notifications")
         self._arrival = threading.Condition(self._lock)
         self._messages: Dict[str, QueueMessage] = {}  # insertion-ordered
         self._dead_letters: List[QueueMessage] = []

@@ -1,0 +1,134 @@
+"""Static-analysis gate: lockcheck + jaxcheck + hygiene over karpenter_tpu/.
+
+    python -m karpenter_tpu.cmd.analyze                   # report everything
+    python -m karpenter_tpu.cmd.analyze --check [root]    # CI gate
+    python -m karpenter_tpu.cmd.analyze --write-baseline  # (re)seed baseline
+
+Mirrors the `gen_docs --check` / `gen_manifests --check` contract: exit 0
+when the tree is clean (every finding either fixed or suppressed by a
+justified baseline entry), exit 1 with `path:line: rule[key]: message`
+lines on stderr otherwise. A baseline entry that no longer matches any
+finding is an error too — paid debt must be deleted.
+
+`--write-baseline` regenerates analysis/baseline.json from the current
+findings with TODO justifications; the diff review that replaces each TODO
+with a real sentence IS the vetting step, and `--check` rejects TODOs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def run_check(root: str, baseline_path: str = None, out=sys.stderr) -> int:
+    from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+
+    baseline_path = baseline_path or default_baseline_path()
+    modules = parse_modules(root)
+    findings = run_rules(modules)
+    baseline = Baseline.load(baseline_path)
+    failures = 0
+    for error in baseline.errors():
+        print(f"analyze --check: {error}", file=out)
+        failures += 1
+    active, suppressed, stale = baseline.split(findings)
+    for finding in active:
+        print(f"analyze --check: {finding.render()}", file=out)
+        failures += 1
+    for entry in stale:
+        print(
+            f"analyze --check: stale baseline entry {entry.get('rule')}:{entry.get('path')}:"
+            f"{entry.get('scope')}[{entry.get('key')}] matches no finding — delete it",
+            file=out,
+        )
+        failures += 1
+    if failures:
+        print(
+            f"analyze --check: {failures} problem(s) ({len(active)} finding(s), "
+            f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}); "
+            f"fix them or add a justified suppression to {os.path.relpath(baseline_path, root)}",
+            file=out,
+        )
+        return 1
+    return 0
+
+
+def run_report(root: str, baseline_path: str = None, out=sys.stdout) -> int:
+    from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+
+    baseline_path = baseline_path or default_baseline_path()
+    modules = parse_modules(root)
+    findings = run_rules(modules)
+    baseline = Baseline.load(baseline_path)
+    active, suppressed, stale = baseline.split(findings)
+    for finding in active:
+        print(finding.render(), file=out)
+    for finding in suppressed:
+        print(f"{finding.render()} (baselined)", file=out)
+    print(
+        f"{len(active)} active finding(s), {len(suppressed)} baselined, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} over {len(modules)} file(s)",
+        file=out,
+    )
+    return 0
+
+
+def write_baseline(root: str, baseline_path: str = None) -> int:
+    from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+
+    baseline_path = baseline_path or default_baseline_path()
+    modules = parse_modules(root)
+    findings = run_rules(modules)
+    existing = Baseline.load(baseline_path)
+    justifications = {
+        (e.get("rule"), e.get("path"), e.get("scope"), e.get("key")): e.get("justification", "")
+        for e in existing.suppressions
+    }
+    entries = []
+    seen = set()
+    for finding in findings:
+        key = finding.suppression_key()
+        if key in seen:  # several findings can share one (scope, key) site
+            continue
+        seen.add(key)
+        entries.append(
+            {
+                "rule": finding.rule,
+                "path": finding.path,
+                "scope": finding.scope,
+                "key": finding.key,
+                "justification": justifications.get(key, "TODO"),
+            }
+        )
+    doc = {
+        "comment": (
+            "Vetted exceptions for `python -m karpenter_tpu.cmd.analyze --check`. "
+            "Entries match findings on (rule, path, scope, key) — line-independent. "
+            "Every entry needs a real justification; --check rejects TODO."
+        ),
+        "suppressions": entries,
+    }
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {len(entries)} suppression(s) to {baseline_path}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    mode = "report"
+    if argv and argv[0] in ("--check", "--write-baseline"):
+        mode = argv.pop(0)
+    root = argv[0] if argv else os.getcwd()
+    if mode == "--check":
+        return run_check(root)
+    if mode == "--write-baseline":
+        return write_baseline(root)
+    return run_report(root)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,6 @@ from __future__ import annotations
 import os
 import signal
 import sys
-import time
 
 
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -69,6 +68,13 @@ def main(argv=None) -> int:
     from ..utils.options import parse
 
     options = parse(argv)
+    if options.enable_lock_witness:
+        # BEFORE the kube backend exists: witnessing happens at lock
+        # creation, and kube.store is the most-shared lock in the process —
+        # Runtime's own enable (for embedded callers) would come too late
+        from ..analysis.witness import WITNESS
+
+        WITNESS.enable()
     kube, url = build_kube_backend(options)
     provider = FakeCloudProvider()
     runtime = Runtime(kube=kube, cloud_provider=provider, options=options)
@@ -98,6 +104,12 @@ def main(argv=None) -> int:
         from .. import slo
 
         extra_routes.update(slo.routes())
+    if options.enable_lock_witness:
+        # lock-order witness read surface: acquisition-order graph, cycle
+        # (potential-deadlock) list, hold times on the metrics port
+        from ..analysis import witness
+
+        extra_routes.update(witness.routes())
     obs = ObservabilityServer(
         healthy=runtime.healthy,
         ready=lambda: runtime.ready() and runtime.healthy(),
@@ -117,9 +129,12 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle)
     backend = f"apiserver {url}" if url else "in-memory backend"
     print(f"karpenter-tpu controller running ({backend}); Ctrl-C to stop", file=sys.stderr)
+    from ..utils.clock import Clock
+
+    clock = Clock()
     try:
         while not stop["flag"]:
-            time.sleep(0.5)
+            clock.sleep(0.5)
     finally:
         runtime.stop()
         obs.stop()

@@ -16,9 +16,9 @@ controller) never consults this ledger — capacity loss is not rate-limited.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Dict, Optional, Set
 
+from ...analysis import WITNESS, guarded_by
 from ...api.provisioner import Provisioner, parse_budget_nodes
 from ...utils import cron
 
@@ -52,6 +52,7 @@ def allowed_disruptions(provisioner: Provisioner, total_nodes: int, now: float) 
     return limit
 
 
+@guarded_by("_lock", "_charged")
 class BudgetTracker:
     """The atomic in-flight ledger, one charge per disrupted node. All
     methods charge through the single disruption orchestrator pass, so the
@@ -59,7 +60,7 @@ class BudgetTracker:
     threads (metrics scrapes, tests)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("disruption.budgets")
         self._charged: Dict[str, Set[str]] = {}  # provisioner -> node names
 
     def in_flight(self, provisioner_name: str) -> int:

@@ -472,6 +472,10 @@ class DisruptionController:
                     self.cluster.nominate_node_for_pod(node.name)
                     launched.append(node.name)
             except Exception as err:  # noqa: BLE001 - capacity errors self-heal next pass
+                log.warning(
+                    "disruption %s: replacement launch failed for %s (unwinding %d partial launch(es)): %s",
+                    cmd.method, ", ".join(cmd.node_names()), len(launched), err,
+                )
                 sp.set(error=str(err))
                 for name in launched:
                     ghost = self.kube.get_node(name)

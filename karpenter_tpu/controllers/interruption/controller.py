@@ -33,9 +33,9 @@ actions_performed{action}, dead_letter_depth) make the loop observable.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
+from ...analysis import WITNESS, guarded_by
 from ...api import labels as lbl
 from ...api.objects import NO_SCHEDULE, Node, Taint
 from ...events import Recorder
@@ -64,6 +64,7 @@ log = get_logger("interruption")
 HANDLED_TTL = 600.0
 
 
+@guarded_by("_lock", "_handled", "_replaced")
 class InterruptionController:
     MAX_MESSAGES = 10
 
@@ -86,7 +87,7 @@ class InterruptionController:
         self.termination = termination  # TerminationController: the drain handoff
         self.recorder = recorder or Recorder()
         self.clock = clock or (kube.clock if kube is not None else None) or Clock()
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("interruption.controller")
         self._handled: dict = {}  # message_id -> expiry (duplicate suppression)
         self._replaced: dict = {}  # node name -> expiry (one proactive solve per victim)
         self.messages_received = REGISTRY.counter(
@@ -129,8 +130,8 @@ class InterruptionController:
                 log.exception("handling interruption message %s failed; left for redelivery", message.message_id)
         try:
             self.dead_letter_depth.set(float(self.queue.dead_letter_depth()))
-        except Exception:  # noqa: BLE001 - observability only
-            pass
+        except Exception as err:  # noqa: BLE001 - observability only
+            log.debug("dead-letter depth scrape failed (gauge unchanged): %s", err)
         return len(messages)
 
     # -- message handling ----------------------------------------------------

@@ -11,18 +11,18 @@ large solve per window beats many small dispatches (host<->device latency).
 
 from __future__ import annotations
 
-import threading
-
+from ...analysis import WITNESS, guarded_by
 from ...config import Config
 
 
+@guarded_by("_cond", "_triggered", "_immediate", "_trigger_time")
 class Batcher:
     def __init__(self, config: Config, clock=None):
         from ...utils.clock import Clock
 
         self.config = config
         self.clock = clock or Clock()
-        self._cond = threading.Condition()
+        self._cond = WITNESS.condition("provisioning.batcher")
         self._triggered = False
         self._immediate = False
         self._trigger_time = 0.0

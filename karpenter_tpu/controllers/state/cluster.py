@@ -15,9 +15,9 @@ whole-cluster repack.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional
 
+from ...analysis import WITNESS, guarded_by, requires_lock
 from ...api import labels as lbl
 from ...api.objects import Node, Pod
 from ...cloudprovider.types import CloudProvider
@@ -90,6 +90,18 @@ def _pod_key(pod: Pod) -> str:
     return f"{pod.metadata.namespace}/{pod.metadata.name}"
 
 
+@guarded_by(
+    "_lock",
+    "_nodes",
+    "_bindings",
+    "_pods",
+    "_anti_affinity_pods",
+    "_nominated",
+    "_consolidation_epoch",
+    "_last_node_deletion",
+    "_last_node_creation",
+    "_node_deletion_seq",
+)
 class Cluster:
     def __init__(self, kube: KubeCluster, cloud_provider: Optional[CloudProvider] = None, clock=None, nomination_ttl: float = 20.0):
         from ...utils.clock import Clock
@@ -98,7 +110,7 @@ class Cluster:
         self.cloud_provider = cloud_provider
         self.clock = clock or kube.clock or Clock()
         self.nomination_ttl = nomination_ttl
-        self._lock = threading.RLock()
+        self._lock = WITNESS.rlock("state.cluster")
         self._nodes: Dict[str, StateNode] = {}
         self._bindings: Dict[str, str] = {}  # pod key -> node name
         self._pods: Dict[str, Pod] = {}  # pod key -> pod (bound pods)
@@ -132,6 +144,7 @@ class Cluster:
                 return
             self._update_node(node)
 
+    @requires_lock
     def _update_node(self, node: Node) -> None:
         existing = self._nodes.get(node.name)
         state = StateNode(self, node)
@@ -147,6 +160,7 @@ class Cluster:
         self._nodes[node.name] = state
         self._bump_epoch()
 
+    @requires_lock
     def _populate_capacity(self, state: StateNode) -> None:
         """Initialized nodes are trusted verbatim. Uninitialized ones fall
         back to instance-type data — including per-resource restoration of
@@ -178,6 +192,7 @@ class Cluster:
         state.allocatable = allocatable
         state.available = dict(allocatable)
 
+    @requires_lock
     def _populate_volume_limits(self, state: StateNode) -> None:
         csi = self.kube.get_csi_node(state.name)
         state.volume_limits = limits_from_csi_node(csi)
@@ -210,6 +225,7 @@ class Cluster:
                 prefetched = _NOT_FETCHED
             self._update_pod(pod, prefetched)
 
+    @requires_lock
     def _update_pod(self, pod: Pod, prefetched_node=_NOT_FETCHED) -> None:
         key = _pod_key(pod)
         old_node = self._bindings.get(key)
@@ -243,6 +259,7 @@ class Cluster:
             self._apply_pod(state, pod)
         self._bump_epoch()
 
+    @requires_lock
     def _apply_pod(self, state: StateNode, pod: Pod) -> None:
         key = _pod_key(pod)
         requests = res.pod_requests(pod)
@@ -256,6 +273,7 @@ class Cluster:
         state.host_port_usage.add(pod)
         state.volume_usage.add(pod)
 
+    @requires_lock
     def _remove_pod(self, pod: Pod) -> None:
         key = _pod_key(pod)
         node_name = self._bindings.pop(key, None)
@@ -341,6 +359,7 @@ class Cluster:
 
     # -- consolidation bookkeeping ----------------------------------------------
 
+    @requires_lock
     def _bump_epoch(self) -> None:
         self._consolidation_epoch += 1
 
@@ -349,10 +368,12 @@ class Cluster:
             return self._consolidation_epoch
 
     def last_node_deletion_time(self) -> float:
-        return self._last_node_deletion
+        with self._lock:
+            return self._last_node_deletion
 
     def last_node_creation_time(self) -> float:
-        return self._last_node_creation
+        with self._lock:
+            return self._last_node_creation
 
     # -- restart reconstruction ---------------------------------------------------
 

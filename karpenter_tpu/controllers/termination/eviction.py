@@ -13,16 +13,17 @@ consuming, eviction.go:71-90).
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Deque, Dict, Optional, Set
 
+from ...analysis import WITNESS, guarded_by
 from ...api.objects import Pod
 from ...events import Recorder
 from ...kube.cluster import KubeCluster
 from ...utils import pod as podutils
 
 
+@guarded_by("_lock", "_queue", "_queued", "_failures", "_not_before")
 class EvictionQueue:
     BASE_DELAY = 0.1  # evictionQueueBaseDelay (eviction.go:37)
     MAX_DELAY = 10.0  # evictionQueueMaxDelay (eviction.go:38)
@@ -33,7 +34,7 @@ class EvictionQueue:
         self.kube = kube
         self.recorder = recorder or Recorder()
         self.clock = clock or kube.clock or Clock()
-        self._lock = threading.Lock()
+        self._lock = WITNESS.lock("termination.eviction")
         self._queue: Deque[Pod] = deque()
         self._queued: Set[str] = set()
         self._failures: Dict[str, int] = {}

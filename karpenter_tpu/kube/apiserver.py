@@ -556,7 +556,9 @@ class APIServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "APIServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, name="kube-apiserver", daemon=True
+        )
         self._thread.start()
         return self
 

@@ -25,7 +25,6 @@ from __future__ import annotations
 import http.client
 import json
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 from urllib.parse import urlparse
 
@@ -42,26 +41,31 @@ DEFAULT_BURST = 300  # options.go:66
 
 
 class TokenBucket:
-    """client-go flowcontrol.NewTokenBucketRateLimiter analog."""
+    """client-go flowcontrol.NewTokenBucketRateLimiter analog. Time flows
+    through the Clock seam so a FakeClock suite can drive refill
+    deterministically (the analyze clock rule's whole point)."""
 
-    def __init__(self, qps: float, burst: int):
+    def __init__(self, qps: float, burst: int, clock=None):
+        from ..utils.clock import Clock
+
         self.qps = qps
         self.burst = float(burst)
+        self.clock = clock or Clock()
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._last = self.clock.now()
         self._lock = threading.Lock()
 
     def take(self) -> None:
         while True:
             with self._lock:
-                now = time.monotonic()
+                now = self.clock.now()
                 self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
                 self._last = now
                 if self._tokens >= 1.0:
                     self._tokens -= 1.0
                     return
                 wait = (1.0 - self._tokens) / self.qps
-            time.sleep(wait)
+            self.clock.sleep(wait)
 
 
 class ApiStatusError(RuntimeError):
@@ -99,9 +103,9 @@ class HttpKubeClient:
 
             self._ssl_context = ssl.create_default_context(cafile=ca_file)
         self._token_file = token_file
-        self._limiter = TokenBucket(qps, burst)
         # same default as KubeCluster: consumers dereference kube.clock.now()
         self.clock = clock or Clock()
+        self._limiter = TokenBucket(qps, burst, clock=self.clock)
         self._watch_threads: List[threading.Thread] = []
         self._watch_cancels: List[tuple] = []  # (kind, handler, cancel Event)
         self._stop = threading.Event()
@@ -310,7 +314,7 @@ class HttpKubeClient:
                 if self._stop.is_set() or (cancel is not None and cancel.is_set()):
                     return
                 log.debug("watch %s: reconnecting after %s", kind, exc)
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
 
     def _stream(self, kind: str, rv: int, handler: Callable[[WatchEvent], None], known: Dict[str, object], cancel=None) -> int:
         conn = self._new_connection(timeout=300)

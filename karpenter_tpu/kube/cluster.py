@@ -46,10 +46,11 @@ def _key(obj) -> tuple:
 
 class KubeCluster:
     def __init__(self, clock=None):
+        from ..analysis import WITNESS
         from ..utils.clock import Clock
 
         self.clock = clock or Clock()
-        self._lock = threading.RLock()
+        self._lock = WITNESS.rlock("kube.store")
         self._objects: Dict[str, Dict[tuple, object]] = {}
         self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
         self._version = 0

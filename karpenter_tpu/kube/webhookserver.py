@@ -154,7 +154,9 @@ class AdmissionWebhookServer:
         return f"https://{host}:{port}"
 
     def start(self) -> "AdmissionWebhookServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, name="webhook-server", daemon=True
+        )
         self._thread.start()
         return self
 

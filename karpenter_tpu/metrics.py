@@ -15,6 +15,8 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from .analysis.guards import guarded_by
+
 NAMESPACE = "karpenter"
 
 DURATION_BUCKETS = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
@@ -28,6 +30,7 @@ class Metric:
         self._lock = threading.Lock()
 
 
+@guarded_by("_lock", "_values")
 class Counter(Metric):
     def __init__(self, name, help, label_names=()):
         super().__init__(name, help, tuple(label_names))
@@ -61,6 +64,7 @@ class Counter(Metric):
                 yield dict(zip(self.label_names, key)), value, ""
 
 
+@guarded_by("_lock", "_values")
 class Gauge(Counter):
     def set(self, value: float, **labels) -> None:
         key = tuple(labels.get(n, "") for n in self.label_names)
@@ -73,6 +77,7 @@ class Gauge(Counter):
             self._values.pop(key, None)
 
 
+@guarded_by("_lock", "_counts", "_sums", "_totals")
 class Histogram(Metric):
     def __init__(self, name, help, label_names=(), buckets=None):
         super().__init__(name, help, tuple(label_names))
@@ -123,6 +128,7 @@ class Histogram(Metric):
         return _Timer(self, labels)
 
 
+@guarded_by("_lock", "_counts", "_sums", "_totals", "_samples")
 class Summary(Histogram):
     """Quantile summary approximated from retained samples (bounded)."""
 
@@ -207,6 +213,7 @@ def escape_label_value(value) -> str:
     return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+@guarded_by("_lock", "_metrics")
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()

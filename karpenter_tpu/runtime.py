@@ -73,6 +73,13 @@ class Runtime:
 
     def __post_init__(self):
         configure_logging(self.options.log_level)
+        if self.options.enable_lock_witness:
+            # must flip BEFORE any component constructs its locks below:
+            # witnessing happens at lock creation, and a disabled witness
+            # hands out plain (never-wrapped) locks
+            from .analysis.witness import WITNESS
+
+            WITNESS.enable()
         if self.options.enable_tracing:
             # the process-wide tracer (tracing.py): spans from every
             # controller pass land in one bounded ring served over

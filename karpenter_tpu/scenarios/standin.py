@@ -21,7 +21,10 @@ import itertools
 import threading
 
 from ..api.objects import Container, NodeCondition, ObjectMeta, OwnerReference, Pod, PodCondition, PodSpec, PodStatus, ResourceRequirements
+from ..logsetup import get_logger
 from .primitives import ScenarioContext
+
+log = get_logger("standin")
 
 _counter = itertools.count(1)
 
@@ -67,7 +70,7 @@ class WorkloadStandIn(threading.Thread):
             try:
                 self.tick()
             except Exception:  # noqa: BLE001 - the stand-in must survive races with the runtime
-                pass
+                log.debug("stand-in tick lost a race with the runtime; retrying next tick", exc_info=True)
 
     def tick(self) -> None:
         ctx = self.ctx
@@ -78,8 +81,8 @@ class WorkloadStandIn(threading.Thread):
                 node.status.conditions = [NodeCondition(type="Ready", status="True")]
                 try:
                     ctx.kube.update(node)
-                except Exception:  # noqa: BLE001 - lost update race with a controller
-                    pass
+                except Exception as err:  # noqa: BLE001 - lost update race with a controller
+                    log.debug("kubelet stand-in ready-flip lost an update race on %s: %s", node.name, err)
         # kube-scheduler: first-fit cpu onto schedulable live capacity
         usable = []
         for node in nodes:
@@ -99,7 +102,8 @@ class WorkloadStandIn(threading.Thread):
                 if slot[1] >= need:
                     try:
                         ctx.kube.bind_pod(pod, slot[0].name)
-                    except Exception:  # noqa: BLE001 - pod deleted under us
+                    except Exception as err:  # noqa: BLE001 - pod deleted under us
+                        log.debug("scheduler stand-in bind of %s raced a delete: %s", pod.metadata.name, err)
                         break
                     slot[1] -= need
                     break

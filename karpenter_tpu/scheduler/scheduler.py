@@ -34,12 +34,15 @@ from ..tracing import (
     DecisionRecord,
     classify_rejection,
 )
+from ..logsetup import get_logger
 from ..utils import resources as res
 from .existingnode import ExistingNodeView
 from .node import IncompatibleError, VirtualNode, catalog_filter_cache
 from .preferences import Preferences
 from .queue import Queue
 from .topology import Topology
+
+log = get_logger("scheduler")
 
 
 @dataclass
@@ -160,6 +163,7 @@ class Scheduler:
             try:
                 queue_pods = self.dense_solver.presolve(self, queue_pods)
             except Exception:  # noqa: BLE001 - dense path must never break solving
+                log.exception("dense presolve failed; falling back to host scheduling for the remainder")
                 committed = {p.uid for n in self.nodes for p in n.pods}
                 committed.update(p.uid for v in self.existing_nodes for p in v.pods)
                 queue_pods = [p for p in pods if p.uid not in committed]

@@ -1402,7 +1402,8 @@ class DenseSolver:
                     bucket_type_cost_pallas(stats, np.full((1, 2), 4, np.float32), np.ones((1,), np.float32), np.ones((1, 1), bool))
                 )
                 cls._pallas_ok = probe.shape == (3, 1) and bool(probe[2, 0])
-            except Exception:
+            except Exception as exc:  # noqa: BLE001 - no Pallas is a supported mode
+                log.debug("Pallas probe failed; kernels disabled for this process: %r", exc)
                 cls._pallas_ok = False
         return cls._pallas_ok
 

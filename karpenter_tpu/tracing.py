@@ -44,6 +44,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from .analysis.guards import guarded_by
 from .metrics import REGISTRY
 
 # perf_counter -> epoch seconds, fixed once per process: every span start is
@@ -121,6 +122,7 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+@guarded_by("_lock", "_ring", "_inflight", "_last_trace_id")
 class Tracer:
     def __init__(self, capacity: int = DEFAULT_RING):
         self._lock = threading.Lock()
@@ -178,7 +180,8 @@ class Tracer:
 
     def last_trace_id(self) -> Optional[str]:
         """Trace ID of the most recently COMPLETED trace."""
-        return self._last_trace_id
+        with self._lock:
+            return self._last_trace_id
 
     # -- span creation ---------------------------------------------------------
 
@@ -457,6 +460,7 @@ class DecisionRecord:
         }
 
 
+@guarded_by("_lock", "_ring")
 class DecisionLog:
     """Bounded ring of per-pod scheduling decisions, indexed by pod name.
 

@@ -35,6 +35,12 @@ class Options:
     # over the metrics port. Off by default — disabled SLO accounting is a
     # true no-op on the watch hot path (same bar as tracing)
     enable_slo: bool = False
+    # lock-order witness (analysis/witness.py): every lock created through
+    # WITNESS after enabling records acquisition order, contention, and hold
+    # times; cycles (potential deadlocks) surface on /debug/locks and the
+    # karpenter_lockwitness_* families. Off by default — disabled means the
+    # shared classes get PLAIN threading locks, zero wrapper overhead
+    enable_lock_witness: bool = False
     leader_elect: bool = True
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -124,6 +130,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--enable-profiling", action="store_true", default=_env("ENABLE_PROFILING", defaults.enable_profiling))
     parser.add_argument("--enable-tracing", action="store_true", default=_env("ENABLE_TRACING", defaults.enable_tracing))
     parser.add_argument("--enable-slo", action="store_true", default=_env("ENABLE_SLO", defaults.enable_slo))
+    parser.add_argument("--enable-lock-witness", action="store_true", default=_env("ENABLE_LOCK_WITNESS", defaults.enable_lock_witness))
     parser.add_argument("--trace-ring-size", type=int, default=_env("TRACE_RING_SIZE", defaults.trace_ring_size))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))

@@ -21,7 +21,27 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+import pytest  # noqa: E402
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: storm/soak tiers excluded from the tier-1 budget (-m 'not slow')"
     )
+
+
+@pytest.fixture
+def lock_order_witness():
+    """Shared chaos-suite fixture (each storm/campaign module opts in with a
+    one-line autouse wrapper): enable the lock-order witness so every lock
+    created during the test is witnessed, then assert at teardown that the
+    acquisition-order graph stayed acyclic — every chaos scenario doubles
+    as a deadlock hunt."""
+    from karpenter_tpu.analysis.witness import WITNESS
+
+    WITNESS.enable()
+    yield WITNESS
+    cycles = WITNESS.cycles()
+    WITNESS.disable()
+    WITNESS.reset()
+    assert cycles == [], f"lock-order cycles (potential deadlocks) detected: {cycles}"

@@ -1,0 +1,462 @@
+"""Static-analysis framework tests: per-rule fixtures, baseline machinery,
+and the tier-1 `analyze --check` gate over the real repo.
+
+Each rule family gets a positive fixture (a seeded violation the rule MUST
+catch) and a negative one (idiomatic clean code it must stay quiet on) —
+the acceptance contract that intentionally-seeded violations of every
+family are caught. The subprocess test at the bottom is the CI gate itself:
+the committed tree plus the committed baseline must analyze clean, the same
+exit-code contract as `gen_docs --check` / `gen_manifests --check`.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from karpenter_tpu.analysis.core import Baseline, Finding, parse_modules, run_rules
+from karpenter_tpu.cmd import analyze
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _tree(tmp_path, files: dict) -> str:
+    """Write a throwaway karpenter_tpu/-shaped tree and return its root."""
+    for rel, source in files.items():
+        path = tmp_path / "karpenter_tpu" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+def _findings(tmp_path, files: dict):
+    return run_rules(parse_modules(_tree(tmp_path, files)))
+
+
+def _keys(findings):
+    return {(f.rule, f.scope, f.key) for f in findings}
+
+
+# -- lockcheck -----------------------------------------------------------------
+
+
+class TestLockcheck:
+    def test_unguarded_access_and_call_site_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import threading
+                from karpenter_tpu.analysis import guarded_by, requires_lock
+
+                @guarded_by("_lock", "_data", "_count", aliases=("_cond",))
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._data = {}
+                        self._count = 0  # __init__ is exempt
+
+                    def bad_read(self):
+                        return len(self._data)
+
+                    def bad_write(self):
+                        self._count += 1
+
+                    def _drain_locked(self):
+                        return self._data.pop("x", None)
+
+                    @requires_lock
+                    def _bump(self):
+                        self._count += 1
+
+                    def bad_call(self):
+                        return self._drain_locked()
+
+                    def bad_decorated_call(self):
+                        self._bump()
+            """,
+        })
+        keys = _keys(findings)
+        assert ("lockcheck", "Box.bad_read", "_data") in keys
+        assert ("lockcheck", "Box.bad_write", "_count") in keys
+        assert ("lockcheck", "Box.bad_call", "_drain_locked") in keys
+        assert ("lockcheck", "Box.bad_decorated_call", "_bump") in keys
+        assert not any(f.scope == "Box.__init__" for f in findings), "__init__ is exempt"
+
+    def test_clean_class_is_quiet(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import threading
+                from karpenter_tpu.analysis import guarded_by, requires_lock
+
+                @guarded_by("_lock", "_data", "_count", aliases=("_cond",))
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cond = threading.Condition(self._lock)
+                        self._data = {}
+                        self._count = 0
+
+                    def read(self):
+                        with self._lock:
+                            return len(self._data)
+
+                    def read_via_alias(self):
+                        with self._cond:
+                            return self._count
+
+                    def _drain_locked(self):
+                        return self._data.pop("x", None)
+
+                    @requires_lock
+                    def _bump(self):
+                        self._count += 1
+
+                    def drain(self):
+                        with self._lock:
+                            self._bump()
+                            return self._drain_locked()
+            """,
+        })
+        assert [f for f in findings if f.rule == "lockcheck"] == []
+
+    def test_undecorated_class_is_ignored(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                class Plain:
+                    def touch(self):
+                        self._data = 1
+                        return self._data
+            """,
+        })
+        assert [f for f in findings if f.rule == "lockcheck"] == []
+
+
+# -- jaxcheck ------------------------------------------------------------------
+
+
+class TestJaxcheck:
+    def test_host_sync_in_jitted_function_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "solver/kernels.py": """
+                import time
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def bad(x):
+                    if x:
+                        return x
+                    np.asarray(x)
+                    time.monotonic()
+                    return float(x.sum()) + x.max().item()
+            """,
+        })
+        keys = _keys(findings)
+        assert ("jaxcheck", "bad", "truthiness") in keys
+        assert ("jaxcheck", "bad", "np.asarray") in keys
+        assert ("jaxcheck", "bad", "wall-clock") in keys
+        assert ("jaxcheck", "bad", "float") in keys
+        assert ("jaxcheck", "bad", "item") in keys
+
+    def test_transitive_helper_reachable_from_jit_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "ops/kern.py": """
+                from functools import partial
+                import jax
+
+                def _helper(x):
+                    return x.sum().item()
+
+                @partial(jax.jit, static_argnames=("flag",))
+                def entry(x, flag):
+                    if flag:
+                        return _helper(x)
+                    return x
+            """,
+        })
+        keys = _keys(findings)
+        assert ("jaxcheck", "_helper", "item") in keys
+        # `flag` is static: branching on it is legal
+        assert ("jaxcheck", "entry", "truthiness") not in keys
+
+    def test_jax_random_is_not_host_rng(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "solver/rng.py": """
+                import random
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def entry(x, key):
+                    good = jax.random.uniform(key, x.shape)  # the correct in-jit RNG
+                    bad = random.random() + np.random.rand()
+                    return good + bad
+            """,
+        })
+        rng = [f for f in findings if f.key == "host-rng"]
+        flagged = {f.message.split("(")[0].strip() for f in rng}
+        assert flagged == {"random.random", "np.random.rand"}, (
+            f"stdlib random + np.random flagged, jax.random exempt: {flagged}"
+        )
+
+    def test_host_orchestration_code_not_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "solver/host.py": """
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                @jax.jit
+                def kernel(x):
+                    return jnp.sum(x)
+
+                def dispatch(batch):
+                    # host side: calls the kernel, syncs the result — allowed
+                    fut = kernel(jnp.asarray(batch))
+                    return float(np.asarray(fut))
+            """,
+            "controllers/loop.py": """
+                def anything(x):
+                    return float(x.sum().item())  # outside solver/ops/parallel
+            """,
+        })
+        assert [f for f in findings if f.rule == "jaxcheck"] == []
+
+
+# -- hygiene: swallow ----------------------------------------------------------
+
+
+class TestSwallow:
+    def test_silent_broad_except_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                def loop():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+
+                def bare():
+                    try:
+                        work()
+                    except:
+                        return None
+            """,
+        })
+        swallows = {f.scope: f.key for f in findings if f.rule == "swallow"}
+        assert "loop" in swallows and "bare" in swallows
+        # keys are content-derived (except:<hash>), not ordinals: a vetted
+        # suppression cannot migrate to a different handler added later
+        assert all(k.startswith("except:") for k in swallows.values())
+        assert swallows["loop"] != swallows["bare"]
+
+    def test_logged_counted_raised_or_narrow_not_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import logging
+                log = logging.getLogger(__name__)
+
+                def logged():
+                    try:
+                        work()
+                    except Exception:
+                        log.exception("work failed")
+
+                def counted(self):
+                    try:
+                        work()
+                    except Exception:
+                        self.failures.inc()
+
+                def reraised():
+                    try:
+                        work()
+                    except Exception:
+                        cleanup()
+                        raise
+
+                def narrow():
+                    try:
+                        work()
+                    except ValueError:
+                        pass
+            """,
+        })
+        assert [f for f in findings if f.rule == "swallow"] == []
+
+
+# -- hygiene: clock ------------------------------------------------------------
+
+
+class TestClockRule:
+    def test_direct_time_calls_flagged_including_aliases(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import time
+                import time as _time
+                from time import sleep
+
+                def a():
+                    time.sleep(1)
+
+                def b():
+                    return _time.monotonic()
+
+                def c():
+                    sleep(0.1)
+            """,
+        })
+        keys = _keys(findings)
+        assert ("clock", "a", "sleep") in keys
+        assert ("clock", "b", "monotonic") in keys
+        assert ("clock", "c", "sleep") in keys
+
+    def test_clock_seam_and_clock_module_exempt(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "utils/clock.py": """
+                import time
+
+                class Clock:
+                    def now(self):
+                        return time.monotonic()
+
+                    def sleep(self, seconds):
+                        time.sleep(seconds)
+            """,
+            "mod.py": """
+                import time
+
+                def good(clock):
+                    clock.sleep(0.1)
+                    return time.time()  # time.time is not in the rule: wall timestamps are fine
+            """,
+        })
+        assert [f for f in findings if f.rule == "clock"] == []
+
+
+# -- hygiene: threads ----------------------------------------------------------
+
+
+class TestThreadsRule:
+    def test_unnamed_or_undaemonized_thread_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import threading
+
+                def spawn():
+                    threading.Thread(target=run, daemon=True).start()
+                    threading.Thread(target=run, name="ok").start()
+            """,
+        })
+        keys = _keys(findings)
+        assert ("threads", "spawn", "name") in keys
+        assert ("threads", "spawn", "daemon") in keys
+
+    def test_named_daemon_thread_not_flagged(self, tmp_path):
+        findings = _findings(tmp_path, {
+            "mod.py": """
+                import threading
+
+                def spawn():
+                    threading.Thread(target=run, name="worker", daemon=True).start()
+            """,
+        })
+        assert [f for f in findings if f.rule == "threads"] == []
+
+
+# -- baseline machinery --------------------------------------------------------
+
+
+class TestBaseline:
+    def _finding(self):
+        return Finding(rule="swallow", path="karpenter_tpu/mod.py", line=9, scope="loop", key="except#0", message="m")
+
+    def test_match_suppresses_independent_of_line(self):
+        baseline = Baseline(suppressions=[{
+            "rule": "swallow", "path": "karpenter_tpu/mod.py", "scope": "loop",
+            "key": "except#0", "justification": "intentional",
+        }])
+        active, suppressed, stale = baseline.split([self._finding()])
+        assert active == [] and len(suppressed) == 1 and stale == []
+
+    def test_stale_entry_reported(self):
+        baseline = Baseline(suppressions=[{
+            "rule": "swallow", "path": "karpenter_tpu/gone.py", "scope": "loop",
+            "key": "except#0", "justification": "paid debt",
+        }])
+        active, suppressed, stale = baseline.split([self._finding()])
+        assert len(active) == 1 and suppressed == [] and len(stale) == 1
+
+    def test_unjustified_entry_is_an_error(self):
+        for bad in ("  ", "TODO", "todo"):
+            baseline = Baseline(suppressions=[{
+                "rule": "swallow", "path": "karpenter_tpu/mod.py", "scope": "loop",
+                "key": "except#0", "justification": bad,
+            }])
+            assert any("justification" in e for e in baseline.errors()), f"{bad!r} must be rejected"
+        assert Baseline(suppressions=[{
+            "rule": "swallow", "path": "p", "scope": "s", "key": "k", "justification": "because",
+        }]).errors() == []
+
+    def test_check_exit_codes(self, tmp_path, capsys):
+        root = _tree(tmp_path, {
+            "mod.py": """
+                def loop():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+            """,
+        })
+        (finding,) = run_rules(parse_modules(root))
+        baseline_path = tmp_path / "baseline.json"
+        baseline_path.write_text(json.dumps({"suppressions": []}))
+        assert analyze.run_check(root, str(baseline_path), out=sys.stderr) == 1
+        baseline_path.write_text(json.dumps({"suppressions": [{
+            "rule": finding.rule, "path": finding.path, "scope": finding.scope,
+            "key": finding.key, "justification": "fixture",
+        }]}))
+        assert analyze.run_check(root, str(baseline_path), out=sys.stderr) == 0
+        # a TODO justification (the --write-baseline seed) must NOT pass
+        baseline_path.write_text(json.dumps({"suppressions": [{
+            "rule": finding.rule, "path": finding.path, "scope": finding.scope,
+            "key": finding.key, "justification": "TODO",
+        }]}))
+        assert analyze.run_check(root, str(baseline_path), out=sys.stderr) == 1
+
+
+# -- the tier-1 gate over the real repo ----------------------------------------
+
+
+class TestAnalyzeCheckRepo:
+    def test_analyze_check_exits_zero_on_the_repo(self):
+        """The CI gate itself (alongside gen_docs --check / gen_manifests
+        --check): the committed tree + committed baseline analyze clean."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.cmd.analyze", "--check"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, f"analyze --check failed:\n{proc.stderr}"
+
+    def test_analyze_check_catches_a_seeded_violation(self, tmp_path):
+        """End-to-end negative control: the same entry point exits 1 when a
+        violation with no baseline entry is present."""
+        root = _tree(tmp_path, {
+            "mod.py": """
+                def loop():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+            """,
+        })
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"suppressions": []}))
+        assert analyze.run_check(root, str(baseline), out=sys.stderr) == 1

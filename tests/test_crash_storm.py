@@ -35,6 +35,12 @@ def _slo_teardown():
     SLO.reset()
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
 def _crash_storm():
     (scenario,) = [s for s in default_campaign() if s.name == "crash_storm"]
     return scenario

@@ -37,6 +37,12 @@ from karpenter_tpu.tracing import TRACER
 from tests.helpers import make_node, make_pod, make_provisioner
 from tests.test_disruption import DisruptionEnv
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
 POD_CPU = 0.8
 # the drifted nodes run pods too big for any one-cpu node's slack, so their
 # re-simulation MUST open fresh capacity — the launch-before-drain chain is

@@ -36,6 +36,12 @@ from karpenter_tpu.runtime import LeaderElector, Runtime
 from karpenter_tpu.utils.options import Options
 from tests.helpers import make_pod, make_provisioner
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
 POD_CPU = 0.5
 DESIRED_PODS = 24
 STORM_MESSAGES = 50

@@ -26,6 +26,12 @@ def _slo_teardown():
     SLO.reset()
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
 class TestSchemaValidator:
     def _valid_doc(self):
         from karpenter_tpu.provenance import provenance_block
