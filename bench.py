@@ -298,7 +298,7 @@ def assert_span_tree(tree, context: str) -> None:
 def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS, phase_key=None):
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
     times = []
-    phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "assemble", "commit", "fill_device")}
+    phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "mask", "assemble", "commit", "fill_device")}
     last_stats = None
     for _ in range(trials):
         elapsed, scheduled, nodes, cost, stats, packing = run_once(
@@ -311,6 +311,9 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         phase_trials["device"].append(stats.device_seconds)
         # host work overlapped with the device RT: splits device-link time
         # from host assembly when attributing headline drift
+        # offering-availability cube reduction (device matmul at the head of
+        # the device phase): subset of device time, like assemble
+        phase_trials["mask"].append(stats.mask_seconds)
         phase_trials["assemble"].append(stats.assemble_seconds)
         phase_trials["commit"].append(stats.commit_seconds)
         phase_trials["fill_device"].append(stats.fill_device_seconds)
@@ -332,6 +335,7 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
             "nodes_opened_dense": last_stats.nodes_opened_dense,
             "nodes_opened_host_floor": last_stats.nodes_opened_host_floor,
             "node_guard_failopens": last_stats.node_guard_failopens,
+            "masked_offerings": last_stats.masked_offerings,
             # the final trial's span tree (encode/device/commit children
             # under the solve root) — the bisect-from-artifacts evidence
             "span_tree": capture_span_tree(),
@@ -446,6 +450,8 @@ def _smoke() -> dict:
             "fill_pods_host": stats.fill_pods_host,
             "nodes_opened_dense": stats.nodes_opened_dense,
             "nodes_opened_host_floor": stats.nodes_opened_host_floor,
+            "masked_offerings": stats.masked_offerings,
+            "mask_seconds": stats.mask_seconds,
             "span_tree": span_tree,
         }
         log(f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes)")
@@ -491,6 +497,26 @@ def _smoke() -> dict:
     # common case, now including single-extra-rule affinity cohorts): a
     # nonzero host-routed pod count here means a plan() fail-open regressed
     assert summary["repack"]["fill_pods_vectorized"] >= 1, "[repack] no pods through the vectorized fill"
+
+    log("smoke: ice_mask (offering-availability mask active)")
+    from dataclasses import replace as _replace
+
+    masked_types = instance_types(100)
+    # quarantine every offering of the 25 cheapest types (the
+    # unavailable-offerings cache shape): the dense path must schedule the
+    # whole batch onto the surviving types, with the mask applied as a
+    # device-side phase — never a host loop and never a masked selection
+    for it in masked_types[:25]:
+        it._offerings = tuple(_replace(o, available=False) for o in it._offerings)
+    check("ice_mask", build_workload(500, seed=9), FakeCloudProvider(masked_types), [make_provisioner()])
+    assert summary["ice_mask"]["masked_offerings"] > 0, "[ice_mask] availability mask never engaged"
+    assert summary["ice_mask"]["mask_seconds"] > 0, "[ice_mask] mask phase not measured"
+    device_children = {
+        c["name"] for c in next(
+            c for c in summary["ice_mask"]["span_tree"]["children"] if c["name"] == "device"
+        ).get("children", ())
+    }
+    assert "mask" in device_children, f"[ice_mask] no device-side mask span: {sorted(device_children)}"
 
     log("smoke: interruption queue counters")
     from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend

@@ -21,6 +21,8 @@ from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
 from ..utils.quantity import parse_quantity
+from .errors import InsufficientCapacityError
+from .offerings import count_insufficient_capacity
 from .types import CloudProvider, InstanceType, NodeRequest, Offering
 
 LABEL_INSTANCE_SIZE = "size"
@@ -235,17 +237,41 @@ class FakeCloudProvider(CloudProvider):
                 raise err
             self.create_calls.append(node_request)
             n = next(self._counter)
+            ice_pools = set(self.insufficient_capacity_pools)
+            allow_ice = self.allow_insufficient_capacity
 
         requirements = node_request.template.requirements
+        skipped = []
         for it in node_request.instance_type_options:
             for offering in it.offerings():
-                if (it.name(), offering.zone, offering.capacity_type) in self.insufficient_capacity_pools:
-                    continue
-                if requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone) and requirements.get(
+                if not requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone) or not requirements.get(
                     lbl.LABEL_CAPACITY_TYPE
                 ).has(offering.capacity_type):
-                    return self._to_node(node_request, it, offering, n)
-        raise RuntimeError("insufficient capacity: no available offering matched the request")
+                    continue
+                pool = (it.name(), offering.zone, offering.capacity_type)
+                if pool in ice_pools or not offering.available:
+                    # same discipline as CloudBackend.create_fleet: an
+                    # exhausted pool is skipped, the launch falls through to
+                    # the next-cheapest offering, and the skipped pool rides
+                    # the typed error if nothing remains. With
+                    # allow_insufficient_capacity=False (the default), the
+                    # FIRST exhausted pool fails the whole request — the
+                    # strict mode suites use to prove a caller would have
+                    # retried into the wall without the negative cache.
+                    skipped.append(pool)
+                    if not allow_ice:
+                        count_insufficient_capacity([pool])
+                        raise InsufficientCapacityError([pool])
+                    continue
+                return self._to_node(node_request, it, offering, n)
+        if not skipped:
+            # no offering matched the REQUIREMENTS at all: a template/
+            # scheduler bug, not a capacity failure — keep it untyped so the
+            # provisioner classifies it reason="other" and the per-pool ICE
+            # counter never records pools that were never exhausted
+            raise RuntimeError("insufficient capacity: no available offering matched the request")
+        count_insufficient_capacity(skipped)
+        raise InsufficientCapacityError(skipped)
 
     def _to_node(self, node_request: NodeRequest, it: InstanceType, offering: Offering, n: int) -> Node:
         name = f"fake-node-{n:05d}"

@@ -23,11 +23,16 @@ Protocol (all JSON; bearer-token auth on every route):
   DELETE /v1/queue/messages/{receipt-handle}             DeleteMessage
   GET    /v1/queue/attributes                            queue depth/dead-letter stats
 
-Error taxonomy is structured, not stringly: a failed CreateFleet returns
-{"error": {"code": "insufficient_capacity", "pools": [...]}} or
-{"code": "launch_template_not_found", "template_ids": [...]}, which the
+Error taxonomy is structured, not stringly: a TOTALLY failed CreateFleet
+returns 409 {"error": {"code": "insufficient_capacity", "pools": [...]}} or
+404 {"code": "launch_template_not_found", "template_ids": [...]}, which the
 client maps back to the typed exceptions the provider's ICE/negative-cache
 handling consumes — the per-item error extraction of instance.go:133-208.
+A PARTIALLY fulfilled fleet is a 200 carrying per-item results:
+{"instances": [...], "errors": [{"code": "insufficient_capacity",
+"pools": [...]}, ...], "unavailable_pools": [...]} — one typed error entry
+per unfulfilled item, plus the exhausted pools the launch loop skipped even
+when every item succeeded (the proactive negative-cache feed).
 
 CreateFleet is idempotent under client tokens: the token rides the
 FleetRequest down into the BACKEND, which remembers {token -> instance} and
@@ -263,8 +268,20 @@ class CloudAPIService:
                 specs=[FleetInstanceSpec(**spec) for spec in body.get("specs", [])],
                 capacity_type=body.get("capacity_type", ""),
                 client_token=body.get("idempotency_token", ""),
+                count=int(body.get("count", 1)),
             )
-            return 200, asdict(be.create_fleet(request))
+            result = be.create_fleet(request)
+            # per-item response shape (the EC2 CreateFleet Instances[] +
+            # Errors[] analog): fulfilled instances plus one typed error per
+            # unfulfilled item; a total failure raised above -> 409
+            return 200, {
+                "instances": [asdict(i) for i in result.instances],
+                "errors": [
+                    {"code": "insufficient_capacity", "pools": [list(p) for p in err.pools]}
+                    for err in result.errors
+                ],
+                "unavailable_pools": [list(p) for p in result.unavailable_pools],
+            }
         if parts == ["v1", "instances"] and method == "GET":
             return 200, {"items": [asdict(i) for i in be.list_instances()]}
         if parts[:2] == ["v1", "instances"] and len(parts) == 3:

@@ -44,6 +44,7 @@ from ...utils.clock import Clock
 from .backend import (
     FleetInstance,
     FleetRequest,
+    FleetResult,
     InstanceTypeInfo,
     InsufficientCapacityError,
     LaunchTemplate,
@@ -252,7 +253,7 @@ class CloudAPIClient:
     def delete_launch_template(self, name: str) -> None:
         self._call("DELETE", f"/v1/launch-templates/{quote(name)}")
 
-    def create_fleet(self, request: FleetRequest) -> FleetInstance:
+    def create_fleet(self, request: FleetRequest) -> FleetResult:
         # the request's own client token wins (callers like the fleet
         # batcher coin one per LOGICAL launch, so an application-level retry
         # dedupes too); a token-less request still gets a per-call token so
@@ -260,6 +261,7 @@ class CloudAPIClient:
         body = {
             "idempotency_token": request.client_token or uuid.uuid4().hex,
             "capacity_type": request.capacity_type,
+            "count": max(1, int(request.count)),
             "specs": [
                 {
                     "instance_type": s.instance_type,
@@ -272,7 +274,17 @@ class CloudAPIClient:
             ],
         }
         data = self._call("POST", "/v1/fleet", body)
-        return FleetInstance(**data)
+        # per-item result shape (api.py /v1/fleet): typed shortfall entries
+        # map back to the same exceptions the in-process backend raises, so
+        # provider/batcher error handling is transport-agnostic
+        return FleetResult(
+            instances=[FleetInstance(**item) for item in data.get("instances", [])],
+            errors=[
+                InsufficientCapacityError([tuple(p) for p in err.get("pools", [])])
+                for err in data.get("errors", [])
+            ],
+            unavailable_pools=[tuple(p) for p in data.get("unavailable_pools", [])],
+        )
 
     def terminate_instance(self, instance_id: str) -> None:
         try:

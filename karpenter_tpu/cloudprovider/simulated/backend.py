@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ...analysis import WITNESS, guarded_by
+from ..errors import InsufficientCapacityError, ResponseLostError, TransientCloudError
 
 
 @dataclass(frozen=True)
@@ -68,11 +69,16 @@ class FleetRequest:
     specs: List[FleetInstanceSpec]
     capacity_type: str
     # client idempotency token (the EC2 ClientToken analog): the backend
-    # remembers {token -> instance} and REPLAYS the original launch for any
+    # remembers {token -> result} and REPLAYS the original launch for any
     # retry carrying the same token, so a caller whose response was lost
     # (mid-call timeout, process crash after the launch ran) can retry
     # without double-launching. Empty = no dedup (every call launches).
     client_token: str = ""
+    # target capacity: how many instances this fleet call should launch (the
+    # EC2 TargetCapacitySpecification analog). A call may come back PARTIAL —
+    # fewer instances than `count`, with one typed error entry per
+    # unfulfilled item (FleetResult.errors).
+    count: int = 1
 
 
 @dataclass
@@ -98,21 +104,33 @@ class LaunchTemplateNotFoundError(RuntimeError):
         self.template_ids = set(template_ids)
 
 
-class InsufficientCapacityError(RuntimeError):
-    def __init__(self, pools):
-        super().__init__(f"insufficient capacity for {pools}")
-        self.pools = pools
+# the capacity taxonomy is shared with the fake provider (cloudprovider/
+# errors.py); re-exported here because the whole simulated stack imports it
+# from the backend module
+__all_errors__ = (InsufficientCapacityError, TransientCloudError, ResponseLostError)
 
 
-class TransientCloudError(RuntimeError):
-    """A transport-shaped failure the caller may retry (with the same client
-    token) — the operation's outcome is UNKNOWN to the caller."""
+@dataclass
+class FleetResult:
+    """Per-item CreateFleet outcome: the fulfilled instances plus one typed
+    error entry per unfulfilled item (the EC2 CreateFleet Instances[] +
+    Errors[] response shape, instance.go:133-208). A call that fulfilled
+    NOTHING raises `InsufficientCapacityError` instead of returning — total
+    failure stays a typed exception on both transports.
 
+    `unavailable_pools` lists every exhausted pool the launch loop skipped
+    EVEN WHEN the call succeeded on a pricier pool — the proactive feed for
+    the negative offering cache (a launch that silently fell past the
+    cheapest pool is the earliest possible ICE signal)."""
 
-class ResponseLostError(TransientCloudError):
-    """The request was fully processed but the response never arrived — the
-    in-process analog of the mid-CreateFleet connection loss the HTTP
-    service injects with drop_response_next()."""
+    instances: List[FleetInstance] = field(default_factory=list)
+    errors: List[InsufficientCapacityError] = field(default_factory=list)
+    unavailable_pools: List[Tuple[str, str, str]] = field(default_factory=list)
+
+    @property
+    def instance(self) -> FleetInstance:
+        """The single-launch accessor (count=1 callers)."""
+        return self.instances[0]
 
 
 def default_catalog() -> List[InstanceTypeInfo]:
@@ -155,6 +173,7 @@ def default_catalog() -> List[InstanceTypeInfo]:
     "terminate_calls",
     "describe_calls",
     "insufficient_capacity_pools",
+    "capacity_pools",
     "next_error",
     "_drop_response",
     "api_latency",
@@ -203,12 +222,19 @@ class CloudBackend:
         }
         # idempotency: settled launches by client token, bounded (insertion
         # order == age; an ordered-dict cap like the interruption
-        # controller's TTL maps). Only SUCCESSFUL launches are recorded —
-        # a failed create may be retried with the same token, EC2-style.
-        self.fleet_tokens: Dict[str, FleetInstance] = {}
+        # controller's TTL maps). Only calls that launched >= 1 instance are
+        # recorded — a totally failed create may be retried with the same
+        # token, EC2-style.
+        self.fleet_tokens: Dict[str, FleetResult] = {}
         self._fleet_token_cap = 4096
         # fault injection
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()  # (type, zone, capacity_type)
+        # FINITE capacity per pool: remaining launchable units for pools
+        # listed here (absent = infinite, the default). A launch from a
+        # finite pool decrements it; terminating an instance credits its
+        # pool back (real clouds regain capacity when instances free up).
+        # A pool at 0 behaves exactly like an injected ICE pool.
+        self.capacity_pools: Dict[Tuple[str, str, str], int] = {}
         self.next_error: Optional[Exception] = None
         # next n create_fleet calls EXECUTE, then lose their response
         # (ResponseLostError) — the in-process drop_response_next analog
@@ -311,13 +337,39 @@ class CloudBackend:
         with self._lock:
             self._drop_response = max(0, n)
 
-    def create_fleet(self, request: FleetRequest) -> FleetInstance:
-        """Launch ONE instance from the cheapest available spec (the
-        lowest-price / capacity-optimized strategies collapse to this in a
-        simulator with explicit price books). Idempotent under client
-        tokens: a token seen before replays the original instance without
-        launching (EC2 ClientToken semantics); the lock serializes a retry
-        racing the original call."""
+    def set_pool_capacity(self, instance_type: str, zone: str, capacity_type: str, capacity: Optional[int]) -> None:
+        """Give a pool FINITE remaining capacity (`capacity` launches left;
+        0 = exhausted right now), or restore it to infinite with None. The
+        seam the capacity-crunch scenarios drive: exhausting the cheapest
+        pool mid-burst makes create_fleet return partial results / typed
+        ICEs instead of capacity."""
+        pool = (instance_type, zone, capacity_type)
+        with self._lock:
+            if capacity is None:
+                self.capacity_pools.pop(pool, None)
+            else:
+                self.capacity_pools[pool] = max(0, int(capacity))
+
+    def pool_capacity(self, instance_type: str, zone: str, capacity_type: str) -> Optional[int]:
+        """Remaining units of a finite pool; None = infinite."""
+        with self._lock:
+            return self.capacity_pools.get((instance_type, zone, capacity_type))
+
+    def _pool_exhausted_locked(self, pool: Tuple[str, str, str]) -> bool:
+        return pool in self.insufficient_capacity_pools or self.capacity_pools.get(pool, 1) <= 0
+
+    def create_fleet(self, request: FleetRequest) -> FleetResult:
+        """Launch up to `request.count` instances, cheapest available spec
+        first (the lowest-price / capacity-optimized strategies collapse to
+        this in a simulator with explicit price books), draining finite
+        pools as they go. Returns PER-ITEM results: the fulfilled instances
+        plus one typed `InsufficientCapacityError` entry per unfulfilled
+        item, and the exhausted pools skipped en route even on success. A
+        call that fulfills nothing raises `InsufficientCapacityError`.
+
+        Idempotent under client tokens: a token seen before replays the
+        original result without launching (EC2 ClientToken semantics); the
+        lock serializes a retry racing the original call."""
         self._simulate_latency()
         with self._lock:
             if request.client_token:
@@ -336,50 +388,88 @@ class CloudBackend:
             specs = [s for s in request.specs if s.launch_template_id in known_templates]
             if not specs and stale:
                 raise LaunchTemplateNotFoundError(stale)
-            unavailable = []
-            best: Optional[Tuple[float, FleetInstanceSpec]] = None
+            count = max(1, int(request.count))
+            priced: List[Tuple[float, FleetInstanceSpec]] = []
             for spec in specs:
-                pool = (spec.instance_type, spec.zone, spec.capacity_type)
-                if pool in self.insufficient_capacity_pools:
-                    unavailable.append(pool)
-                    continue
                 if spec.capacity_type == "spot":
                     price = self._spot_price_locked(spec.instance_type, spec.zone)
                 else:
                     price = self._od_price_locked(spec.instance_type)
-                if price is None:
-                    continue
-                if best is None or price < best[0]:
-                    best = (price, spec)
-            if best is None:
-                raise InsufficientCapacityError(unavailable or [(s.instance_type, s.zone, s.capacity_type) for s in request.specs])
-            spec = best[1]
-            instance = FleetInstance(
-                instance_id=f"i-{next(self._instance_counter):08d}",
-                instance_type=spec.instance_type,
-                subnet_id=spec.subnet_id,
-                zone=spec.zone,
-                capacity_type=spec.capacity_type,
-                launched_at=self.clock.now(),
+                if price is not None:
+                    priced.append((price, spec))
+            priced.sort(key=lambda pair: pair[0])
+            instances: List[FleetInstance] = []
+            unavailable: List[Tuple[str, str, str]] = []
+            seen_unavailable: Set[Tuple[str, str, str]] = set()
+            for _ in range(count):
+                chosen: Optional[FleetInstanceSpec] = None
+                for _price, spec in priced:
+                    pool = (spec.instance_type, spec.zone, spec.capacity_type)
+                    if self._pool_exhausted_locked(pool):
+                        if pool not in seen_unavailable:
+                            seen_unavailable.add(pool)
+                            unavailable.append(pool)
+                        continue
+                    chosen = spec
+                    break
+                if chosen is None:
+                    break
+                pool = (chosen.instance_type, chosen.zone, chosen.capacity_type)
+                if pool in self.capacity_pools:
+                    self.capacity_pools[pool] -= 1
+                instances.append(
+                    FleetInstance(
+                        instance_id=f"i-{next(self._instance_counter):08d}",
+                        instance_type=chosen.instance_type,
+                        subnet_id=chosen.subnet_id,
+                        zone=chosen.zone,
+                        capacity_type=chosen.capacity_type,
+                        launched_at=self.clock.now(),
+                    )
+                )
+            if not instances:
+                raise InsufficientCapacityError(
+                    unavailable or [(s.instance_type, s.zone, s.capacity_type) for s in request.specs]
+                )
+            for instance in instances:
+                self.instances[instance.instance_id] = instance
+            failed_pools = unavailable or [(s.instance_type, s.zone, s.capacity_type) for s in request.specs]
+            result = FleetResult(
+                instances=instances,
+                errors=[InsufficientCapacityError(failed_pools) for _ in range(count - len(instances))],
+                unavailable_pools=list(unavailable),
             )
-            self.instances[instance.instance_id] = instance
             if request.client_token:
+                # the result (instances AND shortfall errors) is the settled
+                # record for this token: a retry replays it verbatim, so a
+                # lost response never double-launches and a failed item is
+                # never resurrected by replay — the caller re-requests the
+                # shortfall under a NEW token once capacity returns
                 while len(self.fleet_tokens) >= self._fleet_token_cap:
                     del self.fleet_tokens[next(iter(self.fleet_tokens))]
-                self.fleet_tokens[request.client_token] = instance
+                self.fleet_tokens[request.client_token] = result
             if self._drop_response > 0:
                 # the launch HAPPENED (and its token is settled above); only
                 # the response is lost — a tokened retry replays it
                 self._drop_response -= 1
-                raise ResponseLostError(f"create_fleet response lost (instance {instance.instance_id} launched)")
-            return instance
+                raise ResponseLostError(
+                    f"create_fleet response lost ({len(instances)} instance(s) launched)"
+                )
+            return result
 
     def terminate_instance(self, instance_id: str) -> None:
         self._simulate_latency()
         with self._lock:
             self.terminate_calls.append(instance_id)
-            existed = self.instances.pop(instance_id, None) is not None
+            instance = self.instances.pop(instance_id, None)
+            existed = instance is not None
             self.pending_reclaims.pop(instance_id, None)
+            if existed:
+                # a finite pool regains the capacity its instance occupied
+                # (real clouds free the slot on terminate)
+                pool = (instance.instance_type, instance.zone, instance.capacity_type)
+                if pool in self.capacity_pools:
+                    self.capacity_pools[pool] += 1
         if existed:
             self.notifications.send({"kind": "instance_terminated", "instance_id": instance_id})
 
@@ -430,8 +520,13 @@ class CloudBackend:
     def stop_instance(self, instance_id: str) -> None:
         """Stop an instance out from under its node (state-change event)."""
         with self._lock:
-            existed = self.instances.pop(instance_id, None) is not None
+            instance = self.instances.pop(instance_id, None)
+            existed = instance is not None
             self.pending_reclaims.pop(instance_id, None)
+            if existed:
+                pool = (instance.instance_type, instance.zone, instance.capacity_type)
+                if pool in self.capacity_pools:
+                    self.capacity_pools[pool] += 1
         if existed:
             self.notifications.send({"kind": "instance_stopped", "instance_id": instance_id})
 
@@ -449,6 +544,7 @@ class CloudBackend:
     def reset(self) -> None:
         with self._lock:
             self.insufficient_capacity_pools = set()
+            self.capacity_pools = {}
             self.next_error = None
             self._drop_response = 0
             self.api_latency = 0.0

@@ -18,11 +18,15 @@ from ...api.objects import OP_IN
 from ...scheduling.requirement import Requirement
 from ...scheduling.requirements import Requirements
 from ...utils import resources as res
+from ..offerings import UNAVAILABLE_OFFERING_TTL, UnavailableOfferings
 from ..types import InstanceType, Offering
 from .backend import CloudBackend, InstanceTypeInfo
 
 CATALOG_CACHE_TTL = 60.0
-UNAVAILABLE_OFFERING_TTL = 180.0
+
+# the cache class moved to cloudprovider/offerings.py (it is provider-neutral
+# state fed by launch ICEs and interruption notices); legacy spelling kept
+UnavailableOfferingsCache = UnavailableOfferings
 
 
 class PricingProvider:
@@ -65,32 +69,6 @@ class PricingProvider:
             return self._spot.get((type_name, zone))
 
 
-class UnavailableOfferingsCache:
-    """Negative cache of (type, zone, capacity-type) pools that recently
-    returned insufficient capacity (instancetypes.go:211-226)."""
-
-    def __init__(self, clock, ttl: float = UNAVAILABLE_OFFERING_TTL):
-        self.clock = clock
-        self.ttl = ttl
-        self._lock = threading.Lock()
-        self._pools: Dict[Tuple[str, str, str], float] = {}
-
-    def mark_unavailable(self, type_name: str, zone: str, capacity_type: str) -> None:
-        with self._lock:
-            self._pools[(type_name, zone, capacity_type)] = self.clock.now() + self.ttl
-
-    def is_unavailable(self, type_name: str, zone: str, capacity_type: str) -> bool:
-        key = (type_name, zone, capacity_type)
-        with self._lock:
-            expiry = self._pools.get(key)
-            if expiry is None:
-                return False
-            if expiry < self.clock.now():
-                del self._pools[key]
-                return False
-            return True
-
-
 class SimulatedInstanceType(InstanceType):
     """Adapts a backend InstanceTypeInfo into the scheduler's InstanceType
     (the instancetype.go adapter): requirements from the catalog entry,
@@ -126,13 +104,19 @@ class SimulatedInstanceType(InstanceType):
         return self._offerings
 
     def requirements(self) -> Requirements:
+        # requirements derive from AVAILABLE offerings only: a zone whose
+        # every pool is quarantined must not satisfy a zone-pinned pod (the
+        # launch would ICE straight back into the wall); the full offering
+        # list — flags included — stays visible via offerings() for pricing,
+        # masks, and metrics
         if self._requirements is None:
+            live = [o for o in self._offerings if o.available] or list(self._offerings)
             self._requirements = Requirements(
                 Requirement(lbl.LABEL_INSTANCE_TYPE, OP_IN, self.info.name),
                 Requirement(lbl.LABEL_ARCH, OP_IN, self.info.architecture),
                 Requirement(lbl.LABEL_OS, OP_IN, lbl.OS_LINUX),
-                Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, *{o.zone for o in self._offerings}),
-                Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, *{o.capacity_type for o in self._offerings}),
+                Requirement(lbl.LABEL_TOPOLOGY_ZONE, OP_IN, *{o.zone for o in live}),
+                Requirement(lbl.LABEL_CAPACITY_TYPE, OP_IN, *{o.capacity_type for o in live}),
                 Requirement("karpenter-tpu/instance-family", OP_IN, self.info.family),
             )
         return self._requirements
@@ -155,7 +139,14 @@ class InstanceTypeCatalog:
         return sorted({s.zone for s in self.backend.describe_subnets(tag_selector)})
 
     def get(self, include_previous_generation: bool = False, subnet_selector: Optional[Dict[str, str]] = None) -> List[SimulatedInstanceType]:
-        key = (include_previous_generation, tuple(sorted((subnet_selector or {}).items())))
+        # the key carries the unavailable-offerings VERSION: a pool mark or
+        # a TTL expiry rebuilds the universe on the next fetch — no explicit
+        # invalidation plumbing between the negative cache and this one
+        key = (
+            include_previous_generation,
+            tuple(sorted((subnet_selector or {}).items())),
+            self.unavailable.version(),
+        )
         with self._lock:
             cached = self._cache.get(key)
             if cached is not None and self.clock.now() < cached[0]:
@@ -168,8 +159,6 @@ class InstanceTypeCatalog:
             offerings = []
             for zone in zones:
                 for capacity_type in (lbl.CAPACITY_TYPE_SPOT, lbl.CAPACITY_TYPE_ON_DEMAND):
-                    if self.unavailable.is_unavailable(info.name, zone, capacity_type):
-                        continue
                     price = (
                         self.pricing.spot_price(info.name, zone)
                         if capacity_type == lbl.CAPACITY_TYPE_SPOT
@@ -177,12 +166,24 @@ class InstanceTypeCatalog:
                     )
                     if price is None:
                         continue
-                    offerings.append(Offering(capacity_type=capacity_type, zone=zone, price=price))
-            if not offerings:
-                continue
-            cheapest = min(o.price for o in offerings if o.price is not None)
-            out.append(SimulatedInstanceType(info, offerings, cheapest))
+                    # a quarantined pool stays in the universe FLAGGED, so
+                    # topology domains and pricing remain stable while the
+                    # scheduler/solver route around it
+                    offerings.append(
+                        Offering(
+                            capacity_type=capacity_type,
+                            zone=zone,
+                            price=price,
+                            available=not self.unavailable.is_unavailable(info.name, zone, capacity_type),
+                        )
+                    )
+            live_prices = [o.price for o in offerings if o.available and o.price is not None]
+            if not live_prices:
+                continue  # every pool of this type is quarantined: drop it
+            out.append(SimulatedInstanceType(info, offerings, min(live_prices)))
         with self._lock:
+            while len(self._cache) > 8:  # version churn must not accumulate
+                self._cache.pop(next(iter(self._cache)))
             self._cache[key] = (self.clock.now() + CATALOG_CACHE_TTL, out)
         return list(out)
 

@@ -17,6 +17,7 @@ from ...api import labels as lbl
 from ...api.objects import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta
 from ...api.provisioner import Provisioner
 from ...utils import resources as res
+from ..offerings import count_insufficient_capacity
 from ..types import CloudProvider, InstanceType, NodeRequest
 from .backend import CloudBackend, FleetInstanceSpec, FleetRequest, InsufficientCapacityError, LaunchTemplateNotFoundError
 from .catalog import InstanceTypeCatalog, PricingProvider, SimulatedInstanceType, UnavailableOfferingsCache
@@ -135,8 +136,25 @@ class SimulatedCloudProvider(CloudProvider):
         self.launch_templates = LaunchTemplateProvider(self.backend, cluster_name, clock=self.clock)
         self.subnets = SubnetProvider(self.backend, self.clock)
         self.security_groups = SecurityGroupProvider(self.backend, self.clock)
-        self.fleet_batcher = CreateFleetBatcher(self.backend, window=0.0)
+        # every exhausted pool an item reports — typed ICEs AND the pools a
+        # successful launch skipped on its way to a pricier one — lands in
+        # the negative cache, so the NEXT solve routes around the crunch
+        # before ever retrying into it
+        self.fleet_batcher = CreateFleetBatcher(self.backend, window=0.0, on_unavailable=self._observe_unavailable_pools)
         self._node_counter = 0
+
+    def _observe_unavailable_pools(self, pools) -> None:
+        """Negative-cache feed shared by the launch paths: quarantine each
+        (type, zone, capacity-type) pool and count the ICE observation."""
+        count_insufficient_capacity(pools)
+        self.unavailable.mark_pools(pools)
+
+    def mark_offering_unavailable(self, type_name: str, zone: str, capacity_type: str, ttl=None) -> None:
+        """Out-of-band offering-health feed (no ICE counted): the
+        interruption controller quarantines a just-reclaimed spot pool here —
+        the pool the cloud is actively draining is the worst candidate for
+        the replacement launch."""
+        self.unavailable.mark_unavailable(type_name, zone, capacity_type, ttl=ttl)
 
     # -- admission hooks (the DefaultHook/ValidateHook seam the webhook
     # chain invokes, reference aws/cloudprovider.go:119-120) ---------------
@@ -270,6 +288,11 @@ class SimulatedCloudProvider(CloudProvider):
                 custom_user_data=node_class.user_data or None,
             )
             for offering in it.offerings():
+                if not offering.available:
+                    # quarantined pool (unavailable-offerings cache): a spec
+                    # for it would let the backend's lowest-price pick launch
+                    # straight back into the exhausted/reclaimed pool
+                    continue
                 if not requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone):
                     continue
                 if not requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type):
@@ -299,19 +322,16 @@ class SimulatedCloudProvider(CloudProvider):
 
         import uuid
 
-        try:
-            # one client token per LOGICAL launch: the batcher derives its
-            # per-waiter tokens from it and replays them on lost responses,
-            # so a transport failure mid-CreateFleet can never double-launch
-            instance = self.fleet_batcher.create_fleet(
-                FleetRequest(specs=specs, capacity_type=capacity_type, client_token=uuid.uuid4().hex)
-            )
-        except InsufficientCapacityError as err:
-            # feed the negative cache so the next solve avoids these pools
-            for type_name, zone, ct in err.pools:
-                self.unavailable.mark_unavailable(type_name, zone, ct)
-            self.catalog.invalidate()
-            raise
+        # one client token per LOGICAL launch: the batcher derives its
+        # per-waiter tokens from it and replays them on lost responses, so a
+        # transport failure mid-CreateFleet can never double-launch. An
+        # InsufficientCapacityError propagates typed to the provisioner's
+        # fallback re-solve; the batcher's on_unavailable callback has
+        # already quarantined the exhausted pools (including pools a
+        # SUCCESSFUL launch skipped) by the time either outcome lands here.
+        instance = self.fleet_batcher.create_fleet(
+            FleetRequest(specs=specs, capacity_type=capacity_type, client_token=uuid.uuid4().hex)
+        )
         return self._instance_to_node(instance, node_request)
 
     def _instance_to_node(self, instance, node_request: NodeRequest) -> Node:

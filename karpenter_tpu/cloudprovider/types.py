@@ -28,6 +28,12 @@ class Offering:
     capacity_type: str
     zone: str
     price: Optional[float] = None  # per-offering price override (spot markets)
+    # offering-health flag fed by the unavailable-offerings cache: an
+    # unavailable offering stays IN the universe (stable topology domains,
+    # visible to pricing and metrics) but is never selected — the host
+    # loop's type_has_offering and the dense encoder's availability cube
+    # both skip it (the reference's Offering.Available)
+    available: bool = True
 
 
 @dataclass

@@ -77,6 +77,7 @@ class InterruptionController:
         termination=None,
         recorder: Optional[Recorder] = None,
         clock=None,
+        cloud_provider=None,
     ):
         from ...utils.clock import Clock
 
@@ -85,6 +86,9 @@ class InterruptionController:
         self.provisioner = provisioner  # ProvisionerController: the proactive solve
         self.queue = queue  # NotificationQueue or CloudAPIClient (duck-typed)
         self.termination = termination  # TerminationController: the drain handoff
+        # offering-health feed: providers exposing mark_offering_unavailable
+        # get the victim's pool quarantined BEFORE the proactive re-solve
+        self.cloud_provider = cloud_provider
         self.recorder = recorder or Recorder()
         self.clock = clock or (kube.clock if kube is not None else None) or Clock()
         self._lock = WITNESS.lock("interruption.controller")
@@ -170,6 +174,12 @@ class InterruptionController:
             node=node.name, action=action,
             deadline_remaining_s=round(msg.deadline - self.clock.now(), 3) if msg.deadline else None,
         ):
+            if msg.kind == "spot_interruption":
+                # the pool the cloud is reclaiming FROM is the worst
+                # candidate for the replacement: quarantine it in the
+                # unavailable-offerings cache before the proactive re-solve
+                # prices the replacement universe
+                self._mark_reclaimed_offering(node)
             self.recorder.node_interrupted(node, msg.kind, self._describe(msg))
             if action == ACTION_GARBAGE_COLLECT:
                 self._garbage_collect(node)
@@ -181,6 +191,27 @@ class InterruptionController:
         self.actions_performed.inc(action=action)
         self._mark_handled(received.message_id)
         self._delete(received)
+
+    def _mark_reclaimed_offering(self, node: Node) -> None:
+        """Quarantine the victim's (instance-type, zone, capacity-type)
+        pool: a spot pool the cloud is actively draining will reclaim a
+        fresh launch just as fast, so the replacement must route around it
+        until the unavailable-offering TTL expires. Providers without the
+        hook (the fake provider) no-op."""
+        mark = getattr(self.cloud_provider, "mark_offering_unavailable", None)
+        if mark is None:
+            return
+        labels = node.metadata.labels
+        type_name = labels.get(lbl.LABEL_INSTANCE_TYPE)
+        zone = labels.get(lbl.LABEL_TOPOLOGY_ZONE)
+        capacity_type = labels.get(lbl.LABEL_CAPACITY_TYPE)
+        if not (type_name and zone and capacity_type):
+            return  # an unlabeled fixture node carries no pool to quarantine
+        mark(type_name, zone, capacity_type)
+        log.info(
+            "quarantined reclaimed offering %s/%s/%s ahead of the replacement solve",
+            type_name, zone, capacity_type,
+        )
 
     @staticmethod
     def _describe(msg: InterruptionMessage) -> str:

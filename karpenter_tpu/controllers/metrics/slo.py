@@ -40,11 +40,21 @@ def ideal_node_price(virtual_node) -> float:
     zone_req = requirements.get(lbl.LABEL_TOPOLOGY_ZONE)
     best = None
     for it in virtual_node.instance_type_options:
-        allowed = [
-            o.price
+        restricted = [
+            o
             for o in it.offerings()
             if o.price is not None and ct_req.has(o.capacity_type) and zone_req.has(o.zone)
         ]
+        # only AVAILABLE offerings price the ideal: a quarantined pool is
+        # not launchable, and pricing it would report fake drift no
+        # consolidation can remove while the crunch lasts. When EVERY
+        # restriction-matching offering is quarantined, fall back to the
+        # restricted set ignoring availability — the template's capacity
+        # type still bounds the price (a spot-priced ideal for an
+        # on-demand-only provisioner would be the same fake-drift failure).
+        allowed = [o.price for o in restricted if o.available]
+        if not allowed:
+            allowed = [o.price for o in restricted]
         # offerings without explicit prices (the fake provider) fall back to
         # the type's headline price
         price = min(allowed) if allowed else it.price()

@@ -40,10 +40,16 @@ class Batcher:
             self._immediate = True
             self._cond.notify_all()
 
-    def wait(self, poll_interval: float = 0.05) -> bool:
-        """Block until a batch window completes; True if triggered."""
+    def wait(self, poll_interval: float = 0.05, deadline=None) -> bool:
+        """Block until a batch window completes; True if triggered. A
+        `deadline` (clock instant) bounds the idle wait: when it passes with
+        no trigger the call returns True anyway, so a caller holding parked
+        work (the provisioner's insufficient-capacity backoff) re-enters its
+        round without needing a fresh pod event to fire."""
         with self._cond:
             while not self._triggered:
+                if deadline is not None and self.clock.now() >= deadline:
+                    return True
                 self._cond.wait(timeout=poll_interval)
         window_start = self.clock.now()
         last_trigger = window_start

@@ -25,9 +25,10 @@ from ...config import Config
 from ...events import Recorder
 from ...kube.cluster import Conflict, KubeCluster
 from ...metrics import REGISTRY
+from ...cloudprovider.errors import InsufficientCapacityError
 from ...scheduler import SchedulerOptions, build_scheduler
 from ...scheduler.scheduler import SchedulingResults
-from ...tracing import DECISIONS, TRACER
+from ...tracing import DECISIONS, OUTCOME_FAILED, TRACER, DecisionRecord
 from ...utils import pod as podutils
 from ...utils import resources as res
 from ..state.cluster import Cluster
@@ -64,6 +65,7 @@ class ProvisionerController:
         remote_solver=None,
         wait_for_cluster_sync: bool = True,
         clock=None,
+        ice_backoff_seconds: Optional[float] = None,
     ):
         from ...utils.clock import Clock
 
@@ -89,7 +91,24 @@ class ProvisionerController:
             "Duration of controller reconcile passes",
             ("controller",),
         )
+        self.launch_failures = REGISTRY.counter(
+            "karpenter_provisioning_launch_failures_total",
+            "Node launches that failed, by failure class",
+            ("reason",),
+        )
         self.last_trace_id: Optional[str] = None  # trace of the latest round (tracing on)
+        # capacity-failure escalation: a pod parks here once every rung of
+        # the escalation ladder (next-cheapest offering -> next type ->
+        # re-solve) is exhausted; get_pods skips it until the instant passes
+        # so a total crunch cannot hot-loop the solver against the wall
+        self.ice_backoff_seconds = ice_backoff_seconds if ice_backoff_seconds is not None else self.ICE_BACKOFF_SECONDS
+        self._ice_backoff: Dict[tuple, float] = {}  # (namespace, name) -> retry-after instant
+        # liveness for unschedulable leftovers: a round that could not place
+        # every pod re-enters on this deadline even with no fresh pod event
+        # (the controller-runtime requeue-with-backoff analog) — without it,
+        # pods waiting out an offering quarantine would stall until an
+        # unrelated pod event happened to pull the batcher trigger
+        self._unschedulable_retry_at: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -105,13 +124,21 @@ class ProvisionerController:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self.batcher.wait()
+            # parked pods (capacity-failure backoff) bound the idle wait:
+            # their retry needs no fresh pod event to re-enter the round
+            self.batcher.wait(deadline=self._earliest_ice_retry())
             if self._stop.is_set():
                 return
             try:
                 self.provision()
             except Exception:  # noqa: BLE001 - the loop is self-healing
                 log.exception("provisioning round failed; next batch retries")
+
+    def _earliest_ice_retry(self) -> Optional[float]:
+        deadlines = list(self._ice_backoff.values())
+        if self._unschedulable_retry_at is not None:
+            deadlines.append(self._unschedulable_retry_at)
+        return min(deadlines) if deadlines else None
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -130,6 +157,16 @@ class ProvisionerController:
         self.last_results = results
         return results
 
+    # bounded capacity-failure escalation: after the initial launch, how
+    # many IMMEDIATE re-solves (with the failed pools excluded via the
+    # provider's unavailable-offerings cache) a round runs before parking
+    # the still-failing pods behind the backoff
+    ICE_RESOLVE_ATTEMPTS = 2
+    # how long a pod that exhausted the ladder sits out of get_pods: long
+    # enough not to hot-loop the solver into the wall, short enough to
+    # re-probe well within the unavailable-offering TTL
+    ICE_BACKOFF_SECONDS = 10.0
+
     def _provision_round(self, root):
         if self.wait_for_cluster_sync:
             deadline = self.clock.now() + 10.0
@@ -146,7 +183,33 @@ class ProvisionerController:
             sp.set(pods=len(pods), state_nodes=len(state_nodes))
         start = self.clock.now()
         results = self.schedule(pods, state_nodes)
-        launched = self.launch_nodes(results)
+        ice_failed: List[object] = []
+        launched = self.launch_nodes(results, ice_failures=ice_failed)
+        # fallback re-solve: a typed insufficient-capacity launch failure
+        # already fed the provider's negative offering cache, so an
+        # IMMEDIATE re-solve sees a universe with the exhausted pools
+        # masked and routes the affected pods to the next-cheapest offering
+        # or the next type — instead of leaving them pending a full batch
+        # cycle to retry into the same wall
+        any_unschedulable = bool(results.unschedulable)
+        for attempt in range(self.ICE_RESOLVE_ATTEMPTS):
+            if not ice_failed:
+                break
+            retry_pods = [p for vn in ice_failed for p in vn.pods]
+            with TRACER.span("ice-resolve", attempt=attempt + 1, pods=len(retry_pods)):
+                retry_results = self.schedule(retry_pods, self.cluster.nodes_snapshot())
+                any_unschedulable |= bool(retry_results.unschedulable)
+                ice_failed = []
+                launched += self.launch_nodes(retry_results, ice_failures=ice_failed)
+        if ice_failed:
+            self._park_ice_failures(ice_failed)
+        # requeue-with-backoff liveness: ANY pod left unschedulable this
+        # round — in the primary solve or a capacity re-solve whose universe
+        # was fully quarantined — re-enters on the deadline, with no fresh
+        # pod event needed
+        self._unschedulable_retry_at = (
+            self.clock.now() + self.ice_backoff_seconds if any_unschedulable else None
+        )
         root.set(
             pods=len(pods),
             launched=len(launched),
@@ -165,6 +228,37 @@ class ProvisionerController:
             )
         return results
 
+    def _park_ice_failures(self, failed_nodes) -> None:
+        """Terminal rung of the escalation ladder: every re-solve attempt
+        still hit insufficient capacity. Mark each pod unschedulable — an
+        event, a per-pod decision-log record naming the failure, and a
+        backoff that keeps the pod out of the next batches until the
+        unavailable-offering TTL has a chance to restore a pool."""
+        retry_at = self.clock.now() + self.ice_backoff_seconds
+        for vn in failed_nodes:
+            for pod in vn.pods:
+                self.recorder.pod_failed_to_schedule(
+                    pod, "insufficient capacity: every offering exhausted; backing off"
+                )
+                if TRACER.enabled:
+                    DECISIONS.record(
+                        DecisionRecord(
+                            pod=pod.metadata.name,
+                            outcome=OUTCOME_FAILED,
+                            provisioner=vn.provisioner_name,
+                            trace_id=TRACER.current_trace_id() or "",
+                            error="insufficient capacity: escalation exhausted (next-offering, next-type, re-solve)",
+                        )
+                    )
+                while len(self._ice_backoff) >= 4096:
+                    del self._ice_backoff[next(iter(self._ice_backoff))]
+                self._ice_backoff[(pod.namespace, pod.metadata.name)] = retry_at
+        log.warning(
+            "capacity-failure escalation exhausted for %d pod(s); backing off %.1fs",
+            sum(len(vn.pods) for vn in failed_nodes),
+            self.ice_backoff_seconds,
+        )
+
     def get_pods(self) -> List[Pod]:
         """Pending provisionable pods, PVC-validated, topology-injected.
 
@@ -173,10 +267,19 @@ class ProvisionerController:
         rounds (the pod stays pending if a round fails)."""
         import copy
 
+        now = self.clock.now()
         pods = []
+        seen_parkable = set()
         for pod in self.kube.list_pods():
             if not podutils.is_provisionable(pod):
                 continue
+            key = (pod.namespace, pod.metadata.name)
+            seen_parkable.add(key)
+            backoff = self._ice_backoff.get(key)
+            if backoff is not None:
+                if backoff > now:
+                    continue  # parked by the capacity-failure escalation
+                del self._ice_backoff[key]
             err = self.volume_topology.validate_persistent_volume_claims(pod)
             if err is not None:
                 self.recorder.pod_failed_to_schedule(pod, err)
@@ -187,6 +290,12 @@ class ProvisionerController:
                 pod = copy.deepcopy(pod)
                 self.volume_topology.inject(pod)
             pods.append(pod)
+        # sweep backoff entries whose pod is gone (deleted) or no longer
+        # provisionable (bound): a stale entry's past deadline would pin
+        # Batcher.wait's deadline in the past forever — a busy loop of
+        # empty provision rounds until process restart
+        for key in [k for k in self._ice_backoff if k not in seen_parkable]:
+            del self._ice_backoff[key]
         return pods
 
     def schedule(self, pods: Sequence[Pod], state_nodes: Sequence[object], opts: Optional[SchedulerOptions] = None) -> SchedulingResults:
@@ -259,13 +368,17 @@ class ProvisionerController:
     # workers == len(nodes)) — a cap keeps thread count sane at 10k scale
     LAUNCH_WORKERS = 50
 
-    def launch_nodes(self, results: SchedulingResults) -> List[str]:
+    def launch_nodes(self, results: SchedulingResults, ice_failures: Optional[List[object]] = None) -> List[str]:
+        """Launch the round's new nodes. `ice_failures` (caller-owned, so
+        concurrent callers — the interruption controller's replacement
+        launch — never share state) collects the virtual nodes whose launch
+        hit a typed InsufficientCapacityError: the fallback re-solve input."""
         with TRACER.span("launch") as sp:
-            launched = self._launch_nodes(results)
+            launched = self._launch_nodes(results, ice_failures)
             sp.set(nodes=len(launched))
         return launched
 
-    def _launch_nodes(self, results: SchedulingResults) -> List[str]:
+    def _launch_nodes(self, results: SchedulingResults, ice_failures: Optional[List[object]] = None) -> List[str]:
         provisioners = {p.name: p for p in self.kube.list_provisioners()}
         to_launch = [vn for vn in results.new_nodes if vn.pods]
 
@@ -304,12 +417,12 @@ class ProvisionerController:
         # launch-node spans under an explicitly captured context.
         parent_ctx = TRACER.current_context()
         if len(approved) <= 1:
-            names = [self._launch(vn, parent_ctx) for vn in approved]
+            names = [self._launch(vn, parent_ctx, ice_failures) for vn in approved]
         else:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=min(len(approved), self.LAUNCH_WORKERS)) as pool:
-                names = list(pool.map(lambda vn: self._launch(vn, parent_ctx), approved))
+                names = list(pool.map(lambda vn: self._launch(vn, parent_ctx, ice_failures), approved))
         launched = [n for n in names if n is not None]
         # nominate pods onto existing nodes they were scheduled against
         with TRACER.span("bind") as sp:
@@ -323,20 +436,34 @@ class ProvisionerController:
             sp.set(nominated=nominated)
         return launched
 
-    def _launch(self, virtual_node, parent_ctx=None) -> Optional[str]:
+    def _launch(self, virtual_node, parent_ctx=None, ice_failures: Optional[List[object]] = None) -> Optional[str]:
         with TRACER.span(
             "launch-node", parent=parent_ctx, provisioner=virtual_node.provisioner_name, pods=len(virtual_node.pods)
         ) as sp:
-            return self._launch_one(virtual_node, sp)
+            return self._launch_one(virtual_node, sp, ice_failures)
 
-    def _launch_one(self, virtual_node, sp) -> Optional[str]:
+    def _launch_one(self, virtual_node, sp, ice_failures: Optional[List[object]] = None) -> Optional[str]:
         try:
             node = self.cloud_provider.create(
                 NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
             )
+        except InsufficientCapacityError as e:
+            # typed capacity failure: the provider already quarantined the
+            # exhausted pools; hand the virtual node to the caller's
+            # fallback re-solve (list.append is atomic — pool workers share
+            # the caller's list safely)
+            log.warning("insufficient capacity for provisioner %s: %s", virtual_node.provisioner_name, e)
+            sp.set(error=str(e), insufficient_capacity=True)
+            self.launch_failures.inc(reason="insufficient_capacity")
+            if ice_failures is not None:
+                ice_failures.append(virtual_node)
+            for pod in virtual_node.pods:
+                self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
+            return None
         except Exception as e:  # noqa: BLE001 - capacity errors self-heal next batch
             log.warning("node launch failed for provisioner %s: %s", virtual_node.provisioner_name, e)
             sp.set(error=str(e))
+            self.launch_failures.inc(reason="other")
             for pod in virtual_node.pods:
                 self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
             return None

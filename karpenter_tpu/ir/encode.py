@@ -269,12 +269,13 @@ class DenseProblem:
     type_template: np.ndarray  # [T] int32: owning template index per column
     caps: np.ndarray  # [T, R] float64 (resources - system overhead, missing -> 0)
     prices: np.ndarray  # [T] float64
-    type_zone: np.ndarray  # [T, Z] bool
-    type_ct: np.ndarray  # [T, C] bool
+    avail: np.ndarray  # [T, Z, C] bool: available-offering cube (see CatalogEncoding.avail)
     compat: np.ndarray  # [G, T] bool (nonzero only inside the group's template segment)
     group_zone_allowed: np.ndarray  # [G, Z] bool
     group_ct_allowed: np.ndarray  # [G, C] bool
     daemon_overhead: np.ndarray  # [T, R] float64: daemonset overhead of each column's template
+    # quarantined offerings in this catalog (CatalogEncoding.masked_offerings)
+    masked_offerings: int = 0
     # pods that must take the exact host path
     host_pods: List[Pod] = field(default_factory=list)
 
@@ -323,8 +324,20 @@ class CatalogEncoding:
     ct_index: Dict[str, int]
     caps: np.ndarray  # [T, R]
     prices: np.ndarray  # [T]
-    type_zone: np.ndarray  # [T, Z]
-    type_ct: np.ndarray  # [T, C]
+    # the availability CUBE: avail[t, z, c] == an AVAILABLE offering of type
+    # t exists in (zone z, capacity-type c). Strictly finer than a
+    # per-axis type-zone x type-ct product (which would let a bucket pinned
+    # to (zone, ct) pick a type offering that pair only across two
+    # DIFFERENT offerings), and the carrier of offering-health: a pool
+    # quarantined by the unavailable-offerings cache is simply a zero here,
+    # so the device mask routes around it with no host loop (see
+    # dense._device_solve).
+    avail: np.ndarray  # [T, Z, C] bool
+    # offerings present in the universe but flagged available=False (the
+    # unavailable-offerings cache quarantine) — distinct from structural
+    # zeros (a type simply not offered in a pool); nonzero means offering
+    # health is actively constraining this catalog
+    masked_offerings: int
     empty_fit: np.ndarray  # [T] bool: overhead alone fits the type
     compat_cache: Dict[tuple, tuple] = field(default_factory=dict)
 
@@ -466,8 +479,8 @@ def encode_catalog(
     T = len(type_list)
     caps = np.zeros((T, R), dtype=np.float64)
     prices = np.zeros((T,), dtype=np.float64)
-    type_zone = np.zeros((T, len(zone_list)), dtype=bool)
-    type_ct = np.zeros((T, len(ct_list)), dtype=bool)
+    avail = np.zeros((T, len(zone_list), len(ct_list)), dtype=bool)
+    masked_offerings = 0
     for t, it in enumerate(type_list):
         cap_vec = resource_vector(it.resources())
         over_vec = resource_vector(it.overhead())
@@ -477,9 +490,13 @@ def encode_catalog(
         caps[t] = np.maximum(cap_vec - over_vec, 0.0)
         prices[t] = it.price()
         for offering in it.offerings():
-            type_zone[t, zone_index[offering.zone]] = True
-            type_ct[t, ct_index[offering.capacity_type]] = True
-
+            # quarantined offerings (unavailable-offerings cache) stay in
+            # the zone/ct axes (domains stable) but are zeros in the cube —
+            # never a selectable (type, zone, ct) cell
+            if getattr(offering, "available", True):
+                avail[t, zone_index[offering.zone], ct_index[offering.capacity_type]] = True
+            else:
+                masked_offerings += 1
     empty_fit = np.array([res.fits(it.overhead(), it.resources()) for it in type_list], dtype=bool)
     return CatalogEncoding(
         key=catalog_key(templates, instance_types, zones, capacity_types),
@@ -493,8 +510,8 @@ def encode_catalog(
         ct_index=ct_index,
         caps=caps,
         prices=prices,
-        type_zone=type_zone,
-        type_ct=type_ct,
+        avail=avail,
+        masked_offerings=masked_offerings,
         empty_fit=empty_fit,
     )
 
@@ -549,8 +566,6 @@ def encode_problem(
     T = len(type_list)
     caps = catalog.caps
     prices = catalog.prices
-    type_zone = catalog.type_zone
-    type_ct = catalog.type_ct
 
     # daemonset overhead per type column = its template's overhead
     overhead_by_template: List[np.ndarray] = []
@@ -722,8 +737,8 @@ def encode_problem(
         type_template=np.asarray(type_template_ids, dtype=np.int32),
         caps=caps,
         prices=prices,
-        type_zone=type_zone,
-        type_ct=type_ct,
+        avail=catalog.avail,
+        masked_offerings=catalog.masked_offerings,
         compat=compat,
         group_zone_allowed=group_zone_allowed,
         group_ct_allowed=group_ct_allowed,

@@ -115,6 +115,7 @@ class Runtime:
             self.kube, self.cluster, self.cloud_provider, config=self.config,
             recorder=self.recorder, dense_solver=self.dense_solver,
             remote_solver=remote_solver, clock=self.kube.clock,
+            ice_backoff_seconds=self.options.ice_backoff_seconds,
         )
         self.reconciler = ProvisioningReconciler(self.kube, self.provisioner)
         self.node_controller = NodeController(
@@ -170,6 +171,10 @@ class Runtime:
                 self.interruption = InterruptionController(
                     self.kube, self.cluster, self.provisioner, source,
                     termination=self.termination, recorder=self.recorder, clock=self.kube.clock,
+                    # offering-health feed: a reclaimed spot pool is
+                    # quarantined before the proactive replacement solve
+                    # (the metrics decorator forwards the provider hook)
+                    cloud_provider=self.cloud_provider,
                 )
         self.pod_metrics = PodMetricsController(self.kube)
         self.provisioner_metrics = ProvisionerMetricsController(self.kube)

@@ -11,6 +11,7 @@ from .primitives import (
     Burst,
     DiurnalRamp,
     DriftRollout,
+    PoolCapacity,
     Primitive,
     ProcessCrash,
     ScaleTo,
@@ -30,6 +31,7 @@ __all__ = [
     "Burst",
     "DiurnalRamp",
     "DriftRollout",
+    "PoolCapacity",
     "Primitive",
     "ProcessCrash",
     "ScaleTo",

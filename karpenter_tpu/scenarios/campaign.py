@@ -41,7 +41,17 @@ from ..logsetup import get_logger
 from ..provenance import provenance_block
 from ..runtime import Runtime
 from ..utils.options import Options
-from .primitives import Burst, DiurnalRamp, DriftRollout, ProcessCrash, Scenario, ScenarioContext, SpotReclaimWave, TransportChaos
+from .primitives import (
+    Burst,
+    DiurnalRamp,
+    DriftRollout,
+    PoolCapacity,
+    ProcessCrash,
+    Scenario,
+    ScenarioContext,
+    SpotReclaimWave,
+    TransportChaos,
+)
 from .schema import scenario_doc_errors
 from .standin import WorkloadStandIn, live_pods
 
@@ -121,6 +131,87 @@ def consolidated_settled(ctx: ScenarioContext) -> bool:
     return ratio is not None and ratio <= 1.5
 
 
+def _node_pool(node) -> tuple:
+    labels = node.metadata.labels
+    return (
+        labels.get(lbl.LABEL_INSTANCE_TYPE),
+        labels.get(lbl.LABEL_TOPOLOGY_ZONE),
+        labels.get(lbl.LABEL_CAPACITY_TYPE),
+    )
+
+
+def capacity_recovered(ctx: ScenarioContext) -> bool:
+    """The capacity-crunch convergence bar: the quarantine has fully
+    expired (no offering is still marked unavailable) AND the newest owned
+    node launched in the CHEAPEST (type, zone, capacity-type) pool — proof
+    the exhausted pool was re-selected once its TTL lapsed, not permanently
+    abandoned for the pricier fallback."""
+    provider = ctx.runtime.cloud_provider  # metrics decorator forwards .unavailable
+    if getattr(provider, "unavailable", None) is not None and provider.unavailable.snapshot():
+        return False
+    nodes = [
+        n
+        for n in ctx.kube.list_nodes()
+        if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) and n.metadata.deletion_timestamp is None
+    ]
+    if not nodes:
+        return False
+    od_books, spot_books = ctx.backend.describe_prices()
+
+    def pool_price(pool: tuple) -> float:
+        type_name, zone, ct = pool
+        if ct == lbl.CAPACITY_TYPE_SPOT:
+            return spot_books.get((type_name, zone), float("inf"))
+        return od_books.get(type_name, float("inf"))
+
+    newest = max(nodes, key=lambda n: n.metadata.creation_timestamp)
+    # cheapest pool the fleet's type(s) could launch in (spot + od books)
+    types = {n.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE) for n in nodes}
+    candidates = [(t, z, ct) for t in types for (t2, z) in spot_books if t2 == t for ct in (lbl.CAPACITY_TYPE_SPOT,)]
+    candidates += [(t, s.zone, lbl.CAPACITY_TYPE_ON_DEMAND) for t in types for s in ctx.backend.subnets]
+    cheapest = min(candidates, key=pool_price)
+    return pool_price(_node_pool(newest)) <= pool_price(cheapest) + 1e-9
+
+
+def avoids_unavailable_pools(ctx: ScenarioContext) -> bool:
+    """The spot-collapse convergence bar: every node launched AFTER the
+    reclaim wave avoids the quarantined pools (a pre-wave survivor may
+    legitimately keep running inside one), and at least one such
+    replacement exists. The offering TTL outlives the scenario, so
+    convergence cannot ride a quarantine expiry."""
+    if ctx.reclaim_started_at is None:
+        return False  # the wave has not fired yet
+    provider = ctx.runtime.cloud_provider
+    unavailable = getattr(provider, "unavailable", None)
+    quarantined = unavailable.snapshot() if unavailable is not None else set()
+    if not quarantined:
+        return False  # the interruption feed never marked the reclaimed pools
+    replacements = [
+        n for n in ctx.kube.list_nodes() if n.metadata.creation_timestamp > ctx.reclaim_started_at
+    ]
+    if not replacements:
+        return False
+    return all(_node_pool(n) not in quarantined for n in replacements)
+
+
+def _unschedulable_pod_seconds(samples: List[dict]) -> float:
+    """Integral of pending pods over the sample timeline (pod-seconds):
+    the user-visible cost of a capacity crunch even when nothing is lost."""
+    total = 0.0
+    for prev, cur in zip(samples, samples[1:]):
+        total += prev["pending_pods"] * max(0.0, cur["t"] - prev["t"])
+    return round(total, 3)
+
+
+def _launch_failures_total() -> int:
+    """Process-wide launch-failure counter sum (all reasons); run_one
+    snapshots it at start and scores the delta."""
+    from ..metrics import REGISTRY
+
+    counter = REGISTRY.get("karpenter_provisioning_launch_failures_total")
+    return int(sum(counter.values().values())) if counter is not None else 0
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -181,6 +272,10 @@ class CampaignRunner:
             service = CloudAPIService(backend=backend).start()
             cloud = CloudAPIClient(service.url)
         provider = SimulatedCloudProvider(backend=cloud, kube=kube, clock=kube.clock)
+        if scenario.offering_ttl is not None:
+            # crunch scenarios need the quarantine to expire (or outlive the
+            # run) on the SCENARIO's timescale, not the production default
+            provider.unavailable.ttl = scenario.offering_ttl
 
         def runtime_factory() -> Runtime:
             # each (re)boot is a FRESH control plane over the same cluster +
@@ -201,6 +296,9 @@ class CampaignRunner:
                     enable_slo=True,
                     gc_interval=1.0,
                     gc_registration_grace=3.0,
+                    # scenario timescales are seconds: a parked pod must
+                    # re-probe within the run, not 10s later
+                    ice_backoff_seconds=1.5,
                 ),
             )
 
@@ -216,6 +314,7 @@ class CampaignRunner:
         )
         samples: List[dict] = []
         violations = 0
+        launch_failures_at_start = _launch_failures_total()
         start = time.monotonic()
         try:
             runtime.start()
@@ -270,6 +369,8 @@ class CampaignRunner:
                     "nodes_churned": snapshot["churn"]["nodes_churned"],
                     "pods_displaced": snapshot["churn"]["pods_displaced"],
                     "restarts": ctx.restarts,
+                    "launch_failures": _launch_failures_total() - launch_failures_at_start,
+                    "unschedulable_pod_seconds": _unschedulable_pod_seconds(samples),
                 },
                 "samples": samples,
             }
@@ -436,6 +537,51 @@ def default_campaign() -> List[Scenario]:
                 "burst + reclaim wave + drift rollout with the control plane kill -9'd three times "
                 "mid-provision/mid-disruption: startup reconstruction + the GC sweep must converge to "
                 "zero leaked instances, zero lost pods, budgets intact"
+            ),
+        ),
+        Scenario(
+            name="capacity_crunch",
+            desired=0,
+            duration=10.0,
+            instance_types=["general-4x8"],
+            offering_ttl=2.0,
+            settled=capacity_recovered,
+            primitives=[
+                # phase 1 — the cheapest pool (zone-c spot) holds ONE more
+                # launch: the burst exhausts it mid-flight, the fleet items
+                # fall through to next-cheapest spot zones (partial
+                # fulfillment) and the skipped pool quarantines
+                PoolCapacity(offset=0.0, instance_type="general-4x8", zones=["zone-c"], capacity_types=["spot"], capacity=1),
+                Burst(offset=0.4, count=26),
+                # phase 2 — the TOTAL wall: every pool of the only allowed
+                # type is exhausted, so the next burst's launches fail with
+                # typed ICEs, the bounded re-solve escalates to
+                # pod-unschedulable (events + decision records + backoff)
+                PoolCapacity(offset=2.6, instance_type="general-4x8", capacity=0),
+                Burst(offset=3.0, count=7),
+                # phase 3 — capacity returns everywhere; parked pods
+                # re-probe on their backoff, quarantines expire, and the
+                # last launches land back in the cheapest pool
+                PoolCapacity(offset=5.0, instance_type="general-4x8", capacity=None),
+            ],
+            description=(
+                "the cheapest pool exhausts mid-burst (fallback to next-cheapest offering), then "
+                "every pool walls off (typed ICE -> bounded re-solve -> unschedulable + backoff): "
+                "nothing is lost, and the exhausted pool is re-selected after its TTL expires"
+            ),
+        ),
+        Scenario(
+            name="spot_collapse",
+            desired=21,
+            duration=9.0,
+            instance_types=["general-4x8"],
+            offering_ttl=300.0,  # outlives the run: convergence cannot ride an expiry
+            settled=avoids_unavailable_pools,
+            primitives=[SpotReclaimWave(offset=3.0, fraction=0.7, warning_seconds=1.5)],
+            description=(
+                "correlated spot loss with the reclaimed pools quarantined by the interruption "
+                "controller: every replacement must route AROUND the collapsing pools (other-zone "
+                "spot or on-demand), never back into them"
             ),
         ),
         Scenario(

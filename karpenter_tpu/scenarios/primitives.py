@@ -41,6 +41,10 @@ class ScenarioContext:
         # same kube + cloud — what the ProcessCrash primitive restarts into
         self.runtime_factory = runtime_factory
         self.restarts = 0
+        # stamped by SpotReclaimWave: kube-clock instant the wave fired, so
+        # predicates can scope assertions to REPLACEMENT nodes (a survivor
+        # legitimately keeps running inside a quarantined pool)
+        self.reclaim_started_at: Optional[float] = None
         self.stop = threading.Event()
         self._lock = threading.Lock()
         self._desired = 0
@@ -156,6 +160,33 @@ class DiurnalRamp(Primitive):
 
 
 @dataclass
+class PoolCapacity(Primitive):
+    """Give every (zone x capacity-type) pool of `instance_type` FINITE
+    remaining capacity (`capacity` launches each; 0 = exhausted now), or
+    restore them to infinite with capacity=None — the capacity-crunch seam.
+    `capacity_types`/`zones` narrow the affected pools (e.g. collapse only
+    the spot side of a type)."""
+
+    instance_type: str = ""
+    capacity: Optional[int] = None
+    zones: Optional[List[str]] = None  # default: every backend zone
+    capacity_types: Optional[List[str]] = None  # default: spot + on-demand
+
+    def run(self, ctx: ScenarioContext) -> None:
+        zones = self.zones or [s.zone for s in ctx.backend.subnets]
+        capacity_types = self.capacity_types or ["spot", "on-demand"]
+        for zone in zones:
+            for ct in capacity_types:
+                ctx.backend.set_pool_capacity(self.instance_type, zone, ct, self.capacity)
+        log.info(
+            "pool capacity: %s -> %s across %d pool(s)",
+            self.instance_type,
+            "infinite" if self.capacity is None else self.capacity,
+            len(zones) * len(capacity_types),
+        )
+
+
+@dataclass
 class SpotReclaimWave(Primitive):
     """Interrupt a fraction of populated nodes at once with a short reclaim
     window — the correlated spot-capacity loss shape. The campaign's
@@ -170,6 +201,7 @@ class SpotReclaimWave(Primitive):
         victims = populated[: max(1, min(self.max_victims, int(len(populated) * self.fraction)))]
         ids = [n.spec.provider_id.split("///", 1)[-1] for n in victims]
         log.info("spot reclaim wave: interrupting %d/%d nodes", len(ids), len(populated))
+        ctx.reclaim_started_at = ctx.kube.clock.now()
         for instance_id in ids:
             ctx.backend.interrupt_spot_instance(instance_id, warning_seconds=self.warning_seconds)
 
@@ -254,6 +286,11 @@ class Scenario:
     # ttlSecondsAfterEmpty — set that to None when enabling this): the
     # consolidation-on diurnal variant pins the post-ramp cost drift
     consolidation: bool = False
+    # override for the provider's unavailable-offerings TTL: the
+    # capacity-crunch scenarios need the quarantine to expire (and the
+    # exhausted pool to be re-selected) INSIDE the scenario window, or —
+    # for the spot-collapse variant — to outlive it
+    offering_ttl: Optional[float] = None
     # extra convergence condition beyond "every pod bound to live capacity"
     # (e.g. the drift scenario waits until no node carries a stale spec
     # hash); not part of the config hash — predicates describe WHEN the run
@@ -273,5 +310,6 @@ class Scenario:
             "instance_types": self.instance_types,
             "ttl_seconds_after_empty": self.ttl_seconds_after_empty,
             "consolidation": self.consolidation,
+            "offering_ttl": self.offering_ttl,
             "primitives": [p.config() for p in self.primitives],
         }

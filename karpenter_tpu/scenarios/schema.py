@@ -28,6 +28,12 @@ SCORE_KEYS = (
     "pods_bound",
     "nodes_churned",
     "restarts",
+    # capacity-failure scores: node launches that failed during the run
+    # (insufficient capacity + other), and the integral of pending pods over
+    # the sample timeline — how much pod-time the cluster spent unable to
+    # place work (a crunch's user-visible cost even when nothing is lost)
+    "launch_failures",
+    "unschedulable_pod_seconds",
 )
 QUANTILE_KEYS = ("p50", "p95", "p99", "count")
 SAMPLE_KEYS = ("t", "pending_pods", "nodes", "cost_per_hour", "disrupting")
@@ -61,10 +67,13 @@ def run_errors(run, where: str = "run") -> List[str]:
         for key in SCORE_KEYS:
             if key not in scores:
                 errs.append(f"{where}.scores missing key {key!r}")
-        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts"):
+        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures"):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
                 errs.append(f"{where}.scores.{field} must be an int, got {type(value).__name__}")
+        ups = scores.get("unschedulable_pod_seconds")
+        if ups is not None and (not isinstance(ups, (int, float)) or isinstance(ups, bool) or ups < 0):
+            errs.append(f"{where}.scores.unschedulable_pod_seconds must be a non-negative number")
         errs.extend(_quantile_errors(scores.get("pending_latency_seconds", {}), f"{where}.scores.pending_latency_seconds"))
     elif scores is not None:
         errs.append(f"{where}.scores must be a dict")

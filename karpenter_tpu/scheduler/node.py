@@ -392,6 +392,8 @@ def type_fits(it: InstanceType, requests: Dict[str, float]) -> bool:
 
 def type_has_offering(it: InstanceType, requirements: Requirements) -> bool:
     for offering in it.offerings():
+        if not offering.available:
+            continue  # quarantined pool (unavailable-offerings cache): never selectable
         if (not requirements.has(lbl.LABEL_TOPOLOGY_ZONE) or requirements.get(lbl.LABEL_TOPOLOGY_ZONE).has(offering.zone)) and (
             not requirements.has(lbl.LABEL_CAPACITY_TYPE) or requirements.get(lbl.LABEL_CAPACITY_TYPE).has(offering.capacity_type)
         ):

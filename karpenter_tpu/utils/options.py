@@ -82,6 +82,12 @@ class Options:
     # how long a launched instance may exist unregistered before the sweep
     # treats it as an orphan (the legitimate launch->register window)
     gc_registration_grace: float = 30.0
+    # capacity-failure escalation (controllers/provisioning): how long a pod
+    # whose every launch/re-solve attempt hit insufficient capacity sits out
+    # of the batch before re-probing — below the unavailable-offering TTL so
+    # recovery is noticed, above the batch window so a total crunch cannot
+    # hot-loop the solver into the wall
+    ice_backoff_seconds: float = 10.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -99,6 +105,8 @@ class Options:
             errs.append("interruption poll interval must be positive")
         if self.gc_registration_grace < 0:
             errs.append("gc registration grace must be non-negative")
+        if self.ice_backoff_seconds <= 0:
+            errs.append("ice backoff must be positive")
         if self.trace_ring_size <= 0:
             errs.append("trace ring size must be positive")
         from ..logsetup import is_valid_level
@@ -144,6 +152,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--pricing-refresh-period", type=float, default=_env("PRICING_REFRESH_PERIOD", defaults.pricing_refresh_period))
     parser.add_argument("--interruption-queue", dest="interruption_queue", default=_env("INTERRUPTION_QUEUE", defaults.interruption_queue))
     parser.add_argument("--interruption-poll-interval", type=float, default=_env("INTERRUPTION_POLL_INTERVAL", defaults.interruption_poll_interval))
+    parser.add_argument("--ice-backoff-seconds", type=float, default=_env("ICE_BACKOFF_SECONDS", defaults.ice_backoff_seconds))
     parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     parser.add_argument("--gc-interval", type=float, default=_env("GC_INTERVAL", defaults.gc_interval))

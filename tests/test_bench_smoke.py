@@ -26,7 +26,7 @@ def test_bench_smoke():
     assert len(provenance["config_hash"]) == 16
     # every config ran and reported its structural counters
     queue_attrs = summary.pop("interruption_queue")
-    assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od"}
+    assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name
         # the per-pod fill routing counters are part of the schema
@@ -43,6 +43,14 @@ def test_bench_smoke():
     # the repack shape exercised the vectorized warm fill specifically
     assert summary["repack"]["fills_vectorized"] >= 1
     assert summary["repack"]["fill_pods_vectorized"] >= 1
+    # offering-health gate: the ice_mask shape ran with quarantined
+    # offerings, the availability mask engaged, and its application is a
+    # device-side phase (a 'mask' child under the device span) — every pod
+    # still scheduled (asserted inside smoke), never onto a masked offering
+    assert summary["ice_mask"]["masked_offerings"] > 0
+    assert summary["ice_mask"]["mask_seconds"] > 0
+    device = next(c for c in summary["ice_mask"]["span_tree"]["children"] if c["name"] == "device")
+    assert "mask" in {c["name"] for c in device.get("children", ())}
     # the interruption-queue counters are part of the smoke JSON schema
     assert {"depth", "in_flight", "dead_letter_depth", "sent_total", "deleted_total", "redelivered_total"} <= set(
         queue_attrs

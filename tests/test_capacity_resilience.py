@@ -1,0 +1,558 @@
+"""Capacity-failure resilience: ICE taxonomy, finite pools, partial fleet
+fulfillment, the unavailable-offerings cache, and the provisioner's fallback
+re-solve / escalation ladder.
+
+The end-to-end story under test (docs/resilience.md §5): the cloud runs out
+of a (type, zone, capacity-type) pool mid-burst; launches surface typed
+per-item results instead of all-or-nothing failures; the exhausted pools
+quarantine in the TTL'd negative cache; the scheduler's universe, the dense
+solver's availability mask, and the SLO ideal repack all route around them;
+an IMMEDIATE re-solve places the affected pods on the next-cheapest
+offering/type; a total wall escalates to pod-unschedulable with events,
+decision records, and a bounded backoff; and a TTL expiry restores the
+cheap pool.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.api.objects import NodeSelectorRequirement, OP_IN
+from karpenter_tpu.cloudprovider.errors import InsufficientCapacityError
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_type
+from karpenter_tpu.cloudprovider.offerings import UnavailableOfferings
+from karpenter_tpu.cloudprovider.simulated import CloudBackend, SimulatedCloudProvider
+from karpenter_tpu.cloudprovider.simulated.backend import FleetInstanceSpec, FleetRequest
+from karpenter_tpu.cloudprovider.simulated.fleet import CreateFleetBatcher
+from karpenter_tpu.cloudprovider.types import NodeRequest, Offering
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.runtime import Runtime
+from karpenter_tpu.scheduling.nodetemplate import NodeTemplate
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.options import Options
+from tests.helpers import make_pod, make_provisioner
+
+
+def _spec(backend, type_name=None, zone="zone-a", ct="on-demand"):
+    lt = backend.ensure_launch_template("lt-cap", "img-1", ["sg-1"], "")
+    return FleetInstanceSpec(
+        instance_type=type_name or backend.catalog[0].name,
+        zone=zone,
+        capacity_type=ct,
+        launch_template_id=lt.template_id,
+        subnet_id=f"subnet-{zone}",
+    )
+
+
+class TestFinitePools:
+    def test_pool_drains_and_partial_result_carries_typed_errors(self):
+        backend = CloudBackend(clock=FakeClock())
+        spec = _spec(backend)
+        pool = (spec.instance_type, spec.zone, spec.capacity_type)
+        backend.set_pool_capacity(*pool, 2)
+        result = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", count=5))
+        assert len(result.instances) == 2
+        assert len(result.errors) == 3, "one typed error per unfulfilled item"
+        assert all(isinstance(e, InsufficientCapacityError) for e in result.errors)
+        assert all(pool in e.pools for e in result.errors)
+        assert result.unavailable_pools == [pool]
+        assert backend.pool_capacity(*pool) == 0
+
+    def test_exhausted_pool_raises_typed_error_when_nothing_launches(self):
+        backend = CloudBackend(clock=FakeClock())
+        spec = _spec(backend)
+        backend.set_pool_capacity(spec.instance_type, spec.zone, spec.capacity_type, 0)
+        with pytest.raises(InsufficientCapacityError) as err:
+            backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand"))
+        assert (spec.instance_type, spec.zone, spec.capacity_type) in err.value.pools
+
+    def test_terminate_credits_the_pool_back(self):
+        backend = CloudBackend(clock=FakeClock())
+        spec = _spec(backend)
+        pool = (spec.instance_type, spec.zone, spec.capacity_type)
+        backend.set_pool_capacity(*pool, 1)
+        result = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand"))
+        assert backend.pool_capacity(*pool) == 0
+        backend.terminate_instance(result.instance.instance_id)
+        assert backend.pool_capacity(*pool) == 1, "terminating frees the slot (real clouds regain capacity)"
+        # and the pool is launchable again
+        again = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand"))
+        assert len(again.instances) == 1
+
+    def test_launch_falls_through_to_next_cheapest_and_reports_skipped_pools(self):
+        backend = CloudBackend(clock=FakeClock())
+        cheap = _spec(backend, zone="zone-a")
+        pricier = _spec(backend, zone="zone-b")
+        od = backend.get_on_demand_price(cheap.instance_type)
+        assert od is not None  # same type, same od price: order by spot below
+        cheap.capacity_type = "spot"
+        pricier.capacity_type = "spot"
+        prices = {
+            z: backend.get_spot_price(cheap.instance_type, z) for z in ("zone-a", "zone-b")
+        }
+        cheap_zone = min(prices, key=prices.get)
+        other_zone = "zone-b" if cheap_zone == "zone-a" else "zone-a"
+        cheap.zone, pricier.zone = cheap_zone, other_zone
+        backend.set_pool_capacity(cheap.instance_type, cheap_zone, "spot", 0)
+        result = backend.create_fleet(FleetRequest(specs=[cheap, pricier], capacity_type="spot"))
+        assert result.instance.zone == other_zone, "launch fell through to the next-cheapest pool"
+        assert (cheap.instance_type, cheap_zone, "spot") in result.unavailable_pools
+
+
+class TestBatcherPartialFulfillment:
+    def test_waiter_whose_item_iced_gets_its_own_typed_error(self):
+        """Satellite: a waiter whose fleet item hit insufficient capacity
+        receives the typed error — not the leader's exception, not a silent
+        None — while siblings whose items launched get their instances."""
+        backend = CloudBackend(clock=FakeClock())
+        spec = _spec(backend)
+        pool = (spec.instance_type, spec.zone, spec.capacity_type)
+        backend.set_pool_capacity(*pool, 2)
+        batcher = CreateFleetBatcher(backend, window=0.05)
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(batcher.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand")))
+            except InsufficientCapacityError as e:
+                errors.append(e)
+            except Exception as e:  # noqa: BLE001
+                errors.append(("WRONG", e))
+
+        threads = [threading.Thread(target=call) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 2 and len({r.instance_id for r in results}) == 2
+        assert len(errors) == 2
+        assert all(isinstance(e, InsufficientCapacityError) for e in errors), errors
+        assert all(pool in e.pools for e in errors)
+
+    def test_failed_item_token_replay_does_not_resurrect_it(self):
+        """Satellite: the backend records settled launches per token; a
+        token whose call FAILED is never recorded, so replaying it after
+        capacity returns launches fresh — and a replayed SUCCESS token never
+        hands back someone else's instance."""
+        backend = CloudBackend(clock=FakeClock())
+        spec = _spec(backend)
+        pool = (spec.instance_type, spec.zone, spec.capacity_type)
+        backend.set_pool_capacity(*pool, 1)
+        ok = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", client_token="tok-ok"))
+        with pytest.raises(InsufficientCapacityError):
+            backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", client_token="tok-failed"))
+        assert "tok-failed" not in backend.fleet_tokens, "a failed item must not settle its token"
+        # capacity returns: the failed token retries as a FRESH launch
+        backend.set_pool_capacity(*pool, 1)
+        retried = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", client_token="tok-failed"))
+        assert retried.instance.instance_id != ok.instance.instance_id
+        # while the settled token still replays its original instance
+        replay = backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", client_token="tok-ok"))
+        assert replay.instance.instance_id == ok.instance.instance_id
+
+    def test_batcher_reports_skipped_pools_even_on_success(self):
+        backend = CloudBackend(clock=FakeClock())
+        observed = []
+        spec_a = _spec(backend, zone="zone-a")
+        spec_b = _spec(backend, zone="zone-b")
+        backend.set_pool_capacity(spec_a.instance_type, "zone-a", "on-demand", 0)
+        batcher = CreateFleetBatcher(backend, window=0.0, on_unavailable=observed.append)
+        # same od price both zones: force zone-a first by exhausting it and
+        # letting the launch fall through — success must STILL report it
+        batcher.create_fleet(FleetRequest(specs=[spec_a, spec_b], capacity_type="on-demand"))
+        assert any((spec_a.instance_type, "zone-a", "on-demand") in pools for pools in observed)
+
+
+class TestUnavailableOfferings:
+    def test_ttl_expiry_and_version_bumps(self):
+        clock = FakeClock()
+        cache = UnavailableOfferings(clock, ttl=10.0)
+        v0 = cache.version()
+        cache.mark_unavailable("t", "z", "spot")
+        assert cache.is_unavailable("t", "z", "spot")
+        v1 = cache.version()
+        assert v1 > v0
+        # re-marking an active quarantine refreshes silently (no rebuild storm)
+        cache.mark_unavailable("t", "z", "spot")
+        assert cache.version() == v1
+        clock.step(11.0)
+        assert not cache.is_unavailable("t", "z", "spot")
+        assert cache.version() > v1, "expiry is a visible availability change"
+        assert cache.snapshot() == set()
+
+    def test_snapshot_prunes_expired(self):
+        clock = FakeClock()
+        cache = UnavailableOfferings(clock, ttl=5.0)
+        cache.mark_unavailable("a", "z1", "spot")
+        cache.mark_unavailable("b", "z2", "on-demand", ttl=100.0)
+        clock.step(6.0)
+        assert cache.snapshot() == {("b", "z2", "on-demand")}
+
+    def test_catalog_rebuilds_on_mark_and_on_expiry_without_invalidate(self):
+        clock = FakeClock()
+        backend = CloudBackend(clock=clock)
+        provider = SimulatedCloudProvider(backend=backend, kube=KubeCluster(clock=clock), clock=clock)
+        provisioner = make_provisioner()
+        name = backend.catalog[0].name
+        before = {it.name(): it for it in provider.get_instance_types(provisioner)}
+        assert all(o.available for o in before[name].offerings())
+        provider.unavailable.mark_unavailable(name, "zone-a", "spot")
+        flagged = {it.name(): it for it in provider.get_instance_types(provisioner)}
+        assert any(
+            not o.available and o.zone == "zone-a" and o.capacity_type == "spot"
+            for o in flagged[name].offerings()
+        ), "a mark rebuilds the universe with the pool flagged (no explicit invalidate)"
+        # requirements derive from AVAILABLE offerings: the flagged pool's
+        # zone survives only because on-demand is still live there
+        clock.step(provider.unavailable.ttl + 1)
+        restored = {it.name(): it for it in provider.get_instance_types(provisioner)}
+        assert all(o.available for o in restored[name].offerings()), "TTL expiry restores the pool lazily"
+
+
+class TestFakeProviderTaxonomy:
+    def _request(self, types):
+        provisioner = make_provisioner()
+        return NodeRequest(
+            template=NodeTemplate.from_provisioner(provisioner),
+            instance_type_options=list(types),
+        )
+
+    def test_strict_mode_raises_typed_error_on_first_exhausted_pool(self):
+        it = instance_type("only", cpu=4, memory="8Gi")
+        provider = FakeCloudProvider([it])
+        pool = ("only", "test-zone-1", "spot")
+        provider.insufficient_capacity_pools.add(pool)
+        with pytest.raises(InsufficientCapacityError) as err:
+            provider.create(self._request([it]))
+        assert pool in err.value.pools
+
+    def test_allow_mode_skips_exhausted_pools_like_the_simulated_backend(self):
+        it = instance_type("only", cpu=4, memory="8Gi")
+        provider = FakeCloudProvider([it])
+        provider.allow_insufficient_capacity = True
+        provider.insufficient_capacity_pools.add(("only", "test-zone-1", "spot"))
+        node = provider.create(self._request([it]))
+        # fell through to the next offering, same skip discipline as
+        # CloudBackend.create_fleet
+        assert (
+            node.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE],
+            node.metadata.labels[lbl.LABEL_CAPACITY_TYPE],
+        ) != ("test-zone-1", "spot")
+
+    def test_allow_mode_raises_typed_error_with_all_pools_when_everything_exhausted(self):
+        it = instance_type("only", cpu=4, memory="8Gi")
+        provider = FakeCloudProvider([it])
+        provider.allow_insufficient_capacity = True
+        for offering in it.offerings():
+            provider.insufficient_capacity_pools.add(("only", offering.zone, offering.capacity_type))
+        with pytest.raises(InsufficientCapacityError) as err:
+            provider.create(self._request([it]))
+        assert len(err.value.pools) == len(it.offerings())
+
+    def test_unavailable_offering_flag_is_skipped(self):
+        offerings = [
+            Offering(capacity_type="on-demand", zone="test-zone-1", available=False),
+            Offering(capacity_type="on-demand", zone="test-zone-2"),
+        ]
+        it = instance_type("flagged", cpu=4, memory="8Gi", offerings=offerings)
+        provider = FakeCloudProvider([it])
+        provider.allow_insufficient_capacity = True
+        node = provider.create(self._request([it]))
+        assert node.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE] == "test-zone-2"
+
+
+class CrunchEnv:
+    """Live Runtime over the simulated cloud with finite pools — the
+    deterministic (FakeClock, provision_once-driven) half of the
+    capacity_crunch scenario."""
+
+    def __init__(self, transport: str = "inprocess", instance_types=("general-2x4", "general-4x8")):
+        self.clock = FakeClock()
+        self.kube = KubeCluster(clock=self.clock)
+        self.backend = CloudBackend(clock=self.clock)
+        self.service = None
+        cloud = self.backend
+        if transport == "http":
+            from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+            self.service = CloudAPIService(backend=self.backend).start()
+            cloud = CloudAPIClient(self.service.url, clock=self.clock)
+        self.provider = SimulatedCloudProvider(backend=cloud, kube=self.kube, clock=self.clock)
+        self.runtime = Runtime(
+            kube=self.kube,
+            cloud_provider=self.provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False, enable_tracing=True),
+        )
+        requirements = [
+            # both capacity types: the provider's defaulting hook would
+            # otherwise pin on-demand and keep every spot pool out of play
+            NodeSelectorRequirement(
+                key=lbl.LABEL_CAPACITY_TYPE,
+                operator=OP_IN,
+                values=[lbl.CAPACITY_TYPE_SPOT, lbl.CAPACITY_TYPE_ON_DEMAND],
+            )
+        ]
+        if instance_types is not None:
+            requirements.append(
+                NodeSelectorRequirement(key=lbl.LABEL_INSTANCE_TYPE, operator=OP_IN, values=list(instance_types))
+            )
+        self.kube.create(make_provisioner(requirements=requirements))
+
+    def close(self):
+        if self.service is not None:
+            self.service.stop()
+
+    def exhaust(self, type_name: str, capacity: int = 0):
+        for zone in ("zone-a", "zone-b", "zone-c"):
+            for ct in ("spot", "on-demand"):
+                self.backend.set_pool_capacity(type_name, zone, ct, capacity)
+
+    def restore(self, type_name: str):
+        for zone in ("zone-a", "zone-b", "zone-c"):
+            for ct in ("spot", "on-demand"):
+                self.backend.set_pool_capacity(type_name, zone, ct, None)
+
+    def node_types(self):
+        return [n.metadata.labels[lbl.LABEL_INSTANCE_TYPE] for n in self.kube.list_nodes()]
+
+
+class TestFallbackResolve:
+    @pytest.mark.parametrize("transport", ["inprocess", "http"])
+    def test_typed_ice_triggers_in_round_resolve_onto_next_types(self, transport, request):
+        """The fallback re-solve rung, end to end in ONE provisioning round:
+        CreateFleet caps its spec list at the 20 cheapest types; with every
+        one of those pools exhausted the launch fails with a typed ICE, the
+        pools quarantine, and the IMMEDIATE re-solve (exclusion set applied
+        through the rebuilt universe) launches from the pricier remainder —
+        no pod waits for a second batch cycle."""
+        env = CrunchEnv(transport, instance_types=None)  # the full catalog
+        request.addfinalizer(env.close)
+        universe = sorted(
+            (
+                it
+                for it in env.provider.get_instance_types(env.kube.get("Provisioner", "default", namespace=""))
+                # the provider's defaulting hook pins arch=amd64, so only
+                # amd64 types can reach the launch's 20-cheapest spec cap
+                if it.info.architecture == lbl.ARCHITECTURE_AMD64
+            ),
+            key=lambda it: it.price(),
+        )
+        cheapest20 = {it.name() for it in universe[:20]}
+        for name in cheapest20:
+            env.exhaust(name, capacity=0)
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.runtime.provision_once()
+        assert env.runtime.provisioner.launch_failures.value(reason="insufficient_capacity") >= 1
+        nodes = env.kube.list_nodes()
+        assert nodes, "the in-round re-solve never launched replacement capacity"
+        assert all(
+            n.metadata.labels[lbl.LABEL_INSTANCE_TYPE] not in cheapest20 for n in nodes
+        ), f"a launch landed on an exhausted type: {env.node_types()}"
+        # the typed failure fed the negative cache
+        assert env.provider.unavailable.snapshot(), "exhausted pools were not quarantined"
+
+    def test_total_wall_quarantines_universe_and_recovers_after_ttl(self):
+        """Every pool of the only allowed type is exhausted: the launch's
+        typed ICE quarantines them all, the re-solve sees an empty universe
+        and leaves the pod unschedulable (event recorded) with the bounded
+        requeue deadline armed; capacity returning + the TTL lapsing makes
+        the NEXT round re-select the exhausted pool."""
+        env = CrunchEnv(instance_types=("general-2x4",))
+        env.exhaust("general-2x4", capacity=0)
+        pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+        env.kube.create(pod)
+        env.runtime.provision_once()
+        provisioner_ctrl = env.runtime.provisioner
+        assert provisioner_ctrl.launch_failures.value(reason="insufficient_capacity") >= 1
+        assert env.provider.unavailable.snapshot(), "the ICE'd pools were not quarantined"
+        assert not env.kube.list_nodes()
+        # unschedulable leftovers arm the requeue-with-backoff deadline so
+        # the retry needs no fresh pod event
+        assert provisioner_ctrl._earliest_ice_retry() is not None
+        events = env.runtime.recorder.of("FailedScheduling")
+        assert events, "no FailedScheduling event for the stranded pod"
+        # recovery: capacity returns and the quarantine TTL lapses -> the
+        # next round re-selects the previously exhausted (cheap) pool
+        env.restore("general-2x4")
+        env.clock.step(env.provider.unavailable.ttl + 1)
+        results = env.runtime.provision_once()
+        assert not results.unschedulable
+        assert "general-2x4" in env.node_types(), "the exhausted pool was not re-selected after its TTL"
+
+    def test_repeated_ice_parks_pod_with_decision_record_and_backoff(self):
+        """The terminal rung on a provider with NO negative cache (the fake
+        provider): every re-solve relaunches into the same wall, so after
+        the bounded attempts the pod parks — pod event, per-pod decision-log
+        record naming the capacity failure, and a backoff withholding it
+        from the next batch until the instant passes."""
+        from karpenter_tpu.tracing import DECISIONS
+
+        clock = FakeClock()
+        kube = KubeCluster(clock=clock)
+        it = instance_type("only", cpu=4, memory="8Gi")
+        provider = FakeCloudProvider([it])
+        for offering in it.offerings():
+            provider.insufficient_capacity_pools.add(("only", offering.zone, offering.capacity_type))
+        runtime = Runtime(
+            kube=kube,
+            cloud_provider=provider,
+            options=Options(leader_elect=False, dense_solver_enabled=False, enable_tracing=True),
+        )
+        kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+        kube.create(pod)
+        runtime.provision_once()
+        ctrl = runtime.provisioner
+        # 1 initial launch + ICE_RESOLVE_ATTEMPTS re-solved launches all ICE'd
+        assert ctrl.launch_failures.value(reason="insufficient_capacity") >= 1 + ctrl.ICE_RESOLVE_ATTEMPTS
+        assert (pod.namespace, pod.metadata.name) in ctrl._ice_backoff, "pod was not parked"
+        failed = [r for r in DECISIONS.recent(limit=50, outcome="failed") if r["pod"] == pod.metadata.name]
+        assert failed, "no decision-log record for the escalated pod"
+        assert "insufficient capacity" in failed[0]["error"]
+        assert runtime.recorder.of("FailedScheduling"), "no FailedScheduling event"
+        # parked: withheld from the batch until the backoff instant passes
+        assert ctrl.get_pods() == []
+        provider.insufficient_capacity_pools.clear()
+        clock.step(ctrl.ice_backoff_seconds + 1)
+        assert [p.metadata.name for p in ctrl.get_pods()] == [pod.metadata.name]
+        results = runtime.provision_once()
+        assert not results.unschedulable
+        assert kube.list_nodes(), "capacity returned but the parked pod never launched"
+
+    def test_deleted_parked_pod_releases_its_backoff_entry(self):
+        """A parked pod that disappears (deleted, or bound out-of-band) must
+        not leave a stale backoff entry behind: once expired, a stale entry
+        would pin Batcher.wait's deadline in the past forever — a busy loop
+        of empty provision rounds until process restart."""
+        clock = FakeClock()
+        kube = KubeCluster(clock=clock)
+        it = instance_type("only", cpu=4, memory="8Gi")
+        provider = FakeCloudProvider([it])
+        for offering in it.offerings():
+            provider.insufficient_capacity_pools.add(("only", offering.zone, offering.capacity_type))
+        runtime = Runtime(
+            kube=kube, cloud_provider=provider, options=Options(leader_elect=False, dense_solver_enabled=False)
+        )
+        kube.create(make_provisioner())
+        pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+        kube.create(pod)
+        runtime.provision_once()
+        ctrl = runtime.provisioner
+        assert ctrl._ice_backoff, "precondition: the pod parked"
+        kube.delete(pod, grace=False)
+        ctrl.get_pods()
+        assert not ctrl._ice_backoff, "the deleted pod's backoff entry must be swept"
+        assert ctrl._earliest_ice_retry() is None or ctrl._earliest_ice_retry() > clock.now()
+
+    def test_partial_fulfillment_feeds_cache_even_when_every_launch_succeeds(self):
+        """A launch that silently fell past the cheapest pool still
+        quarantines it: the NEXT solve prices the universe without the
+        exhausted pool (the earliest possible ICE signal)."""
+        env = CrunchEnv()
+        # drain only the cheapest spot pool of the bigger type
+        spot = {z: env.backend.get_spot_price("general-4x8", z) for z in ("zone-a", "zone-b", "zone-c")}
+        cheap_zone = min(spot, key=spot.get)
+        env.backend.set_pool_capacity("general-4x8", cheap_zone, "spot", 0)
+        for _ in range(6):
+            env.kube.create(make_pod(requests={"cpu": "3", "memory": "2Gi"}))
+        results = env.runtime.provision_once()
+        assert not results.unschedulable
+        assert ("general-4x8", cheap_zone, "spot") in env.provider.unavailable.snapshot()
+        # and no node of the round landed in the drained pool
+        for node in env.kube.list_nodes():
+            pool = (
+                node.metadata.labels[lbl.LABEL_INSTANCE_TYPE],
+                node.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE],
+                node.metadata.labels[lbl.LABEL_CAPACITY_TYPE],
+            )
+            assert pool != ("general-4x8", cheap_zone, "spot")
+
+
+class TestInterruptionOfferingFeed:
+    def test_spot_reclaim_notice_quarantines_the_pool(self):
+        """Satellite: a spot-interruption notice marks the victim's pool
+        unavailable BEFORE the proactive replacement solve prices the
+        universe — the just-reclaimed pool is the worst candidate."""
+        env = CrunchEnv(instance_types=("general-4x8",))
+        env.kube.create(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+        env.runtime.provision_once()
+        nodes = env.kube.list_nodes()
+        assert nodes
+        victim = nodes[0]
+        instance_id = victim.spec.provider_id.rsplit("/", 1)[-1]
+        pool = (
+            victim.metadata.labels[lbl.LABEL_INSTANCE_TYPE],
+            victim.metadata.labels[lbl.LABEL_TOPOLOGY_ZONE],
+            victim.metadata.labels[lbl.LABEL_CAPACITY_TYPE],
+        )
+        env.backend.interrupt_spot_instance(instance_id, warning_seconds=120.0)
+        # interruption controller is wired by the runtime only with a queue
+        # name; build it directly, the way Runtime does
+        from karpenter_tpu.controllers.interruption import InterruptionController
+
+        controller = InterruptionController(
+            env.kube,
+            env.runtime.cluster,
+            env.runtime.provisioner,
+            env.provider.notification_source(),
+            termination=env.runtime.termination,
+            clock=env.clock,
+            cloud_provider=env.runtime.cloud_provider,  # the decorated provider, as Runtime passes it
+        )
+        controller.poll_once()
+        assert pool in env.provider.unavailable.snapshot(), "reclaimed pool was not quarantined"
+
+
+class TestDenseAvailabilityMask:
+    def test_masked_offerings_never_selected_device_side(self):
+        """The dense path with the availability mask active: types whose
+        every offering is quarantined are never selected, the mask counters
+        engage, and application is the device-side cube reduction (no host
+        loop, no masked pick even at commit audit)."""
+        from dataclasses import replace
+
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.scheduler import build_scheduler
+        from karpenter_tpu.solver import DenseSolver
+
+        types = instance_types(30)
+        masked = {it.name() for it in types[:10]}
+        for it in types[:10]:
+            it._offerings = tuple(replace(o, available=False) for o in it._offerings)
+        provider = FakeCloudProvider(types)
+        pods = [make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(64)]
+        solver = DenseSolver(min_batch=1)
+        scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+        results = scheduler.solve(pods)
+        assert not results.unschedulable
+        assert solver.stats.masked_offerings > 0
+        assert solver.stats.mask_seconds > 0
+        for node in results.new_nodes:
+            assert not (masked & {it.name() for it in node.instance_type_options}), (
+                "a fully-masked type survived into a launchable option set"
+            )
+
+
+class TestHttpFleetSchema:
+    def test_partial_fleet_response_carries_per_item_errors(self):
+        from karpenter_tpu.cloudprovider.simulated import CloudAPIClient, CloudAPIService
+
+        clock = FakeClock()
+        backend = CloudBackend(clock=clock)
+        service = CloudAPIService(backend=backend).start()
+        try:
+            client = CloudAPIClient(service.url, clock=clock)
+            spec = _spec(backend)
+            pool = (spec.instance_type, spec.zone, spec.capacity_type)
+            backend.set_pool_capacity(*pool, 1)
+            result = client.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", count=3))
+            assert len(result.instances) == 1
+            assert len(result.errors) == 2
+            assert all(isinstance(e, InsufficientCapacityError) for e in result.errors)
+            assert all(pool in e.pools for e in result.errors)
+            assert pool in result.unavailable_pools
+        finally:
+            service.stop()

@@ -147,10 +147,10 @@ class TestIdempotentCreateFleet:
         reject-before-processing cannot exercise): the client's retry replays
         the same idempotency token and must receive the ORIGINAL instance."""
         service.drop_response_next(1)
-        instance = client.create_fleet(_fleet_request(backend))
+        result = client.create_fleet(_fleet_request(backend))
         assert client.retries >= 1
         assert len(backend.instances) == 1, "a lost response must never double-launch"
-        assert instance.instance_id in backend.instances
+        assert result.instance.instance_id in backend.instances
 
     def test_client_token_rides_the_fleet_request(self, service, backend, client):
         """An application-level token (the fleet batcher's per-launch token)
@@ -160,7 +160,7 @@ class TestIdempotentCreateFleet:
         request.client_token = "tok-app-level"
         first = client.create_fleet(request)
         second = client.create_fleet(request)  # a fresh call, same token
-        assert first.instance_id == second.instance_id
+        assert first.instance.instance_id == second.instance.instance_id
         assert len(backend.instances) == 1
 
     def test_request_deadline_bounds_the_retry_budget(self, service, backend, clock):
@@ -233,7 +233,7 @@ class TestIdempotentCreateFleet:
     def test_distinct_calls_launch_distinct_instances(self, backend, client):
         a = client.create_fleet(_fleet_request(backend))
         b = client.create_fleet(_fleet_request(backend))
-        assert a.instance_id != b.instance_id
+        assert a.instance.instance_id != b.instance.instance_id
         assert len(backend.instances) == 2
 
 
@@ -253,7 +253,7 @@ class TestInProcessIdempotency:
     def test_tokenless_requests_never_dedupe(self, backend):
         a = backend.create_fleet(_fleet_request(backend))
         b = backend.create_fleet(_fleet_request(backend))
-        assert a.instance_id != b.instance_id
+        assert a.instance.instance_id != b.instance.instance_id
 
     def test_backend_drop_response_executes_then_raises(self, backend):
         from karpenter_tpu.cloudprovider.simulated.backend import ResponseLostError
@@ -267,7 +267,7 @@ class TestInProcessIdempotency:
         # the retry with the same token replays the settled launch
         replay = backend.create_fleet(request)
         assert len(backend.instances) == 1
-        assert replay.instance_id in backend.instances
+        assert replay.instance.instance_id in backend.instances
 
     def test_fleet_batcher_retries_lost_response_with_same_token(self, backend):
         """The batcher's own retry loop: a lost response mid-call replays

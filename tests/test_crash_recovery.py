@@ -56,7 +56,7 @@ def _leak_instance(backend: CloudBackend) -> str:
             ],
             capacity_type="on-demand",
         )
-    ).instance_id
+    ).instance.instance_id
 
 
 @pytest.mark.parametrize("transport", ["inprocess", "http"])

@@ -89,7 +89,7 @@ class GCEnv:
                 capacity_type="on-demand",
             )
         )
-        return instance.instance_id
+        return instance.instance.instance_id
 
 
 @pytest.fixture()

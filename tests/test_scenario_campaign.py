@@ -57,6 +57,8 @@ class TestSchemaValidator:
                         "pods_bound": 4,
                         "nodes_churned": {},
                         "restarts": 0,
+                        "launch_failures": 0,
+                        "unschedulable_pod_seconds": 0.4,
                     },
                     "samples": [
                         {"t": 0.0, "pending_pods": 4, "nodes": 0, "cost_per_hour": 0.0, "disrupting": 0},
@@ -87,6 +89,14 @@ class TestSchemaValidator:
         doc = self._valid_doc()
         doc["runs"][0]["scores"]["lost_pods"] = "zero"
         assert any("lost_pods" in e for e in scenario_doc_errors(doc))
+
+    def test_capacity_failure_scores_required_and_typed(self):
+        doc = self._valid_doc()
+        del doc["runs"][0]["scores"]["launch_failures"]
+        doc["runs"][0]["scores"]["unschedulable_pod_seconds"] = -1.0
+        errors = scenario_doc_errors(doc)
+        assert any("launch_failures" in e for e in errors)
+        assert any("unschedulable_pod_seconds" in e for e in errors)
 
     def test_empty_runs_rejected(self):
         doc = self._valid_doc()
@@ -134,6 +144,10 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     assert scores["cost_drift_ratio"] > 0
     # the reclaim primitive exercised churn accounting
     assert sum(scores["nodes_churned"].values()) >= 1
+    # capacity-failure scores: a healthy smoke run fails no launches, and
+    # the pending integral is a finite non-negative pod-seconds figure
+    assert scores["launch_failures"] == 0
+    assert scores["unschedulable_pod_seconds"] >= 0
     # samples cover the whole run with monotonic timestamps (also schema-
     # checked) and the final sample sees the converged cluster
     assert len(run["samples"]) >= 3
@@ -176,3 +190,17 @@ def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
     # the crash storm actually stormed: >= 3 restarts, invariants held anyway
     for run in by_name["crash_storm"]["runs"]:
         assert run["scores"]["restarts"] >= 3, "crash storm must restart the control plane >= 3 times"
+    # capacity crunch: the wall produced real typed launch failures and real
+    # pending time, cost drift stayed bounded, and convergence (asserted
+    # above) required the exhausted pool re-selected after its TTL — while
+    # nothing was lost or leaked
+    for run in by_name["capacity_crunch"]["runs"]:
+        scores = run["scores"]
+        assert scores["launch_failures"] >= 1, "the total wall must surface typed launch failures"
+        assert scores["unschedulable_pod_seconds"] > 0, "the crunch must cost visible pending time"
+        assert scores["cost_drift_ratio"] <= 1.5, scores["cost_drift_ratio"]
+    # spot collapse: replacements churned via interruption and (per the
+    # settled predicate gating convergence) routed around the quarantined
+    # pools for the whole run
+    for run in by_name["spot_collapse"]["runs"]:
+        assert run["scores"]["nodes_churned"].get("interruption", 0) >= 1
