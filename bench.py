@@ -296,7 +296,14 @@ def assert_span_tree(tree, context: str) -> None:
 
 
 def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trials=SIDE_TRIALS, phase_key=None):
+    from karpenter_tpu import flight
+
     run_once(pods, provider, provisioners, solver, state_nodes)  # warmup/compile
+    # compile-churn gate data (flight.py): the measured trials run the SAME
+    # shapes the warmup compiled, so a nonzero count here IS steady-state
+    # recompilation — the regression the flight recorder exists to attribute
+    compile_base = flight.FLIGHT.compilations_total()
+    compile_seconds_base = flight.COMPILE_SECONDS.value()
     times = []
     phase_trials: dict = {k: [] for k in ("encode", "fill", "device", "mask", "assemble", "commit", "fill_device")}
     last_stats = None
@@ -325,9 +332,20 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         )
         if scheduled < len(pods) * 0.99:
             log(f"  [{name}] WARNING: only {scheduled}/{len(pods)} pods scheduled")
+    compilations = flight.FLIGHT.compilations_total() - compile_base
+    if compilations:
+        log(f"  [{name}] WARNING: {compilations} XLA compilations during measured trials (post-warmup)")
     if phase_key is not None and last_stats is not None:
         PHASE_BREAKDOWN[phase_key] = {
             **{k: round(float(np.median(v)) * 1000, 2) for k, v in phase_trials.items()},
+            # device-runtime telemetry (flight.py): compilations across the
+            # measured (post-warmup) trials, their compile seconds, and the
+            # peak device memory of the final trial — per-config, so a
+            # compile-churn or HBM regression is attributable from the
+            # artifact exactly like a phase-time drift
+            "compilations": compilations,
+            "compile_seconds": round(float(flight.COMPILE_SECONDS.value() - compile_seconds_base), 3),
+            "hbm_peak_bytes": int(flight.HBM_PEAK.value()),
             "fills_vectorized": last_stats.fills_vectorized,
             "fills_host": last_stats.fills_host,
             "fill_pods_vectorized": last_stats.fill_pods_vectorized,
@@ -399,10 +417,13 @@ def smoke() -> dict:
     (cold configs) or the vectorized warm fill engaged with nonzero device
     time (repack config); the node-guard never tripped and the dense node
     count stayed within the guard ratio of the host floor."""
+    from karpenter_tpu.flight import FLIGHT
     from karpenter_tpu.tracing import TRACER
 
     was_enabled = TRACER.enabled
+    flight_was_enabled = FLIGHT.enabled
     TRACER.enable()  # smoke runs traced: an empty span tree is a tier-1 failure
+    FLIGHT.enable()  # and flight-recorded: compile/HBM telemetry per config
     try:
         return _smoke()
     finally:
@@ -411,9 +432,12 @@ def smoke() -> dict:
             # assert must not leave the process-wide tracer on for
             # unrelated tests that follow
             TRACER.disable()
+        if not flight_was_enabled:
+            FLIGHT.disable()
 
 
 def _smoke() -> dict:
+    from karpenter_tpu import flight
     from karpenter_tpu.api.objects import Taint
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
     from karpenter_tpu.solver import DenseSolver
@@ -423,11 +447,20 @@ def _smoke() -> dict:
 
     def check(name, pods, provider, provisioners, state_nodes=(), repack=False):
         solver = DenseSolver(min_batch=1)
+        compile_base = flight.FLIGHT.compilations_total()
+        compile_seconds_base = flight.COMPILE_SECONDS.value()
         elapsed, scheduled, nodes, cost, stats, _packing = run_once(
             pods, provider, provisioners, solver, state_nodes
         )
         span_tree = capture_span_tree()
         assert_span_tree(span_tree, name)
+        # flight-recorder gate: the solve was recorded, with non-negative
+        # compile/HBM telemetry (counts are structural — a shared-process
+        # tier-1 run may find these shapes already compiled)
+        records = flight.FLIGHT.records()
+        assert records, f"[{name}] flight recorder captured no solve record"
+        hbm_peak, hbm_live = records[-1].hbm_peak_bytes, records[-1].hbm_live_bytes
+        assert hbm_peak >= 0 and hbm_live >= 0, f"[{name}] negative HBM accounting"
         assert scheduled == len(pods), f"[{name}] scheduled {scheduled}/{len(pods)}"
         assert stats.node_guard_failopens == 0, f"[{name}] node guard tripped"
         if stats.nodes_opened_host_floor:
@@ -452,6 +485,11 @@ def _smoke() -> dict:
             "nodes_opened_host_floor": stats.nodes_opened_host_floor,
             "masked_offerings": stats.masked_offerings,
             "mask_seconds": stats.mask_seconds,
+            # device-runtime telemetry (flight.py), per config
+            "compilations": flight.FLIGHT.compilations_total() - compile_base,
+            "compile_seconds": round(float(flight.COMPILE_SECONDS.value() - compile_seconds_base), 6),
+            "hbm_peak_bytes": hbm_peak,
+            "hbm_live_bytes": hbm_live,
             "span_tree": span_tree,
         }
         log(f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes)")
@@ -538,6 +576,21 @@ def _smoke() -> dict:
     assert attrs["depth"] == 0
     summary["interruption_queue"] = attrs
 
+    # steady-state recompile gate (the flight recorder's reason to exist):
+    # re-solving the already-warm anti_spread shapes must trigger ZERO new
+    # XLA compilations — the property the incremental-solve work is gated on
+    log("smoke: steady-state recompile gate")
+    steady_base = flight.FLIGHT.compilations_total()
+    run_once(
+        build_workload(700, seed=42),
+        FakeCloudProvider(instance_types(100)),
+        [make_provisioner()],
+        DenseSolver(min_batch=1),
+    )
+    steady = flight.FLIGHT.compilations_total() - steady_base
+    assert steady == 0, f"steady-state re-solve recompiled {steady} XLA programs"
+    summary["steady_state_recompiles"] = steady
+
     summary["provenance"] = bench_provenance("smoke")
     summary["ok"] = True
     return summary
@@ -552,10 +605,15 @@ def main() -> None:
 
     import gc
 
+    from karpenter_tpu.flight import FLIGHT
+
     # the whole grid runs traced (a handful of spans per solve — noise-level
     # next to the solve itself) so the emitted phases JSON carries the span
-    # tree of every config's final trial, headline included
+    # tree of every config's final trial, headline included — and
+    # flight-recorded, so per-config compile counts + peak HBM land in the
+    # phases JSON next to the phase medians
     TRACER.enable()
+    FLIGHT.enable()
 
     configs: dict = {}
 

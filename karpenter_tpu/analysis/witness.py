@@ -306,3 +306,10 @@ def routes() -> dict:
     """`/debug/locks` for the metrics listener (cmd/controller.py wires it
     behind --enable-lock-witness)."""
     return {"/debug/locks": _locks_route}
+
+
+def route_descriptions() -> dict:
+    """/debug-index descriptions, keyed like routes() (see tracing.py)."""
+    return {
+        "/debug/locks": "lock-order witness: acquisition graph, cycles (potential deadlocks), contention/hold times",
+    }

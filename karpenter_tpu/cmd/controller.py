@@ -82,34 +82,51 @@ def main(argv=None) -> int:
     # probes + /metrics serve from the moment the process is up — BEFORE
     # runtime.start(), which blocks on leader election: a standby replica
     # must still answer kubelet probes (controllers.go:167-181)
-    from ..observability import ObservabilityServer
+    from ..observability import ObservabilityServer, debug_index_route
 
     extra_routes = {}
+    # /debug index rows: every wired debug endpoint with the one-line
+    # description its OWN module declares next to its routes() — path and
+    # description can only drift together, inside one file
+    debug_descriptions = {}
     if options.enable_profiling:
         # live pprof-analog endpoints on the metrics port
         # (controllers.go:183-202): on-demand host profile + XLA trace of
         # the RUNNING process, no restart needed
         from ..profiling import LiveProfiler
 
-        extra_routes.update(LiveProfiler().routes())
+        profiler = LiveProfiler()
+        extra_routes.update(profiler.routes())
+        debug_descriptions.update(profiler.route_descriptions())
     if options.enable_tracing:
         # decision-tracing read surface: /debug/traces (+ ?id, ?format=chrome)
         # and /debug/decisions (+ ?pod=, ?outcome=, ?limit=) on the metrics port
         from .. import tracing
 
         extra_routes.update(tracing.routes())
+        debug_descriptions.update(tracing.route_descriptions())
     if options.enable_slo:
         # the SLO snapshot: live pending-latency quantiles, cluster $/hr,
         # cost-drift ratio, churn counters on the metrics port
         from .. import slo
 
         extra_routes.update(slo.routes())
+        debug_descriptions.update(slo.route_descriptions())
     if options.enable_lock_witness:
         # lock-order witness read surface: acquisition-order graph, cycle
         # (potential-deadlock) list, hold times on the metrics port
         from ..analysis import witness
 
         extra_routes.update(witness.routes())
+        debug_descriptions.update(witness.route_descriptions())
+    if options.enable_solver_telemetry:
+        # solver flight recorder read surface: per-solve records with
+        # compile-churn attribution and HBM accounting on the metrics port
+        from .. import flight
+
+        extra_routes.update(flight.routes())
+        debug_descriptions.update(flight.route_descriptions())
+    extra_routes["/debug"] = debug_index_route(debug_descriptions)
     obs = ObservabilityServer(
         healthy=runtime.healthy,
         ready=lambda: runtime.ready() and runtime.healthy(),

@@ -1,0 +1,520 @@
+"""Solver flight recorder: per-solve telemetry for the device runtime.
+
+The solve headline (BENCH_*.json) scores how fast a solve is; tracing says
+where one solve's wall-clock went. Neither observes the *device runtime*
+underneath: whether a solve recompiled its XLA programs (the r2-r5 headline
+drift stayed unbisectable partly because nobody could say "r4 started
+recompiling every pass"), or what the encode pushed through device memory.
+This module is that instrument — the precondition for the incremental
+steady-state solve work (ROADMAP item 1): before the O(delta) reformulation
+can be *gated*, "a settled cluster re-solving under churn triggers zero new
+compilations" has to be a measurable property.
+
+Three instruments, one bounded ring:
+
+- **per-solve records** — every dense presolve appends one `SolveRecord`:
+  pod/group/bucket/type/zone cardinalities, the dispatch flavor and its
+  padded vs actual shapes (with padding-waste %), every `DenseSolveStats`
+  phase delta (encode/fill/device/mask/assemble/commit), fill routing, and
+  the compile/HBM attribution below. Served at `/debug/solver` (index +
+  `?id=` detail, 404-shaped JSON like the tracing routes).
+- **JIT compile churn** — a `jax.monitoring` listener counts XLA
+  backend-compile events and their seconds
+  (`karpenter_jax_compilations_total{fn}` / `karpenter_jax_compile_seconds_total`);
+  per-entry attribution comes from polling the registered jitted entries'
+  `_cache_size()` around each solve, and each recompile is further
+  attributed to the *dimension that changed shape* since the previous solve
+  (pods grew past a pad boundary, the type universe changed, a new bucket
+  count) — the record names the changed axes, so compile churn is
+  actionable, not just counted.
+- **HBM accounting** — per-solve device-memory snapshots from
+  `device.memory_stats()` (TPU) with a `jax.live_arrays()` fallback (CPU/
+  interpret), exported as `karpenter_solver_hbm_peak_bytes` /
+  `karpenter_solver_hbm_live_bytes` gauges and stamped on each record.
+
+Design constraints match tracing.py exactly:
+
+- **disabled == free**: OFF by default; the ring allocates on `enable()`,
+  never before, and every hot-path hook is one attribute read when
+  disabled. The dense solver snapshots stats only when enabled.
+- **zero deps, bounded memory**: the ring is a bounded deque (default 128
+  records); overflow evicts oldest and counts into
+  `karpenter_flight_records_dropped`.
+- **one read surface**: `/debug/solver` on the metrics listener (wired
+  behind `--enable-solver-telemetry` in cmd/controller.py); the same
+  families export through `/metrics` for scrapers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .analysis.guards import guarded_by
+from .analysis.witness import WITNESS
+from .logsetup import get_logger
+from .metrics import REGISTRY
+
+log = get_logger("flight")
+
+DEFAULT_RING = 128
+
+# the backend-compile event jax.monitoring emits once per XLA compilation
+# (trace-cache hits emit nothing): the one signal that IS a recompile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# registered at import so gen_docs sees the families without a live recorder
+COMPILATIONS = REGISTRY.counter(
+    "karpenter_jax_compilations_total",
+    "XLA compilations observed by the solver flight recorder, by jitted entry"
+    " ('other' = a compile no registered entry's cache grew for).",
+    ("fn",),
+)
+COMPILE_SECONDS = REGISTRY.counter(
+    "karpenter_jax_compile_seconds_total",
+    "Seconds spent in XLA backend compilation (jax.monitoring compile events).",
+)
+HBM_PEAK = REGISTRY.gauge(
+    "karpenter_solver_hbm_peak_bytes",
+    "Peak device-memory bytes reported at the last recorded solve"
+    " (device memory_stats, or the live-array total where unavailable).",
+)
+HBM_LIVE = REGISTRY.gauge(
+    "karpenter_solver_hbm_live_bytes",
+    "Live device-memory bytes at the last recorded solve.",
+)
+RECORDS_STORED = REGISTRY.gauge(
+    "karpenter_flight_records_stored", "Per-solve records currently held in the flight-recorder ring"
+)
+RECORDS_DROPPED = REGISTRY.counter(
+    "karpenter_flight_records_dropped", "Per-solve records evicted from the bounded flight-recorder ring"
+)
+SOLVE_LATENCY = REGISTRY.summary(
+    "karpenter_solver_solve_duration_seconds",
+    "Wall-clock of real (non-simulation) Scheduler.solve calls while solver telemetry is enabled.",
+    objectives=(0.5, 0.95, 0.99),
+)
+
+@guarded_by("_lock", "events", "seconds", "_registered")
+class _CompileTally:
+    """Process-wide backend-compile tally. jax.monitoring offers no
+    per-listener unregister, so exactly ONE listener is ever installed (on
+    the first recorder enable) and it feeds this shared tally + the
+    COMPILE_SECONDS family exactly once per compile — a second enabled
+    recorder (tests construct fresh instances in the shared tier-1 process)
+    reads the same tally instead of double-counting."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered = False
+        self.events = 0
+        self.seconds = 0.0
+
+    def register_listener(self) -> None:
+        with self._lock:
+            if self._registered:
+                return
+            self._registered = True  # set even on failure: don't retry every enable
+        try:
+            from jax import monitoring
+        except Exception as exc:  # noqa: BLE001 - recorder must work jax-less
+            log.warning("jax.monitoring unavailable; compile churn not counted: %r", exc)
+            return
+
+        def on_event(event: str, duration: float, **kwargs) -> None:
+            if event != _COMPILE_EVENT:
+                return
+            with self._lock:
+                self.events += 1
+                self.seconds += duration
+            COMPILE_SECONDS.inc(duration)
+
+        monitoring.register_event_duration_secs_listener(on_event)
+
+    def snapshot(self) -> tuple:
+        with self._lock:
+            return self.events, self.seconds
+
+
+_TALLY = _CompileTally()
+
+# the shape-signature axes recompiles are attributed to, in report order
+_SIGNATURE_DIMS = (
+    "pods",
+    "groups",
+    "buckets",
+    "types",
+    "zones",
+    "capacity_types",
+    "resources",
+    "buckets_padded",
+    "types_padded",
+)
+
+
+@dataclass
+class SolveRecord:
+    """One dense solve, as the flight recorder saw it."""
+
+    id: int
+    timestamp: float  # epoch seconds
+    signature: Dict[str, int]  # the _SIGNATURE_DIMS cardinalities
+    dispatch: str  # plain | pallas | sharded | none (no device dispatch ran)
+    padding_waste_pct: float  # 100 * padded-but-dead share of the dispatch surface
+    phases: Dict[str, float]  # per-phase seconds, this solve only (stats delta)
+    fill_routing: Dict[str, int]  # fills/pods via the vectorized vs host fill
+    pods_committed: int = 0
+    pods_to_host: int = 0
+    duration_seconds: float = 0.0
+    recompile: bool = False
+    compiled_fns: Dict[str, int] = field(default_factory=dict)  # entry -> compiles this solve
+    compile_seconds: float = 0.0
+    # the dimensions whose cardinality changed vs the PREVIOUS recorded
+    # solve — empty on a recompile with an unchanged signature (a new code
+    # path compiled), ["cold-start"] when there was no previous solve
+    recompile_attribution: List[str] = field(default_factory=list)
+    hbm_peak_bytes: int = 0
+    hbm_live_bytes: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "timestamp": self.timestamp,
+            "signature": self.signature,
+            "dispatch": self.dispatch,
+            "padding_waste_pct": round(self.padding_waste_pct, 2),
+            "phases": {k: round(v, 6) for k, v in self.phases.items()},
+            "fill_routing": self.fill_routing,
+            "pods_committed": self.pods_committed,
+            "pods_to_host": self.pods_to_host,
+            "duration_seconds": round(self.duration_seconds, 6),
+            "recompile": self.recompile,
+            "compiled_fns": self.compiled_fns,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "recompile_attribution": self.recompile_attribution,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+            "hbm_live_bytes": self.hbm_live_bytes,
+        }
+
+    def summary(self) -> dict:
+        """The /debug/solver index row."""
+        return {
+            "id": self.id,
+            "timestamp": self.timestamp,
+            "pods": self.signature.get("pods", 0),
+            "buckets": self.signature.get("buckets", 0),
+            "types": self.signature.get("types", 0),
+            "dispatch": self.dispatch,
+            "duration_seconds": round(self.duration_seconds, 6),
+            "recompile": self.recompile,
+            "recompile_attribution": self.recompile_attribution,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+        }
+
+
+@guarded_by("_lock", "_ring", "_next_id", "_prev_signature", "_entries")
+class FlightRecorder:
+    """Bounded ring of per-solve records + the compile/HBM instruments."""
+
+    # distinct jitted wrappers retained per {fn} name: the sharded path can
+    # mint a fresh wrapper per mesh generation (lru-evicted meshes, chip
+    # dropout + re-detect), and a registry that only ever appends would pin
+    # every generation's compiled executables for process lifetime
+    MAX_FNS_PER_ENTRY = 8
+
+    def __init__(self, capacity: int = DEFAULT_RING):
+        self._lock = WITNESS.lock("solver.flight")
+        self.capacity = capacity
+        self.enabled = False
+        # allocated on enable(), never before — "disabled is a true no-op"
+        self._ring: Optional[List[SolveRecord]] = None
+        self._next_id = 0
+        self._prev_signature: Optional[Dict[str, int]] = None
+        # named jitted entries whose _cache_size() growth attributes compiles
+        self._entries: Dict[str, List[object]] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None:
+                self.capacity = capacity
+            first = self._ring is None
+            if first:
+                self._ring = []
+        if first and WITNESS.enabled:
+            # first enable happens at Runtime construction, before any solve
+            # holds the lock: adopt a witnessed lock so the ring joins the
+            # lock-order graph the chaos suites assert acyclic
+            self._lock = WITNESS.lock("solver.flight")
+        _TALLY.register_listener()
+        self._register_default_entries()
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop records and attribution state (per-run harness reset; the
+        monotonic compile counters survive — consumers score deltas)."""
+        with self._lock:
+            if self._ring is not None:
+                self._ring.clear()
+            self._prev_signature = None
+        RECORDS_STORED.set(0)
+        SOLVE_LATENCY.clear()
+
+    # -- compile instruments ---------------------------------------------------
+
+    def _register_default_entries(self) -> None:
+        """Name the solver pipeline's jitted entries so compile counts carry
+        a {fn} label. Import errors degrade to unattributed counting."""
+        try:
+            from .ops import feasibility, packing, warmfill
+
+            self.register_jit_entry("resource_fit", feasibility.resource_fit)
+            self.register_jit_entry("feasibility_mask", feasibility.feasibility_mask)
+            self.register_jit_entry("bucket_type_cost", feasibility.bucket_type_cost)
+            self.register_jit_entry("bucket_type_cost_packed", feasibility.bucket_type_cost_packed)
+            self.register_jit_entry("segment_usage", packing.segment_usage)
+            self.register_jit_entry("audit_layout", packing.audit_layout)
+            self.register_jit_entry("warm_fill_counts", warmfill.warm_fill_counts)
+            self.register_jit_entry("warm_fill_counts_pallas", warmfill._warm_fill_counts_pallas_padded)
+        except Exception as exc:  # noqa: BLE001 - per-fn attribution is best-effort
+            log.warning("solver jit entries unavailable; compiles will count as 'other': %r", exc)
+        try:
+            from .ops import pallas_kernels
+
+            self.register_jit_entry("bucket_type_cost_pallas", pallas_kernels._bucket_type_cost_padded)
+        except Exception as exc:  # noqa: BLE001 - Pallas-less builds are supported
+            log.debug("pallas entry unavailable: %r", exc)
+
+    def register_jit_entry(self, name: str, fn: object) -> None:
+        """Attach a jitted function (anything exposing _cache_size()) to a
+        {fn} label; repeated registrations of the same object are no-ops,
+        and several objects may share one name (per-mesh sharded wrappers)."""
+        if not hasattr(fn, "_cache_size"):
+            return
+        with self._lock:
+            fns = self._entries.setdefault(name, [])
+            if any(existing is fn for existing in fns):
+                return
+            fns.append(fn)
+            if len(fns) > self.MAX_FNS_PER_ENTRY:
+                del fns[0]  # oldest generation: stop pinning its executables
+
+    def _cache_sizes_locked(self) -> Dict[str, int]:
+        sizes: Dict[str, int] = {}
+        for name, fns in self._entries.items():
+            total = 0
+            for fn in fns:
+                try:
+                    total += int(fn._cache_size())  # type: ignore[attr-defined]
+                except Exception:  # noqa: BLE001 - a dead wrapper must not kill telemetry
+                    log.debug("cache-size probe failed for %s", name)
+            sizes[name] = total
+        return sizes
+
+    def _cache_sizes(self) -> Dict[str, int]:
+        with self._lock:
+            return self._cache_sizes_locked()
+
+    def compilations_total(self) -> int:
+        """Sum of the per-fn compile counter across labels (score surface)."""
+        return int(sum(COMPILATIONS.values().values()))
+
+    # -- HBM instrument --------------------------------------------------------
+
+    @staticmethod
+    def hbm_snapshot() -> tuple:
+        """(peak_bytes, live_bytes) for the first addressable device.
+        TPU backends report memory_stats(); where that is None (CPU, the
+        interpret path) fall back to the live-array total — an HBM *model*,
+        but a shape-faithful one: the arrays the solver keeps resident."""
+        try:
+            import jax
+
+            device = jax.local_devices()[0]
+            stats = device.memory_stats()
+            if stats:
+                live = int(stats.get("bytes_in_use", 0))
+                peak = int(stats.get("peak_bytes_in_use", live))
+                return peak, live
+            live = int(sum(arr.nbytes for arr in jax.live_arrays()))
+            return live, live
+        except Exception as exc:  # noqa: BLE001 - telemetry must never fail a solve
+            log.debug("hbm snapshot unavailable: %r", exc)
+            return 0, 0
+
+    # -- the per-solve seam (dense.py) ----------------------------------------
+
+    def begin_solve(self) -> Optional[dict]:
+        """Snapshot the compile tallies at the head of a dense solve; the
+        matching complete_solve() attributes everything that moved."""
+        if not self.enabled:
+            return None
+        events, seconds = _TALLY.snapshot()
+        return {"sizes": self._cache_sizes(), "events": events, "seconds": seconds}
+
+    def complete_solve(
+        self,
+        token: dict,
+        signature: Dict[str, int],
+        dispatch: Optional[dict],
+        phases: Dict[str, float],
+        fill_routing: Dict[str, int],
+        pods_committed: int,
+        pods_to_host: int,
+        duration: float,
+    ) -> Optional[SolveRecord]:
+        """Close the window begin_solve() opened: compute per-entry compile
+        deltas, attribute them to the changed shape dimensions, snapshot
+        HBM, and append the record to the ring."""
+        if not self.enabled or token is None:
+            return None
+        sizes = self._cache_sizes()
+        compiled = {
+            name: sizes[name] - token["sizes"].get(name, 0)
+            for name in sizes
+            if sizes[name] > token["sizes"].get(name, 0)
+        }
+        tally_events, tally_seconds = _TALLY.snapshot()
+        events = tally_events - token["events"]
+        seconds = tally_seconds - token["seconds"]
+        attributed = sum(compiled.values())
+        if events > attributed:
+            compiled["other"] = events - attributed
+        for name, count in compiled.items():
+            COMPILATIONS.inc(count, fn=name)
+        peak, live = self.hbm_snapshot()
+        HBM_PEAK.set(float(peak))
+        HBM_LIVE.set(float(live))
+        waste = 0.0
+        surface = signature.get("buckets_padded", 0) * signature.get("types_padded", 0)
+        if surface > 0:
+            actual = signature.get("buckets", 0) * signature.get("types", 0)
+            waste = 100.0 * (1.0 - actual / surface)
+        with self._lock:
+            if self._ring is None:
+                return None
+            attribution: List[str] = []
+            if compiled:
+                if self._prev_signature is None:
+                    attribution = ["cold-start"]
+                else:
+                    attribution = [
+                        dim
+                        for dim in _SIGNATURE_DIMS
+                        if signature.get(dim) != self._prev_signature.get(dim)
+                    ]
+            record = SolveRecord(
+                id=self._next_id,
+                timestamp=time.time(),
+                signature={dim: int(signature.get(dim, 0)) for dim in _SIGNATURE_DIMS},
+                dispatch=(dispatch or {}).get("flavor", "none"),
+                padding_waste_pct=waste,
+                phases=dict(phases),
+                fill_routing=dict(fill_routing),
+                pods_committed=pods_committed,
+                pods_to_host=pods_to_host,
+                duration_seconds=duration,
+                recompile=bool(compiled),
+                compiled_fns=compiled,
+                compile_seconds=seconds,
+                recompile_attribution=attribution,
+                hbm_peak_bytes=peak,
+                hbm_live_bytes=live,
+            )
+            self._next_id += 1
+            self._prev_signature = dict(signature)
+            self._ring.append(record)
+            if len(self._ring) > self.capacity:
+                del self._ring[0]
+                RECORDS_DROPPED.inc()
+            RECORDS_STORED.set(float(len(self._ring)))
+        return record
+
+    def observe_solve_latency(self, seconds: float) -> None:
+        """One observation per REAL Scheduler.solve (the scheduler gates on
+        enabled + non-simulation before calling)."""
+        SOLVE_LATENCY.observe(seconds)
+
+    # -- read surface ----------------------------------------------------------
+
+    def records(self) -> List[SolveRecord]:
+        with self._lock:
+            return list(self._ring) if self._ring is not None else []
+
+    def record_by_id(self, record_id: int) -> Optional[SolveRecord]:
+        with self._lock:
+            if self._ring is None:
+                return None
+            for record in self._ring:
+                if record.id == record_id:
+                    return record
+        return None
+
+    def snapshot(self) -> dict:
+        """The /debug/solver index payload: newest-first record summaries
+        plus the process-wide compile tallies."""
+        records = self.records()
+        events, seconds = _TALLY.snapshot()
+        return {
+            "enabled": self.enabled,
+            "records": [r.summary() for r in reversed(records)],
+            "compilations_total": self.compilations_total(),
+            "compile_events": events,
+            "compile_seconds_total": round(seconds, 6),
+            "compilations_by_fn": {
+                (labels[0] or "other"): int(value) for labels, value in COMPILATIONS.values().items()
+            },
+            "hbm_peak_bytes": int(HBM_PEAK.value()),
+            "hbm_live_bytes": int(HBM_LIVE.value()),
+        }
+
+
+# the process-wide instance (the TRACER analog): dense.py feeds it, the
+# Runtime enables it behind --enable-solver-telemetry, bench enables directly
+FLIGHT = FlightRecorder()
+
+
+def enabled() -> bool:
+    return FLIGHT.enabled
+
+
+# -- HTTP route (ObservabilityServer extra routes) ----------------------------
+
+
+def _json(status, payload) -> tuple:
+    return status, "application/json; charset=utf-8", json.dumps(payload) + "\n"
+
+
+def _solver_route(query: dict) -> tuple:
+    raw_id = (query.get("id") or [None])[0]
+    if raw_id is None:
+        return _json(200, FLIGHT.snapshot())
+    try:
+        record_id = int(raw_id)
+    except ValueError:
+        return _json(404, {"error": f"solve id {raw_id!r} is not an integer", "status": 404})
+    record = FLIGHT.record_by_id(record_id)
+    if record is None:
+        return _json(404, {"error": f"solve record {record_id} not found", "status": 404})
+    return _json(200, record.to_dict())
+
+
+def routes() -> dict:
+    """The flight-recorder read surface, served from the metrics listener
+    alongside tracing/SLO (cmd/controller.py wires it behind
+    --enable-solver-telemetry)."""
+    return {"/debug/solver": _solver_route}
+
+
+def route_descriptions() -> dict:
+    """/debug-index descriptions, keyed like routes() (see tracing.py)."""
+    return {
+        "/debug/solver": "solver flight recorder: per-solve shapes/phases, recompile attribution, HBM; ?id= detail",
+    }

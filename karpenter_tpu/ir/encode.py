@@ -294,6 +294,21 @@ class DenseProblem:
     def template_of_group(self, group: "GroupInfo") -> NodeTemplate:
         return self.templates[group.template_index]
 
+    def shape_signature(self) -> Dict[str, int]:
+        """The axis cardinalities that key the solver's compiled-shape
+        universe — what the flight recorder (flight.py) attributes a
+        recompile to when one of them changes between solves. Bucket and
+        padded-dispatch dimensions are appended by the solver (they only
+        exist after domain assignment / dispatch padding)."""
+        return {
+            "pods": self.P,
+            "groups": self.G,
+            "types": self.T,
+            "zones": len(self.zones),
+            "capacity_types": len(self.capacity_types),
+            "resources": len(self.resource_names),
+        }
+
 
 @dataclass
 class CatalogEncoding:

@@ -9,14 +9,33 @@ embedding runtimes in tests never binds real ports.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .logsetup import get_logger
 from .metrics import REGISTRY
 
 log = get_logger("observability")
+
+
+def debug_index_route(descriptions: Dict[str, str]):
+    """Build the `/debug` index route: one JSON row per registered debug
+    endpoint with its one-line description, so the read surface is
+    discoverable from the process itself instead of the docs. The entry
+    point (cmd/controller.py) passes the paths it actually wired — an
+    endpoint behind a disabled flag is absent here too, matching what a
+    GET against it would find."""
+
+    def route(query: dict) -> tuple:
+        endpoints = [
+            {"path": path, "description": descriptions[path]} for path in sorted(descriptions)
+        ]
+        body = json.dumps({"endpoints": endpoints}) + "\n"
+        return 200, "application/json; charset=utf-8", body
+
+    return route
 
 
 def _handler(routes):

@@ -134,6 +134,16 @@ class LiveProfiler:
         }
 
     @staticmethod
+    def route_descriptions() -> dict:
+        """/debug-index descriptions, keyed like routes() (see tracing.py)."""
+        return {
+            "/debug/pprof/": "live profiling index",
+            "/debug/pprof/profile": "statistical host CPU profile (?seconds=N, collapsed stacks)",
+            "/debug/pprof/heap": "tracemalloc top allocations",
+            "/debug/pprof/trace": "JAX/XLA device trace (?seconds=N, TensorBoard-ready)",
+        }
+
+    @staticmethod
     def _seconds(query: dict, default: float = 1.0) -> float:
         try:
             value = float(query.get("seconds", [default])[0])

@@ -31,6 +31,7 @@ from .controllers.termination import TerminationController
 from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
 from .logsetup import configure as configure_logging, get_logger, set_level
+from .flight import FLIGHT
 from .metrics import REGISTRY
 from .slo import SLO
 from .tracing import TRACER
@@ -85,6 +86,11 @@ class Runtime:
             # controller pass land in one bounded ring served over
             # /debug/traces on the metrics port
             TRACER.enable(capacity=self.options.trace_ring_size)
+        if self.options.enable_solver_telemetry:
+            # the solver flight recorder (flight.py): per-solve shape/phase
+            # records, XLA compile-churn attribution, HBM gauges — served
+            # over /debug/solver on the metrics port
+            FLIGHT.enable(capacity=self.options.flight_ring_size)
         self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree

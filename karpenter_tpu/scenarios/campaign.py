@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import slo
+from .. import flight, slo
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
 from ..api.provisioner import Budget, Consolidation, Disruption, Provisioner, ProvisionerSpec
@@ -212,6 +212,15 @@ def _launch_failures_total() -> int:
     return int(sum(counter.values().values())) if counter is not None else 0
 
 
+def _solver_latency_p95():
+    """p95 of real Scheduler.solve wall-clock this run (flight.py summary,
+    reset at run start); None when the run never solved."""
+    import math
+
+    value = flight.SOLVE_LATENCY.quantile(0.95)
+    return None if math.isnan(value) else round(value, 6)
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -261,6 +270,7 @@ class CampaignRunner:
         if transport not in TRANSPORTS:
             raise ValueError(f"unknown transport {transport!r}; one of {TRANSPORTS}")
         slo.SLO.reset()
+        flight.FLIGHT.reset()  # per-run solver-latency quantiles + records
         kube = KubeCluster()
         backend = CloudBackend(clock=kube.clock)
         backend.notifications.visibility_timeout = 1.0
@@ -294,6 +304,10 @@ class CampaignRunner:
                     interruption_queue="interruptions",
                     interruption_poll_interval=0.2,
                     enable_slo=True,
+                    # solver telemetry scores the steady-state property:
+                    # recompiles_total (must be 0 for a settled cluster
+                    # re-solving under churn) + solver-latency p95
+                    enable_solver_telemetry=True,
                     gc_interval=1.0,
                     gc_registration_grace=3.0,
                     # scenario timescales are seconds: a parked pod must
@@ -315,6 +329,7 @@ class CampaignRunner:
         samples: List[dict] = []
         violations = 0
         launch_failures_at_start = _launch_failures_total()
+        recompiles_at_start = flight.FLIGHT.compilations_total()
         start = time.monotonic()
         try:
             runtime.start()
@@ -371,6 +386,8 @@ class CampaignRunner:
                     "restarts": ctx.restarts,
                     "launch_failures": _launch_failures_total() - launch_failures_at_start,
                     "unschedulable_pod_seconds": _unschedulable_pod_seconds(samples),
+                    "recompiles_total": flight.FLIGHT.compilations_total() - recompiles_at_start,
+                    "solver_latency_p95_seconds": _solver_latency_p95(),
                 },
                 "samples": samples,
             }
@@ -396,6 +413,7 @@ class CampaignRunner:
             # run must not leave accounting on for unrelated work (the next
             # run_one re-enables through its own Runtime)
             slo.SLO.disable()
+            flight.FLIGHT.disable()
 
     @staticmethod
     def _run_primitive(ctx: ScenarioContext, primitive) -> None:

@@ -34,6 +34,12 @@ SCORE_KEYS = (
     # place work (a crunch's user-visible cost even when nothing is lost)
     "launch_failures",
     "unschedulable_pod_seconds",
+    # solver-telemetry scores (flight.py): XLA compilations observed during
+    # the run (the steady-state property — a settled cluster re-solving
+    # under churn must score 0 after warmup) and the p95 of real
+    # Scheduler.solve wall-clock (null when the run solved nothing)
+    "recompiles_total",
+    "solver_latency_p95_seconds",
 )
 QUANTILE_KEYS = ("p50", "p95", "p99", "count")
 SAMPLE_KEYS = ("t", "pending_pods", "nodes", "cost_per_hour", "disrupting")
@@ -67,13 +73,16 @@ def run_errors(run, where: str = "run") -> List[str]:
         for key in SCORE_KEYS:
             if key not in scores:
                 errs.append(f"{where}.scores missing key {key!r}")
-        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures"):
+        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures", "recompiles_total"):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
                 errs.append(f"{where}.scores.{field} must be an int, got {type(value).__name__}")
         ups = scores.get("unschedulable_pod_seconds")
         if ups is not None and (not isinstance(ups, (int, float)) or isinstance(ups, bool) or ups < 0):
             errs.append(f"{where}.scores.unschedulable_pod_seconds must be a non-negative number")
+        p95 = scores.get("solver_latency_p95_seconds")
+        if p95 is not None and (not isinstance(p95, (int, float)) or isinstance(p95, bool) or p95 < 0):
+            errs.append(f"{where}.scores.solver_latency_p95_seconds must be null or a non-negative number")
         errs.extend(_quantile_errors(scores.get("pending_latency_seconds", {}), f"{where}.scores.pending_latency_seconds"))
     elif scores is not None:
         errs.append(f"{where}.scores must be a dict")

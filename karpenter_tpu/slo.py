@@ -269,3 +269,10 @@ def routes() -> dict:
     tracing/profiling endpoints (cmd/controller.py wires it behind
     --enable-slo)."""
     return {"/debug/slo": _slo_route}
+
+
+def route_descriptions() -> dict:
+    """/debug-index descriptions, keyed like routes() (see tracing.py)."""
+    return {
+        "/debug/slo": "SLO snapshot: pending-latency/time-to-ready quantiles, cluster $/hr, cost drift, churn",
+    }

@@ -54,6 +54,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
+from ..flight import FLIGHT
 from ..ir.encode import DenseProblem, GroupKind, catalog_key, catalog_pin, encode_catalog, encode_problem, resource_vector
 from ..tracing import TRACER
 from ..scheduling.requirement import Requirement
@@ -349,6 +350,15 @@ class DenseSolver:
         # fill probe ask acceptance/freeness for the same pairs
         self._view_free_memo.clear()
         self._view_accepts_memo.clear()
+        # flight recorder (flight.py): open the compile-attribution window
+        # and snapshot cumulative stats so the record carries THIS solve's
+        # deltas. Both are gated — disabled telemetry allocates nothing.
+        flight_token = FLIGHT.begin_solve()
+        if flight_token is not None:
+            from dataclasses import replace as _stats_copy
+
+            stats_before = _stats_copy(self.stats)
+            self._flight_dispatch = None
 
         assemble_before = self.stats.assemble_seconds  # delta -> this solve's assemble child span
         mask_before = self.stats.mask_seconds  # delta -> this solve's mask child span
@@ -454,6 +464,39 @@ class DenseSolver:
         leftover.extend(problem.pods[row] for row in fallback_rows)
         self.stats.pods_committed += committed
         self.stats.pods_to_host += len(leftover)
+        flight_record = None
+        if flight_token is not None and FLIGHT.enabled:
+            dispatch = getattr(self, "_flight_dispatch", None) or {}
+            signature = {
+                **problem.shape_signature(),
+                "buckets": dispatch.get("buckets", len(buckets)),
+                "buckets_padded": dispatch.get("buckets_padded", len(buckets)),
+                "types_padded": dispatch.get("types_padded", problem.T),
+            }
+            stats = self.stats
+            flight_record = FLIGHT.complete_solve(
+                token=flight_token,
+                signature=signature,
+                dispatch=dispatch,
+                phases={
+                    "encode": stats.encode_seconds - stats_before.encode_seconds,
+                    "fill": stats.fill_seconds - stats_before.fill_seconds,
+                    "device": stats.device_seconds - stats_before.device_seconds,
+                    "mask": stats.mask_seconds - mask_before,
+                    "assemble": stats.assemble_seconds - assemble_before,
+                    "commit": stats.commit_seconds - stats_before.commit_seconds,
+                    "fill_device": stats.fill_device_seconds - stats_before.fill_device_seconds,
+                },
+                fill_routing={
+                    "fills_vectorized": stats.fills_vectorized - stats_before.fills_vectorized,
+                    "fills_host": stats.fills_host - stats_before.fills_host,
+                    "fill_pods_vectorized": stats.fill_pods_vectorized - stats_before.fill_pods_vectorized,
+                    "fill_pods_host": stats.fill_pods_host - stats_before.fill_pods_host,
+                },
+                pods_committed=committed,
+                pods_to_host=len(leftover),
+                duration=t3 - t0,
+            )
         if TRACER.enabled:
             # the measured phase boundaries as completed child spans under the
             # ambient solve span (tracing.py record_span): the per-solve half
@@ -461,7 +504,18 @@ class DenseSolver:
             # per trace, not just aggregated per bench run
             TRACER.record_span("encode", t0, t_encoded - t0, {"pods": problem.P, "groups": len(problem.groups)})
             TRACER.record_span("fill", t_encoded, t1 - t_encoded, {"on_existing": existing_committed})
-            device_ctx = TRACER.record_span("device", t1, t2 - t1, {"buckets": len(buckets)})
+            device_attrs = {"buckets": len(buckets)}
+            if flight_record is not None:
+                # compile/memory attribution on the span the drift hunts
+                # start from (the flight recorder's per-solve record carries
+                # the full detail keyed by the same solve)
+                device_attrs.update(
+                    recompiles=sum(flight_record.compiled_fns.values()),
+                    compile_seconds=round(flight_record.compile_seconds, 6),
+                    hbm_peak_bytes=flight_record.hbm_peak_bytes,
+                    flight_record=flight_record.id,
+                )
+            device_ctx = TRACER.record_span("device", t1, t2 - t1, device_attrs)
             mask = self.stats.mask_seconds - mask_before
             if mask > 0 and device_ctx is not None:
                 # offering-availability cube reduction (a device matmul at
@@ -1478,6 +1532,17 @@ class DenseSolver:
         B = len(buckets)
         mesh = self._active_mesh()
         use_pallas = mesh is None and self._pallas_enabled()
+        if FLIGHT.enabled:
+            # flight recorder: actual vs padded dispatch surface. The plain
+            # path pads nothing; the pallas/sharded paths overwrite the
+            # padded dims (and flavor, on mid-solve retirement) below.
+            self._flight_dispatch = {
+                "flavor": "sharded" if mesh is not None else ("pallas" if use_pallas else "plain"),
+                "buckets": B,
+                "types": problem.T,
+                "buckets_padded": B,
+                "types_padded": problem.T,
+            }
         zone_index = {z: i for i, z in enumerate(problem.zones)}
         ct_index = {c: i for i, c in enumerate(problem.capacity_types)}
 
@@ -1589,6 +1654,10 @@ class DenseSolver:
 
                 caps_dev, prices_dev = _catalog("pallas")
                 sum_p, max_p, allowed_p = pad_batch(bucket_stats, allowed)
+                if getattr(self, "_flight_dispatch", None) is not None:
+                    self._flight_dispatch.update(
+                        buckets_padded=int(allowed_p.shape[0]), types_padded=int(allowed_p.shape[1])
+                    )
                 packed_fut = bucket_type_cost_padded(
                     jnp.asarray(sum_p), jnp.asarray(max_p), caps_dev, prices_dev, jnp.asarray(allowed_p)
                 )
@@ -1596,6 +1665,8 @@ class DenseSolver:
                 type(self)._pallas_ok = False
                 use_pallas = False
                 log.warning("retiring Pallas kernel (compile/dispatch failure), falling back to jnp path: %r", exc)
+                if getattr(self, "_flight_dispatch", None) is not None:
+                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
                 packed_fut = _jnp_dispatch()
         else:
             try:
@@ -1609,6 +1680,8 @@ class DenseSolver:
                 self._mesh = None
                 mesh = None
                 log.warning("retiring solver mesh (dispatch failure), falling back to single device: %r", exc)
+                if getattr(self, "_flight_dispatch", None) is not None:
+                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
                 packed_fut = _plain_dispatch()
         if mesh is not None:
             self.stats.sharded_batches += 1
@@ -1666,12 +1739,16 @@ class DenseSolver:
             if use_pallas:
                 type(self)._pallas_ok = False  # runtime failure: retire the kernel
                 log.warning("retiring Pallas kernel (runtime failure), falling back to jnp path: %r", exc)
+                if getattr(self, "_flight_dispatch", None) is not None:
+                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
                 packed = np.asarray(_jnp_dispatch())[:, :B]
             elif mesh is not None:
                 self._mesh = None
                 mesh = None
                 log.warning("retiring solver mesh (runtime failure), falling back to single device: %r", exc)
                 self.stats.sharded_batches -= 1
+                if getattr(self, "_flight_dispatch", None) is not None:
+                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
                 packed = np.asarray(_plain_dispatch())[:, :B]
             else:
                 raise
@@ -1740,6 +1817,8 @@ class DenseSolver:
         pods_dim = mesh.shape["pods"]
         B = bucket_stats.shape[1]
         Bp = max(-(-B // pods_dim) * pods_dim, pods_dim)
+        if getattr(self, "_flight_dispatch", None) is not None:
+            self._flight_dispatch.update(flavor="sharded", buckets_padded=int(Bp), types_padded=int(Tp))
         stats_p = np.zeros((2, Bp, bucket_stats.shape[2]), np.float32)
         stats_p[:, :B] = bucket_stats
         allowed_p = np.zeros((Bp, Tp), dtype=bool)
@@ -1749,6 +1828,9 @@ class DenseSolver:
             # mesh (parallel/peers.py); result is already replicated numpy
             return self.peer_fabric.dispatch(stats_p, np.asarray(caps_dev), np.asarray(prices_dev), allowed_p)
         fn = make_sharded_bucket_cost(mesh)
+        if FLIGHT.enabled:
+            # per-mesh wrappers share one {fn} label; registration dedupes
+            FLIGHT.register_jit_entry("sharded_bucket_cost", fn)
         return fn(
             place(mesh, stats_p, P(None, "pods", None)),
             caps_dev,

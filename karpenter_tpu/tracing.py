@@ -605,3 +605,13 @@ def routes() -> dict:
     live-profiling endpoints (cmd/controller.py wires them behind
     --enable-tracing)."""
     return {"/debug/traces": _traces_route, "/debug/decisions": _decisions_route}
+
+
+def route_descriptions() -> dict:
+    """One-line /debug-index descriptions, keyed like routes() — owned here
+    so the index (observability.debug_index_route) can never drift from the
+    paths this module actually serves."""
+    return {
+        "/debug/traces": "recent trace index; ?id= span tree, &format=chrome Perfetto export",
+        "/debug/decisions": "per-pod scheduling decision records; ?pod=, ?outcome=, ?limit=",
+    }

@@ -41,6 +41,12 @@ class Options:
     # karpenter_lockwitness_* families. Off by default — disabled means the
     # shared classes get PLAIN threading locks, zero wrapper overhead
     enable_lock_witness: bool = False
+    # solver flight recorder (flight.py): per-solve shape/phase records, XLA
+    # compile-churn attribution, HBM gauges, served on /debug/solver over
+    # the metrics port. Off by default — disabled telemetry is a true no-op
+    # on the solve path (same bar as tracing)
+    enable_solver_telemetry: bool = False
+    flight_ring_size: int = 128  # per-solve records retained (bounded ring)
     leader_elect: bool = True
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -109,6 +115,8 @@ class Options:
             errs.append("ice backoff must be positive")
         if self.trace_ring_size <= 0:
             errs.append("trace ring size must be positive")
+        if self.flight_ring_size <= 0:
+            errs.append("flight ring size must be positive")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -139,7 +147,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--enable-tracing", action="store_true", default=_env("ENABLE_TRACING", defaults.enable_tracing))
     parser.add_argument("--enable-slo", action="store_true", default=_env("ENABLE_SLO", defaults.enable_slo))
     parser.add_argument("--enable-lock-witness", action="store_true", default=_env("ENABLE_LOCK_WITNESS", defaults.enable_lock_witness))
+    parser.add_argument("--enable-solver-telemetry", action="store_true", default=_env("ENABLE_SOLVER_TELEMETRY", defaults.enable_solver_telemetry))
     parser.add_argument("--trace-ring-size", type=int, default=_env("TRACE_RING_SIZE", defaults.trace_ring_size))
+    parser.add_argument("--flight-ring-size", type=int, default=_env("FLIGHT_RING_SIZE", defaults.flight_ring_size))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
     parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))

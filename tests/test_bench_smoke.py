@@ -26,11 +26,25 @@ def test_bench_smoke():
     assert len(provenance["config_hash"]) == 16
     # every config ran and reported its structural counters
     queue_attrs = summary.pop("interruption_queue")
+    # the steady-state recompile gate ran and held: re-solving warm shapes
+    # compiled nothing (the flight recorder's headline property)
+    assert summary.pop("steady_state_recompiles") == 0
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name
         # the per-pod fill routing counters are part of the schema
         assert "fill_pods_vectorized" in info and "fill_pods_host" in info, name
+        # the offering-availability mask stat + phase key are part of the
+        # schema for EVERY config (PR 9 follow-up: previously only the
+        # ice_mask shape was asserted)
+        assert "masked_offerings" in info and "mask_seconds" in info, name
+        assert info["masked_offerings"] >= 0 and info["mask_seconds"] >= 0, name
+        # device-runtime telemetry (flight.py): per-config compile counts
+        # and HBM accounting are part of the smoke schema. Counts are
+        # structural, not zero-asserted — a shared tier-1 process may have
+        # compiled these shapes already
+        assert info["compilations"] >= 0 and info["compile_seconds"] >= 0, name
+        assert info["hbm_peak_bytes"] >= 0 and info["hbm_live_bytes"] >= 0, name
         # tracing regression gate: every config's solve emitted a non-empty
         # span tree whose dense phase children are disjoint sub-intervals of
         # the solve (encode+device+commit must not exceed the parent) — an
@@ -40,6 +54,10 @@ def test_bench_smoke():
         children = {c["name"]: c["duration_ms"] for c in tree["children"]}
         assert {"encode", "device", "commit"} <= set(children), (name, sorted(children))
         assert children["encode"] + children["device"] + children["commit"] <= tree["duration_ms"] + 1e-3, name
+        # the device span carries the flight recorder's compile/HBM stamp
+        device = next(c for c in tree["children"] if c["name"] == "device")
+        assert "recompiles" in device["attributes"], name
+        assert "hbm_peak_bytes" in device["attributes"], name
     # the repack shape exercised the vectorized warm fill specifically
     assert summary["repack"]["fills_vectorized"] >= 1
     assert summary["repack"]["fill_pods_vectorized"] >= 1

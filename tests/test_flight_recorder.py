@@ -1,0 +1,447 @@
+"""Solver flight recorder (flight.py): per-solve records, compile-churn
+attribution, HBM accounting, the /debug/solver + /debug read surfaces.
+
+The load-bearing test is the steady-state recompile gate: after warmup, a
+settled configuration re-solving must trigger ZERO new XLA compilations —
+the property ROADMAP item 1 (incremental steady-state solve) will be gated
+on — with a negative control proving the instrument actually fires (a shape
+change increments the counter and the record names the changed dimension).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import flight
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.flight import FLIGHT, FlightRecorder
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from tests.helpers import make_pod, make_provisioner
+
+
+@pytest.fixture()
+def recorder():
+    """The process-wide recorder, enabled for one test and restored after
+    (tier-1 shares one process; a leaked enable would tax unrelated tests)."""
+    was_enabled = FLIGHT.enabled
+    FLIGHT.enable()
+    FLIGHT.reset()
+    yield FLIGHT
+    if not was_enabled:
+        FLIGHT.disable()
+    FLIGHT.reset()
+
+
+def _solve_once(solver, provider, pods):
+    scheduler = build_scheduler([make_provisioner()], provider, pods, dense_solver=solver)
+    return scheduler.solve(pods)
+
+
+def _workload(count=300):
+    return [make_pod(requests={"cpu": 1, "memory": "1Gi"}) for _ in range(count)]
+
+
+class TestSteadyStateRecompileGate:
+    def test_warm_resolves_compile_nothing(self, recorder):
+        """THE gate: repeated same-config solves after warmup must not
+        compile — per the monitoring listener AND every record's flag."""
+        provider = FakeCloudProvider(instance_types(50))
+        pods = _workload(300)
+        solver = DenseSolver(min_batch=1)
+        for _ in range(2):  # warmup: trace + compile every shape once
+            _solve_once(solver, provider, pods)
+        base = recorder.compilations_total()
+        first_steady = len(recorder.records())
+        for _ in range(3):
+            _solve_once(solver, provider, pods)
+        assert recorder.compilations_total() - base == 0, "steady-state re-solve recompiled"
+        steady_records = recorder.records()[first_steady:]
+        assert len(steady_records) == 3
+        for record in steady_records:
+            assert record.recompile is False
+            assert record.compiled_fns == {}
+
+    def test_shape_change_attributed_to_changed_dimension(self, recorder):
+        """Negative control: growing the type universe must increment the
+        compile counter and the record must NAME the changed dimension."""
+        pods = _workload(300)
+        solver = DenseSolver(min_batch=1)
+        _solve_once(solver, FakeCloudProvider(instance_types(53)), pods)
+        _solve_once(solver, FakeCloudProvider(instance_types(53)), pods)  # settle
+        base = recorder.compilations_total()
+        _solve_once(solver, FakeCloudProvider(instance_types(59)), pods)
+        assert recorder.compilations_total() - base > 0, "shape change did not compile"
+        record = recorder.records()[-1]
+        assert record.recompile is True
+        assert record.compiled_fns, "recompile with no attributed entries"
+        assert "types" in record.recompile_attribution, record.recompile_attribution
+
+    def test_first_solve_is_cold_start(self):
+        """A recompile with no previous record attributes to cold-start, not
+        to a phantom dimension."""
+        fresh = FlightRecorder()
+        fresh.enable()
+        try:
+            token = fresh.begin_solve()
+            # simulate one compile event landing inside the window (the
+            # process-wide tally the single jax.monitoring listener feeds)
+            with flight._TALLY._lock:
+                flight._TALLY.events += 1
+                flight._TALLY.seconds += 0.01
+            record = fresh.complete_solve(
+                token=token,
+                signature={"pods": 10, "types": 5, "buckets": 1, "buckets_padded": 1, "types_padded": 5},
+                dispatch={"flavor": "plain"},
+                phases={},
+                fill_routing={},
+                pods_committed=10,
+                pods_to_host=0,
+                duration=0.01,
+            )
+            assert record.recompile is True
+            assert record.recompile_attribution == ["cold-start"]
+            assert record.compiled_fns.get("other") == 1
+        finally:
+            fresh.disable()
+
+
+class TestRecordContents:
+    def test_record_shapes_phases_and_hbm(self, recorder):
+        provider = FakeCloudProvider(instance_types(50))
+        solver = DenseSolver(min_batch=1)
+        _solve_once(solver, provider, _workload(300))
+        record = recorder.records()[-1]
+        sig = record.signature
+        assert sig["pods"] == 300
+        assert sig["types"] == 50
+        assert sig["buckets"] >= 1
+        assert sig["zones"] >= 1 and sig["capacity_types"] >= 1 and sig["resources"] >= 1
+        # padded >= actual, and the waste figure is consistent with them
+        assert sig["buckets_padded"] >= sig["buckets"]
+        assert sig["types_padded"] >= sig["types"]
+        assert 0.0 <= record.padding_waste_pct < 100.0
+        assert record.dispatch in ("plain", "pallas", "sharded")
+        # every DenseSolveStats phase, mask included, as THIS solve's delta
+        assert set(record.phases) == {"encode", "fill", "device", "mask", "assemble", "commit", "fill_device"}
+        assert all(v >= 0 for v in record.phases.values())
+        assert record.phases["device"] > 0
+        assert set(record.fill_routing) == {
+            "fills_vectorized", "fills_host", "fill_pods_vectorized", "fill_pods_host",
+        }
+        assert record.pods_committed == 300
+        assert record.duration_seconds > 0
+        # HBM accounting: gauges track the record
+        assert record.hbm_peak_bytes >= 0 and record.hbm_live_bytes >= 0
+        assert flight.HBM_PEAK.value() == float(record.hbm_peak_bytes)
+        assert flight.HBM_LIVE.value() == float(record.hbm_live_bytes)
+
+    def test_device_span_carries_compile_and_hbm_attributes(self, recorder):
+        from karpenter_tpu.tracing import TRACER
+
+        was_enabled = TRACER.enabled
+        TRACER.enable()
+        try:
+            TRACER.reset()
+            provider = FakeCloudProvider(instance_types(50))
+            _solve_once(DenseSolver(min_batch=1), provider, _workload(300))
+            tree = TRACER.span_tree(TRACER.last_trace_id())
+            device = next(c for c in tree["children"] if c["name"] == "device")
+            attrs = device["attributes"]
+            assert "recompiles" in attrs and "hbm_peak_bytes" in attrs and "compile_seconds" in attrs
+            assert attrs["flight_record"] == recorder.records()[-1].id
+        finally:
+            if not was_enabled:
+                TRACER.disable()
+            TRACER.reset()
+
+    def test_ring_is_bounded(self):
+        fresh = FlightRecorder(capacity=4)
+        fresh.enable()
+        try:
+            for i in range(7):
+                token = fresh.begin_solve()
+                fresh.complete_solve(
+                    token=token,
+                    signature={"pods": i},
+                    dispatch=None,
+                    phases={},
+                    fill_routing={},
+                    pods_committed=0,
+                    pods_to_host=0,
+                    duration=0.0,
+                )
+            records = fresh.records()
+            assert len(records) == 4
+            # oldest evicted, ids still monotonic
+            assert [r.id for r in records] == [3, 4, 5, 6]
+        finally:
+            fresh.disable()
+
+    def test_two_enabled_recorders_install_one_listener(self):
+        """jax.monitoring has no unregister: a second enabled recorder must
+        reuse the process-wide tally's single listener, or every compile
+        would double into karpenter_jax_compile_seconds_total."""
+        a, b = FlightRecorder(), FlightRecorder()
+        a.enable()
+        b.enable()
+        try:
+            from jax._src import monitoring as mon
+
+            listeners = getattr(mon, "_event_duration_secs_listeners", None)
+            if listeners is None:
+                pytest.skip("jax.monitoring internals moved; listener count not inspectable")
+            ours = [
+                cb for cb in listeners
+                if "_CompileTally" in getattr(cb, "__qualname__", "")
+            ]
+            assert len(ours) == 1, f"{len(ours)} compile listeners installed"
+        finally:
+            a.disable()
+            b.disable()
+
+    def test_register_jit_entry_bounds_wrapper_generations(self):
+        """The sharded path can mint a wrapper per mesh generation; the
+        registry must not pin every generation's executables forever."""
+        fresh = FlightRecorder()
+
+        class FakeJitted:
+            def _cache_size(self):
+                return 1
+
+        for _ in range(FlightRecorder.MAX_FNS_PER_ENTRY + 5):
+            fresh.register_jit_entry("sharded_bucket_cost", FakeJitted())
+        assert len(fresh._entries["sharded_bucket_cost"]) == FlightRecorder.MAX_FNS_PER_ENTRY
+
+    def test_register_jit_entry_dedupes_and_ignores_uncacheable(self):
+        fresh = FlightRecorder()
+
+        class FakeJitted:
+            def _cache_size(self):
+                return 2
+
+        fn = FakeJitted()
+        fresh.register_jit_entry("fake", fn)
+        fresh.register_jit_entry("fake", fn)  # same object: no-op
+        assert len(fresh._entries["fake"]) == 1
+        fresh.register_jit_entry("fake", FakeJitted())  # sibling wrapper: sums
+        assert fresh._cache_sizes()["fake"] == 4
+        fresh.register_jit_entry("plain", object())  # no _cache_size: ignored
+        assert "plain" not in fresh._entries
+
+
+class TestDisabledIsFree:
+    def test_disabled_recorder_allocates_nothing(self):
+        """The acceptance bar (same as tracing/SLO): disabled telemetry
+        keeps no ring, opens no window, appends no record."""
+        fresh = FlightRecorder()
+        assert fresh._ring is None
+        assert fresh.begin_solve() is None
+        assert fresh.records() == []
+        assert fresh.record_by_id(0) is None
+
+    def test_disabled_solve_records_nothing(self):
+        was_enabled = FLIGHT.enabled
+        FLIGHT.disable()
+        try:
+            before = len(FLIGHT.records())
+            provider = FakeCloudProvider(instance_types(50))
+            _solve_once(DenseSolver(min_batch=1), provider, _workload(300))
+            assert len(FLIGHT.records()) == before
+        finally:
+            if was_enabled:
+                FLIGHT.enable()
+
+    def test_enabled_overhead_within_bound(self, recorder):
+        """Regression tripwire, not a microbenchmark: recording a solve
+        (cache-size polls + an HBM snapshot + one record) must stay within
+        the tracing bar relative to the solve itself."""
+        provider = FakeCloudProvider(instance_types(50))
+        pods = _workload(300)
+        solver = DenseSolver(min_batch=1)
+        _solve_once(solver, provider, pods)  # warmup/compile
+
+        def churn(enabled: bool) -> float:
+            if enabled:
+                FLIGHT.enable()
+            else:
+                FLIGHT.disable()
+            start = time.perf_counter()
+            for _ in range(3):
+                _solve_once(solver, provider, pods)
+            return time.perf_counter() - start
+
+        plain, recorded = [], []
+        for _ in range(3):
+            plain.append(churn(False))
+            recorded.append(churn(True))
+        base, with_flight = min(plain), min(recorded)
+        assert with_flight <= base * 3.0 + 0.25, (
+            f"flight overhead too high: {with_flight * 1000:.1f}ms enabled vs {base * 1000:.1f}ms disabled"
+        )
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+class TestSolverRoute:
+    @pytest.fixture()
+    def server(self, recorder):
+        from karpenter_tpu.observability import ObservabilityServer, debug_index_route
+
+        routes = dict(flight.routes())
+        routes["/debug"] = debug_index_route({"/debug/solver": "solver flight recorder"})
+        srv = ObservabilityServer(
+            healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=0, extra_routes=routes
+        )
+        srv.start()
+        yield srv.ports[0]
+        srv.stop()
+
+    def test_index_and_detail(self, server, recorder):
+        provider = FakeCloudProvider(instance_types(50))
+        _solve_once(DenseSolver(min_batch=1), provider, _workload(300))
+        status, body = _get(server, "/debug/solver")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["records"], "index must list the recorded solve"
+        assert "compilations_total" in payload and "compile_seconds_total" in payload
+        assert "hbm_peak_bytes" in payload
+        newest = payload["records"][0]
+        status, body = _get(server, f"/debug/solver?id={newest['id']}")
+        assert status == 200
+        detail = json.loads(body)
+        assert detail["id"] == newest["id"]
+        assert detail["signature"]["pods"] == 300
+        assert "phases" in detail and "recompile_attribution" in detail
+
+    def test_unknown_and_malformed_ids_are_404_json(self, server):
+        """The tracing routes' contract: unknown ids answer 404 with a JSON
+        body, never a 500 or an HTML error page."""
+        status, body = _get(server, "/debug/solver?id=999999")
+        assert status == 404
+        payload = json.loads(body)
+        assert payload["status"] == 404 and "not found" in payload["error"]
+        status, body = _get(server, "/debug/solver?id=bogus")
+        assert status == 404
+        assert json.loads(body)["status"] == 404
+
+    def test_debug_index_lists_endpoints(self, server):
+        status, body = _get(server, "/debug")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["endpoints"] == [
+            {"path": "/debug/solver", "description": "solver flight recorder"}
+        ]
+
+
+class TestDebugIndexRoute:
+    def test_enumerates_sorted_with_descriptions(self):
+        from karpenter_tpu.observability import debug_index_route
+
+        route = debug_index_route({"/debug/traces": "traces", "/debug/locks": "locks"})
+        status, content_type, body = route({})
+        assert status == 200 and "json" in content_type
+        payload = json.loads(body)
+        assert [e["path"] for e in payload["endpoints"]] == ["/debug/locks", "/debug/traces"]
+        assert all(e["description"] for e in payload["endpoints"])
+
+    def test_empty_registration_is_valid_json(self):
+        from karpenter_tpu.observability import debug_index_route
+
+        status, _, body = debug_index_route({})({})
+        assert status == 200
+        assert json.loads(body) == {"endpoints": []}
+
+    def test_module_descriptions_match_their_routes(self):
+        """Every debug module's route_descriptions() must key exactly its
+        routes() — cmd/controller.py builds the /debug index from these
+        pairs, so a drifted key would list a dead path or hide a live one."""
+        from karpenter_tpu import slo, tracing
+        from karpenter_tpu.analysis import witness
+        from karpenter_tpu.profiling import LiveProfiler
+
+        for mod in (tracing, slo, witness, flight):
+            assert set(mod.route_descriptions()) == set(mod.routes()), mod.__name__
+        profiler = LiveProfiler()
+        assert set(profiler.route_descriptions()) == set(profiler.routes())
+
+
+def test_live_process_serves_debug_and_solver_json():
+    """Tier-1 deployment-shape gate: a real controller process launched with
+    --enable-solver-telemetry serves valid JSON from /debug (the endpoint
+    index) and /debug/solver, with 404-shaped JSON for unknown ids —
+    the same contract the in-process route tests pin, proved over a socket
+    against the shipped entry point."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def free_port():
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            return sock.getsockname()[1]
+
+    health_port, metrics_port = free_port(), free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KUBERNETES_APISERVER_URL", None)  # in-memory backend
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "karpenter_tpu.cmd.controller",
+            "--disable-dense-solver",
+            "--enable-solver-telemetry",
+            "--enable-tracing",
+            "--health-probe-port", str(health_port),
+            "--metrics-port", str(metrics_port),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status, body = _get(metrics_port, "/debug")
+                break
+            except OSError:
+                assert proc.poll() is None, f"controller died: {proc.communicate()[1][-2000:]}"
+                time.sleep(0.2)
+        assert status == 200, "controller never served /debug"
+        index = json.loads(body)
+        paths = {e["path"] for e in index["endpoints"]}
+        # both wired features are discoverable, each with a description
+        assert {"/debug/solver", "/debug/traces", "/debug/decisions"} <= paths
+        assert all(e["description"] for e in index["endpoints"])
+        status, body = _get(metrics_port, "/debug/solver")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["records"] == []  # dense solver disabled: no solves recorded
+        status, body = _get(metrics_port, "/debug/solver?id=12345")
+        assert status == 404
+        assert json.loads(body)["status"] == 404
+    finally:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()

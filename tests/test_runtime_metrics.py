@@ -150,6 +150,56 @@ class TestMetricsRegistry:
         summary.clear()
         assert summary.series() == [] and summary.count(provisioner="default") == 0
 
+    def test_summary_quantile_empty_series_is_nan(self):
+        """The SLO scoring path (slo.py _quantile_block, campaign p95)
+        leans on NaN-for-empty: an unobserved series must answer NaN from
+        quantile() and emit no quantile samples from collect()."""
+        import math
+
+        registry = Registry()
+        summary = registry.summary("empty_summary", "help", ("provisioner",))
+        assert math.isnan(summary.quantile(0.5))
+        assert math.isnan(summary.quantile(0.5, provisioner="never-observed"))
+        assert list(summary.collect()) == []
+
+    def test_summary_quantile_single_observation(self):
+        """One sample answers that sample for EVERY quantile — the
+        first-pod-of-a-run case the campaign smoke scores."""
+        registry = Registry()
+        summary = registry.summary("single_summary", "help")
+        summary.observe(2.5)
+        for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+            assert summary.quantile(q) == 2.5
+
+    def test_summary_quantile_objective_boundaries(self):
+        """q=0.0 is the minimum, q=1.0 the maximum (the index clamp), and
+        an interior objective never exceeds the maximum."""
+        registry = Registry()
+        summary = registry.summary("boundary_summary", "help")
+        for value in (5.0, 1.0, 3.0, 2.0, 4.0):  # unsorted on purpose
+            summary.observe(value)
+        assert summary.quantile(0.0) == 1.0
+        assert summary.quantile(1.0) == 5.0
+        assert summary.quantile(0.99) <= 5.0
+        assert summary.quantile(0.5) == 3.0
+
+    def test_summary_clear_then_observe(self):
+        """clear() between campaign runs must not poison the next run: new
+        observations rebuild samples, counts, and sums from zero."""
+        registry = Registry()
+        summary = registry.summary("reset_summary", "help", ("provisioner",))
+        for i in range(10):
+            summary.observe(100.0 + i, provisioner="default")
+        summary.clear()
+        summary.observe(1.0, provisioner="default")
+        assert summary.quantile(0.99, provisioner="default") == 1.0
+        assert summary.count(provisioner="default") == 1
+        assert summary.sum(provisioner="default") == 1.0
+        # the old run's samples are gone from the exposition too
+        samples = list(summary.collect())
+        values = [value for labels, value, suffix in samples if suffix == ""]
+        assert all(v == 1.0 for v in values)
+
 
 class TestScrapers:
     def test_node_and_pod_and_provisioner_scrape(self):

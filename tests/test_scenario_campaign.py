@@ -59,6 +59,8 @@ class TestSchemaValidator:
                         "restarts": 0,
                         "launch_failures": 0,
                         "unschedulable_pod_seconds": 0.4,
+                        "recompiles_total": 0,
+                        "solver_latency_p95_seconds": 0.01,
                     },
                     "samples": [
                         {"t": 0.0, "pending_pods": 4, "nodes": 0, "cost_per_hour": 0.0, "disrupting": 0},
@@ -97,6 +99,20 @@ class TestSchemaValidator:
         errors = scenario_doc_errors(doc)
         assert any("launch_failures" in e for e in errors)
         assert any("unschedulable_pod_seconds" in e for e in errors)
+
+    def test_solver_telemetry_scores_required_and_typed(self):
+        doc = self._valid_doc()
+        del doc["runs"][0]["scores"]["recompiles_total"]
+        assert any("recompiles_total" in e for e in scenario_doc_errors(doc))
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["recompiles_total"] = 1.5
+        assert any("recompiles_total" in e for e in scenario_doc_errors(doc))
+        # the p95 is nullable (a run that never solved) but never negative
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["solver_latency_p95_seconds"] = None
+        assert scenario_doc_errors(doc) == []
+        doc["runs"][0]["scores"]["solver_latency_p95_seconds"] = -0.1
+        assert any("solver_latency_p95_seconds" in e for e in scenario_doc_errors(doc))
 
     def test_empty_runs_rejected(self):
         doc = self._valid_doc()
@@ -148,6 +164,12 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     # the pending integral is a finite non-negative pod-seconds figure
     assert scores["launch_failures"] == 0
     assert scores["unschedulable_pod_seconds"] >= 0
+    # solver-telemetry scores: the smoke runtime solves on the host path
+    # (dense disabled), so the steady-state property is exact — zero XLA
+    # compilations — while the latency summary still observed every real
+    # provisioning solve
+    assert scores["recompiles_total"] == 0
+    assert scores["solver_latency_p95_seconds"] is None or scores["solver_latency_p95_seconds"] >= 0
     # samples cover the whole run with monotonic timestamps (also schema-
     # checked) and the final sample sees the converged cluster
     assert len(run["samples"]) >= 3
