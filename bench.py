@@ -436,6 +436,15 @@ def smoke() -> dict:
             FLIGHT.disable()
 
 
+# smoke configs whose workloads carry NO multi-rule affinity cohorts (every
+# cohort holds at most one extra integer rule — the certified vectorized
+# case): their fill stream must never route a pod through the host loop.
+# Today that is EVERY smoke config; a future config seeding multi-rule
+# cohorts (the PR 1 deferral, ROADMAP item 5) gets added here only once the
+# device-side rule kernel lands.
+SMOKE_ZERO_HOST_FILL_CONFIGS = ("anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask")
+
+
 def _smoke() -> dict:
     from karpenter_tpu import flight
     from karpenter_tpu.api.objects import Taint
@@ -444,6 +453,10 @@ def _smoke() -> dict:
     from tests.helpers import make_pod, make_provisioner
 
     summary: dict = {}
+    # flight records created by THIS smoke run (a shared tier-1 process may
+    # hold earlier records): everything after this id is ours
+    _prior = flight.FLIGHT.records()
+    smoke_first_record_id = (_prior[-1].id + 1) if _prior else 0
 
     def check(name, pods, provider, provisioners, state_nodes=(), repack=False):
         solver = DenseSolver(min_batch=1)
@@ -492,7 +505,19 @@ def _smoke() -> dict:
             "hbm_live_bytes": hbm_live,
             "span_tree": span_tree,
         }
-        log(f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes)")
+        # host-fallback residue gate (ROADMAP item 5): a config with no
+        # multi-rule affinity cohorts must keep its whole fill stream on the
+        # vectorized path — a nonzero host-routed pod count is a plan()
+        # fail-open regression, not a workload property
+        if name in SMOKE_ZERO_HOST_FILL_CONFIGS:
+            assert stats.fill_pods_host == 0, (
+                f"[{name}] {stats.fill_pods_host} pod(s) routed through the host fill loop "
+                f"on a config with no multi-rule affinity cohorts"
+            )
+        log(
+            f"  [smoke:{name}] ok ({elapsed*1000:.0f} ms, {nodes} nodes, "
+            f"fill_pods_host={stats.fill_pods_host})"
+        )
 
     log("smoke: anti_spread (headline shape, scaled)")
     check("anti_spread", build_workload(700, seed=42), FakeCloudProvider(instance_types(100)), [make_provisioner()])
@@ -590,6 +615,23 @@ def _smoke() -> dict:
     steady = flight.FLIGHT.compilations_total() - steady_base
     assert steady == 0, f"steady-state re-solve recompiled {steady} XLA programs"
     summary["steady_state_recompiles"] = steady
+
+    # program-contract cross-check (analysis/contracts.py): every recompile
+    # the flight recorder attributed during this smoke run must be explained
+    # by an axis the committed SOLVER_CONTRACTS.json declares varying for
+    # that entry — a recompile on a declared-static axis is a contract
+    # violation and fails here with both the static declaration and the
+    # observed signature change printed
+    log("smoke: recompile-axis contract cross-check")
+    import os as _os
+
+    from karpenter_tpu.analysis import contracts as _contracts
+
+    doc = _contracts.load_committed(_os.path.dirname(_os.path.abspath(__file__)))
+    smoke_records = [r for r in flight.FLIGHT.records() if r.id >= smoke_first_record_id]
+    violations = _contracts.recompile_violations(smoke_records, doc)
+    assert not violations, "recompile-axis contract violations:\n" + "\n".join(violations)
+    summary["contract_recompile_violations"] = len(violations)
 
     summary["provenance"] = bench_provenance("smoke")
     summary["ok"] = True

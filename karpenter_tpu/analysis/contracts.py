@@ -1,0 +1,627 @@
+"""Solver program contracts: the compile-free jaxpr audit behind
+`analyze --contracts`.
+
+PR 8's AST tier (rules/*.py) reads *source*; this second tier reads the
+*programs*: every registered jit entry of the solver pipeline (the same
+registry flight.py attributes compile churn to) is abstractly interpreted
+with `jax.make_jaxpr` over the bench shape grid — no XLA compile, no
+device — and the facts that govern the incremental steady-state solve
+(ROADMAP item 1) are extracted into a committed machine-readable contract,
+`SOLVER_CONTRACTS.json`:
+
+- **recompile axes** — per entry, which named shape dimensions (the flight
+  recorder's signature vocabulary) are *declared varying* (a change is an
+  expected retrace) vs *declared static* (a change recompiling this entry
+  is a contract violation). The flight recorder's runtime recompile
+  attribution is cross-checked against this declaration by the bench smoke
+  gate (`recompile_violations`).
+- **dtype surface** — every input/output dtype, weak-type leaks on
+  outputs, and x64-sensitivity: the entry is re-traced under
+  `jax.experimental.enable_x64()` with the SAME pinned f32/i32 inputs, and
+  any 64-bit intermediate that appears means the program's dtype
+  discipline depends on the global flag instead of pinned dtypes — the
+  silent f64/i64 promotion class.
+- **donation coverage** — which inputs are donated (`donated_invars` read
+  straight off the traced pjit equation), which donations XLA would reject
+  (no byte-size-matched output buffer to alias), and which large inputs
+  are donation *candidates* left undonated (an unclaimed output of equal
+  byte size exists at every grid point — the `donate_argnums` debt the
+  incremental solve needs paid).
+- **captured-constant bytes** — concrete arrays closed over and baked into
+  the jaxpr (every nested sub-jaxpr is walked). Baked constants ride along
+  with every compiled executable; the current solver surface is pinned at
+  zero bytes.
+
+Violations become `Finding`s (rules/programcheck.py) and flow through the
+SAME justified-baseline machinery as the AST tier — one baseline.json, one
+(rule, path, scope, key) suppression shape, one workflow.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..flight import _SIGNATURE_DIMS
+
+CONTRACTS_BASENAME = "SOLVER_CONTRACTS.json"
+SCHEMA_VERSION = 1
+
+# the flight recorder's recompile-attribution vocabulary, imported (not
+# duplicated) so contracts declare varying/static in exactly the terms the
+# runtime cross-check compares — a dimension added to flight.py can never
+# silently read as declared-static here
+FLIGHT_DIMS = tuple(_SIGNATURE_DIMS)
+
+# The bench shape grid the entries are audited over: BASE mirrors the smoke
+# configs' scaled shapes, ALT perturbs every runtime-varying dimension so
+# (a) re-tracing across the varying surface is proven and (b) donation
+# byte-size matches are structural, not a numeric coincidence of one point.
+# "resources" is deliberately identical in both: it is the canonical
+# declared-STATIC axis (the encode's resource arity never changes within a
+# deployment), and the grid embodies that.
+GRID_BASE: Dict[str, int] = {
+    "pods": 304,
+    "groups": 8,
+    "buckets": 24,
+    "types": 56,
+    "zones": 3,
+    "capacity_types": 2,
+    "resources": 3,
+    "segments": 41,
+    "sizes": 16,
+    "views": 48,
+    "bins": 40,
+    "offerings": 6,  # zones x capacity_types, flattened
+    "buckets_padded": 24,
+    "types_padded": 128,
+    "sizes_padded": 16,
+    "views_padded": 128,
+}
+GRID_ALT: Dict[str, int] = {
+    **GRID_BASE,
+    "pods": 712,
+    "groups": 12,
+    "buckets": 40,
+    "types": 104,
+    "zones": 4,
+    "capacity_types": 3,
+    "segments": 57,
+    "sizes": 24,
+    "views": 160,
+    "bins": 56,
+    "offerings": 12,
+    "buckets_padded": 40,
+    "types_padded": 256,
+    "sizes_padded": 24,
+    "views_padded": 256,
+}
+
+
+@dataclass(frozen=True)
+class ArgSpec:
+    """One array argument: named axes (grid dims or literal ints) + dtype."""
+
+    name: str
+    axes: Tuple[object, ...]  # str grid-dim names or int literals
+    dtype: str  # numpy dtype name
+
+    def shape(self, dims: Dict[str, int]) -> Tuple[int, ...]:
+        return tuple(dims[a] if isinstance(a, str) else int(a) for a in self.axes)
+
+
+@dataclass(frozen=True)
+class EntrySpec:
+    """One registered jit entry: how to build it, its abstract argument
+    surface, and its declared recompile contract."""
+
+    name: str  # MUST match the flight recorder's register_jit_entry label
+    module: str  # repo-relative path, forward slashes
+    resolve: Callable[[Dict[str, int]], object]  # dims -> jitted callable
+    args: Tuple[ArgSpec, ...]
+    varying: Tuple[str, ...]  # FLIGHT_DIMS declared runtime-varying
+    # trailing static (hashed) arguments: (name, grid-dim name or literal)
+    static_args: Tuple[Tuple[str, object], ...] = ()
+
+    def static_values(self, dims: Dict[str, int]) -> Tuple[object, ...]:
+        return tuple(dims[v] if isinstance(v, str) and v in dims else v for _, v in self.static_args)
+
+
+def _audit_mesh():
+    """The deterministic 1-device CPU mesh the per-mesh wrappers are audited
+    on: the contract facts (avals, donation, consts) are mesh-shape
+    independent, and pinning CPU keeps the committed JSON identical across
+    hosts with and without accelerators."""
+    from ..parallel.mesh import solver_mesh
+
+    return solver_mesh(1, types_parallel=1, prefer_cpu=True)
+
+
+def _resolve_plain(module_name: str, attr: str):
+    def resolve(dims: Dict[str, int]):
+        import importlib
+
+        return getattr(importlib.import_module(module_name), attr)
+
+    return resolve
+
+
+def _resolve_sharded_step(dims: Dict[str, int]):
+    from ..parallel.sharded import make_sharded_solve_step
+
+    return make_sharded_solve_step(_audit_mesh(), dims["bins"])
+
+
+def _resolve_sharded_bucket_cost(dims: Dict[str, int]):
+    from ..parallel.sharded import make_sharded_bucket_cost
+
+    return make_sharded_bucket_cost(_audit_mesh())
+
+
+def default_entries() -> Tuple[EntrySpec, ...]:
+    """The audited program surface. Names match flight.py's registered
+    {fn} labels exactly — the runtime cross-check joins on them."""
+    f32, i32, b8, i8 = "float32", "int32", "bool", "int8"
+    ops = "karpenter_tpu.ops."
+    return (
+        EntrySpec(
+            name="resource_fit",
+            module="karpenter_tpu/ops/feasibility.py",
+            resolve=_resolve_plain(ops + "feasibility", "resource_fit"),
+            args=(ArgSpec("requests", ("pods", "resources"), f32), ArgSpec("caps", ("types", "resources"), f32)),
+            varying=("pods", "types"),
+        ),
+        EntrySpec(
+            name="feasibility_mask",
+            module="karpenter_tpu/ops/feasibility.py",
+            resolve=_resolve_plain(ops + "feasibility", "feasibility_mask"),
+            args=(
+                ArgSpec("requests", ("pods", "resources"), f32),
+                ArgSpec("caps", ("types", "resources"), f32),
+                ArgSpec("compat", ("groups", "types"), b8),
+                ArgSpec("group_ids", ("pods",), i32),
+            ),
+            varying=("pods", "types", "groups"),
+        ),
+        EntrySpec(
+            name="availability_counts",
+            module="karpenter_tpu/ops/feasibility.py",
+            resolve=_resolve_plain(ops + "feasibility", "availability_counts"),
+            args=(
+                ArgSpec("pair", ("buckets", "offerings"), f32),
+                ArgSpec("cube", ("types", "offerings"), f32),
+            ),
+            varying=("buckets", "types", "zones", "capacity_types"),
+        ),
+        EntrySpec(
+            name="bucket_type_cost",
+            module="karpenter_tpu/ops/feasibility.py",
+            resolve=_resolve_plain(ops + "feasibility", "bucket_type_cost"),
+            args=(
+                ArgSpec("sum_requests", ("buckets", "resources"), f32),
+                ArgSpec("max_requests", ("buckets", "resources"), f32),
+                ArgSpec("caps", ("types", "resources"), f32),
+                ArgSpec("prices", ("types",), f32),
+                ArgSpec("allowed", ("buckets", "types"), b8),
+            ),
+            varying=("buckets", "types"),
+        ),
+        EntrySpec(
+            name="bucket_type_cost_packed",
+            module="karpenter_tpu/ops/feasibility.py",
+            resolve=_resolve_plain(ops + "feasibility", "bucket_type_cost_packed"),
+            args=(
+                ArgSpec("bucket_stats", (2, "buckets", "resources"), f32),
+                ArgSpec("caps", ("types", "resources"), f32),
+                ArgSpec("prices", ("types",), f32),
+                ArgSpec("allowed", ("buckets", "types"), b8),
+            ),
+            varying=("buckets", "types"),
+        ),
+        EntrySpec(
+            name="segment_usage",
+            module="karpenter_tpu/ops/packing.py",
+            resolve=_resolve_plain(ops + "packing", "segment_usage"),
+            args=(ArgSpec("requests", ("pods", "resources"), f32), ArgSpec("bin_ids", ("pods",), i32)),
+            static_args=(("num_segments", "segments"),),
+            varying=("pods", "buckets"),
+        ),
+        EntrySpec(
+            name="audit_layout",
+            module="karpenter_tpu/ops/packing.py",
+            resolve=_resolve_plain(ops + "packing", "audit_layout"),
+            args=(ArgSpec("usage", ("buckets", "resources"), f32), ArgSpec("caps_of_bin", ("buckets", "resources"), f32)),
+            varying=("buckets",),
+        ),
+        EntrySpec(
+            name="warm_fill_counts",
+            module="karpenter_tpu/ops/warmfill.py",
+            resolve=_resolve_plain(ops + "warmfill", "warm_fill_counts"),
+            args=(ArgSpec("sizes", ("sizes", "resources"), f32), ArgSpec("head", ("views", "resources"), f32)),
+            varying=("pods",),
+        ),
+        EntrySpec(
+            name="warm_fill_counts_pallas",
+            module="karpenter_tpu/ops/warmfill.py",
+            resolve=_resolve_plain(ops + "warmfill", "_warm_fill_counts_pallas_padded"),
+            args=(
+                ArgSpec("sizes_p", ("sizes_padded", "resources"), f32),
+                ArgSpec("head_t", ("resources", "views_padded"), f32),
+            ),
+            static_args=(("interpret", True),),
+            varying=("pods",),
+        ),
+        EntrySpec(
+            name="bucket_type_cost_pallas",
+            module="karpenter_tpu/ops/pallas_kernels.py",
+            resolve=_resolve_plain(ops + "pallas_kernels", "_bucket_type_cost_padded"),
+            args=(
+                ArgSpec("sum_requests", ("buckets_padded", "resources"), f32),
+                ArgSpec("max_requests", ("buckets_padded", "resources"), f32),
+                ArgSpec("caps_t", ("resources", "types_padded"), f32),
+                ArgSpec("prices", (1, "types_padded"), f32),
+                ArgSpec("allowed", ("buckets_padded", "types_padded"), i8),
+            ),
+            static_args=(("interpret", True),),
+            varying=("buckets", "buckets_padded", "types", "types_padded"),
+        ),
+        EntrySpec(
+            name="sharded_solve_step",
+            module="karpenter_tpu/parallel/sharded.py",
+            resolve=_resolve_sharded_step,
+            args=(
+                ArgSpec("requests", ("pods", "resources"), f32),
+                ArgSpec("group_ids", ("pods",), i32),
+                ArgSpec("compat", ("groups", "types"), b8),
+                ArgSpec("caps", ("types", "resources"), f32),
+                ArgSpec("prices", ("types",), f32),
+                ArgSpec("allowed", ("buckets", "types"), b8),
+                ArgSpec("bucket_sum", ("buckets", "resources"), f32),
+                ArgSpec("bucket_max", ("buckets", "resources"), f32),
+                ArgSpec("bin_ids", ("pods",), i32),
+            ),
+            varying=("pods", "groups", "buckets", "types", "buckets_padded", "types_padded"),
+        ),
+        EntrySpec(
+            name="sharded_bucket_cost",
+            module="karpenter_tpu/parallel/sharded.py",
+            resolve=_resolve_sharded_bucket_cost,
+            args=(
+                ArgSpec("bucket_stats", (2, "buckets", "resources"), f32),
+                ArgSpec("caps", ("types", "resources"), f32),
+                ArgSpec("prices", ("types",), f32),
+                ArgSpec("allowed", ("buckets", "types"), b8),
+            ),
+            varying=("buckets", "types", "buckets_padded", "types_padded"),
+        ),
+    )
+
+
+# -- the abstract interpretation ----------------------------------------------
+
+
+_64BIT = ("float64", "int64", "uint64", "complex128")
+
+
+def _abstract_args(spec: EntrySpec, dims: Dict[str, int]):
+    import jax
+    import numpy as np
+
+    return tuple(jax.ShapeDtypeStruct(a.shape(dims), np.dtype(a.dtype)) for a in spec.args)
+
+
+def _trace(spec: EntrySpec, dims: Dict[str, int]):
+    """make_jaxpr the entry at one grid point; returns (closed_jaxpr,
+    donated_invars, inner_closed_jaxpr). Tracing only — no XLA compile."""
+    import jax
+
+    fn = spec.resolve(dims)
+    n_array = len(spec.args)
+    static_argnums = tuple(range(n_array, n_array + len(spec.static_args)))
+    closed = jax.make_jaxpr(fn, static_argnums=static_argnums or None)(
+        *_abstract_args(spec, dims), *spec.static_values(dims)
+    )
+    donated = None
+    inner = closed
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name == "pjit" and "jaxpr" in eqn.params:
+            donated = eqn.params.get("donated_invars")
+            inner = eqn.params["jaxpr"]
+            break
+    return closed, donated, inner
+
+
+def _walk_nested(closed, visit) -> None:
+    """visit(closed_jaxpr) on a closed jaxpr and every nested sub-jaxpr
+    reachable through equation params (pjit bodies, scan/cond branches,
+    pallas kernels)."""
+    seen = set()
+    stack = [closed]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        visit(node)
+        jaxpr = getattr(node, "jaxpr", node)
+        for eqn in getattr(jaxpr, "eqns", ()):
+            for value in eqn.params.values():
+                candidates = value if isinstance(value, (list, tuple)) else (value,)
+                for cand in candidates:
+                    if hasattr(cand, "eqns") or hasattr(cand, "jaxpr"):
+                        stack.append(cand)
+
+
+def _captured_consts(closed) -> List[dict]:
+    out: List[dict] = []
+
+    def visit(node):
+        for const in getattr(node, "consts", ()):
+            shape = getattr(const, "shape", None)
+            if shape is None or getattr(const, "size", 0) == 0:
+                continue
+            out.append(
+                {
+                    "shape": [int(d) for d in shape],
+                    "dtype": str(getattr(const, "dtype", "?")),
+                    "bytes": int(getattr(const, "nbytes", 0)),
+                }
+            )
+
+    _walk_nested(closed, visit)
+    out.sort(key=lambda c: (-c["bytes"], c["dtype"], c["shape"]))
+    return out
+
+
+def _x64_sensitive(spec: EntrySpec, dims: Dict[str, int]) -> List[str]:
+    """Re-trace under enable_x64 with the SAME pinned 32-bit inputs; any
+    64-bit aval that appears is dtype discipline leaning on the global flag."""
+    import jax
+
+    with jax.experimental.enable_x64():
+        closed, _, _ = _trace(spec, dims)
+    hits = set()
+
+    def visit(node):
+        jaxpr = getattr(node, "jaxpr", node)
+        for eqn in getattr(jaxpr, "eqns", ()):
+            if eqn.primitive.name == "pjit":
+                # the wrapper eqn's outvars restate its inner jaxpr's outputs;
+                # the nested walk visits the inner program and names the
+                # actually-promoting primitive instead
+                continue
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dtype = str(getattr(aval, "dtype", ""))
+                if dtype in _64BIT:
+                    hits.add(f"{eqn.primitive.name}:{dtype}")
+
+    _walk_nested(closed, visit)
+    return sorted(hits)
+
+
+def _donation_audit(spec: EntrySpec, traces: Sequence[tuple]) -> dict:
+    """Greedy byte-size matching of inputs to outputs at EVERY grid point:
+    a donated input must find an unclaimed output of equal byte size at all
+    points or XLA would reject the aliasing; an undonated input that finds
+    one (and is large enough to matter) is a candidate left on the table."""
+    donated_names: List[str] = []
+    rejected: List[str] = []
+    candidates: List[str] = []
+    per_point = []
+    for closed, donated, inner in traces:
+        jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        in_bytes = [
+            int(v.aval.size) * v.aval.dtype.itemsize for v in jaxpr.invars
+        ]
+        out_bytes = [int(v.aval.size) * v.aval.dtype.itemsize for v in jaxpr.outvars]
+        per_point.append((in_bytes, out_bytes))
+    donated_flags = traces[0][1] or (False,) * len(spec.args)
+
+    def match_all_points(arg_idx: int, claimed: List[set]) -> Optional[List[int]]:
+        """Output index per point aliasable by this input, or None."""
+        picks = []
+        for point, (in_bytes, out_bytes) in enumerate(per_point):
+            pick = next(
+                (o for o, ob in enumerate(out_bytes) if o not in claimed[point] and ob == in_bytes[arg_idx]),
+                None,
+            )
+            if pick is None:
+                return None
+            picks.append(pick)
+        return picks
+
+    claimed: List[set] = [set() for _ in per_point]
+    for i, arg in enumerate(spec.args):
+        if i < len(donated_flags) and donated_flags[i]:
+            picks = match_all_points(i, claimed)
+            if picks is None:
+                rejected.append(arg.name)
+            else:
+                donated_names.append(arg.name)
+                for point, pick in enumerate(picks):
+                    claimed[point].add(pick)
+    from .rules.programcheck import DONATION_MIN_BYTES
+
+    for i, arg in enumerate(spec.args):
+        if i < len(donated_flags) and donated_flags[i]:
+            continue
+        if per_point[0][0][i] < DONATION_MIN_BYTES:
+            continue
+        if match_all_points(i, claimed) is not None:
+            candidates.append(arg.name)
+    return {"donated": donated_names, "rejected": rejected, "candidates": candidates}
+
+
+def audit_entry(spec: EntrySpec, grid_points: Sequence[Dict[str, int]] = (GRID_BASE, GRID_ALT)) -> dict:
+    """Audit one entry over the grid; returns its contract dict."""
+    traces = [_trace(spec, dims) for dims in grid_points]
+    closed, donated, inner = traces[0]
+    jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+    base = grid_points[0]
+    outputs = []
+    for i, var in enumerate(jaxpr.outvars):
+        aval = var.aval
+        outputs.append(
+            {
+                "shape": [int(d) for d in aval.shape],
+                "dtype": str(aval.dtype),
+                "weak_type": bool(getattr(aval, "weak_type", False)),
+            }
+        )
+    consts = _captured_consts(traces[0][0])
+    promotions = list(_x64_sensitive(spec, base))
+    promotions.extend(f"out[{i}]:weak_type" for i, o in enumerate(outputs) if o["weak_type"])
+    varying = sorted(spec.varying)
+    donation = _donation_audit(spec, traces)
+    return {
+        "module": spec.module,
+        "args": [
+            {
+                "name": a.name,
+                "axes": [ax if isinstance(ax, str) else int(ax) for ax in a.axes],
+                "dtype": a.dtype,
+                "donated": a.name in donation["donated"],
+            }
+            for a in spec.args
+        ],
+        "static_args": [name for name, _ in spec.static_args],
+        "outputs": outputs,
+        "varying_axes": varying,
+        "static_axes": sorted(set(FLIGHT_DIMS) - set(varying)),
+        "donation": donation,
+        "promotions": sorted(set(promotions)),
+        "captured_consts": consts,
+        "captured_const_bytes": sum(c["bytes"] for c in consts),
+    }
+
+
+def build_contracts(entries: Optional[Sequence[EntrySpec]] = None) -> dict:
+    """The full contract document (deterministic: sorted entries, no
+    timestamps; the digest keys the staleness gate)."""
+    specs = list(entries if entries is not None else default_entries())
+    doc_entries = {spec.name: audit_entry(spec) for spec in specs}
+    body = {
+        "schema_version": SCHEMA_VERSION,
+        "grid": {"base": GRID_BASE, "alt": GRID_ALT},
+        "entries": {name: doc_entries[name] for name in sorted(doc_entries)},
+    }
+    digest = hashlib.sha256(json.dumps(body, sort_keys=True).encode("utf-8")).hexdigest()[:16]
+    return {
+        "comment": (
+            "Solver program contracts — generated by `python -m karpenter_tpu.cmd.analyze "
+            "--contracts --write`, gated by `--contracts --check`. Per jit entry: declared "
+            "varying/static recompile axes (cross-checked against the flight recorder's "
+            "runtime attribution by the bench smoke gate), dtype surface with x64-sensitive "
+            "promotions, donation coverage, and captured-constant bytes. Do not edit by hand."
+        ),
+        **body,
+        "digest": digest,
+    }
+
+
+def default_contracts_path(root: str) -> str:
+    return os.path.join(root, CONTRACTS_BASENAME)
+
+
+def load_committed(root: str, path: Optional[str] = None) -> Optional[dict]:
+    path = path or default_contracts_path(root)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_contracts(root: str, path: Optional[str] = None, entries: Optional[Sequence[EntrySpec]] = None) -> dict:
+    doc = build_contracts(entries)
+    path = path or default_contracts_path(root)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return doc
+
+
+def staleness_errors(committed: Optional[dict], current: dict) -> List[str]:
+    """Staleness gate: the committed contract must equal the recomputed one.
+    Equality is judged on CONTENT (schema/grid/entries), never on the
+    committed file's own digest field — a hand-edited file keeps its old
+    digest, and trusting it would wave the tamper through."""
+    if committed is None:
+        return [f"{CONTRACTS_BASENAME} missing — run `analyze --contracts --write` and commit it"]
+
+    def body(doc: dict) -> dict:
+        return {k: doc.get(k) for k in ("schema_version", "grid", "entries")}
+
+    if body(committed) == body(current) and committed.get("digest") == current.get("digest"):
+        return []
+    errors = [f"{CONTRACTS_BASENAME} is stale — run `analyze --contracts --write` and commit the diff"]
+    old_entries = committed.get("entries", {})
+    new_entries = current.get("entries", {})
+    for name in sorted(set(old_entries) | set(new_entries)):
+        old, new = old_entries.get(name), new_entries.get(name)
+        if old is None:
+            errors.append(f"  entry {name}: new (no committed contract)")
+        elif new is None:
+            errors.append(f"  entry {name}: removed (committed contract is orphaned)")
+        elif json.dumps(old, sort_keys=True) != json.dumps(new, sort_keys=True):
+            changed = [k for k in sorted(set(old) | set(new)) if old.get(k) != new.get(k)]
+            errors.append(f"  entry {name}: changed field(s) {changed}")
+    return errors
+
+
+# -- the runtime cross-check (flight recorder <-> static contract) ------------
+
+
+def recompile_violations(records: Sequence[object], doc: Optional[dict]) -> List[str]:
+    """Cross-validate observed recompiles against the declared contract.
+
+    A recompile of entry E attributed to changed shape axes D is
+    *contract-explained* when at least one axis in D is declared varying
+    for E; it is a violation when every changed axis is declared static —
+    the program retraced on an axis the contract promises never moves.
+    Out of scope: process-wide cold starts, unattributed ('other')
+    compiles, and per-fn FIRST compiles (record.first_compiles — an entry
+    whose executable cache was empty when the solve started is a path
+    engaging for the first time, not a retrace; the solve-level shape
+    delta says nothing about it). An entry with no contract at all is
+    itself a violation (the registry and the contract must stay in
+    lockstep)."""
+    if doc is None:
+        return [f"{CONTRACTS_BASENAME} missing — the recompile cross-check has no contract to check against"]
+    entries = doc.get("entries", {})
+    violations: List[str] = []
+    for rec in records:
+        recompile = rec.recompile if hasattr(rec, "recompile") else rec.get("recompile")
+        attribution = list(
+            rec.recompile_attribution if hasattr(rec, "recompile_attribution") else rec.get("recompile_attribution", [])
+        )
+        compiled = dict(rec.compiled_fns if hasattr(rec, "compiled_fns") else rec.get("compiled_fns", {}))
+        first = set(rec.first_compiles if hasattr(rec, "first_compiles") else rec.get("first_compiles", ()))
+        signature = dict(rec.signature if hasattr(rec, "signature") else rec.get("signature", {}))
+        rec_id = rec.id if hasattr(rec, "id") else rec.get("id")
+        if not recompile or not attribution or attribution == ["cold-start"]:
+            continue
+        for fn_name in sorted(compiled):
+            if fn_name == "other" or fn_name in first:
+                continue
+            entry = entries.get(fn_name)
+            if entry is None:
+                violations.append(
+                    f"solve {rec_id}: recompile of {fn_name!r} but no contract entry exists — "
+                    f"add it to analysis/contracts.py default_entries()"
+                )
+                continue
+            varying = set(entry.get("varying_axes", ()))
+            observed = set(attribution)
+            if observed & varying:
+                continue
+            observed_sig = {dim: signature.get(dim) for dim in sorted(observed)}
+            violations.append(
+                f"solve {rec_id}: recompile of {fn_name!r} attributed to declared-STATIC axis(es) "
+                f"{sorted(observed)} — contract declares varying={sorted(varying)}, "
+                f"static={entry.get('static_axes')}; observed signature change: {observed_sig}"
+            )
+    return violations

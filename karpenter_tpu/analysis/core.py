@@ -61,12 +61,24 @@ class Baseline:
         return cls(suppressions=list(doc.get("suppressions", [])))
 
     def errors(self) -> List[str]:
-        """Malformed entries: the baseline only admits justified suppressions."""
+        """Malformed entries: the baseline only admits justified suppressions
+        for rules that exist. The rule-name check matters because split()
+        filters by tier — a typo'd or deleted rule name would otherwise be
+        invisible to BOTH gates' staleness checks and suppress nothing,
+        silently, forever."""
+        from .rules import CONTRACT_RULE_NAMES, RULE_NAMES  # runtime: avoids the import cycle
+
+        known_rules = set(RULE_NAMES) | set(CONTRACT_RULE_NAMES)
         out = []
         for i, entry in enumerate(self.suppressions):
             missing = [k for k in ("rule", "path", "scope", "key") if not entry.get(k)]
             if missing:
                 out.append(f"baseline entry {i} missing field(s) {missing}: {entry}")
+            if entry.get("rule") and entry.get("rule") not in known_rules:
+                out.append(
+                    f"baseline entry {i} names unknown rule {entry.get('rule')!r} "
+                    f"(not in {sorted(known_rules)}) — typo, or the rule was deleted; delete the entry"
+                )
             justification = str(entry.get("justification", "")).strip()
             if not justification or justification.lower() == "todo":
                 # 'TODO' is the --write-baseline seed: committing it unvetted
@@ -77,11 +89,20 @@ class Baseline:
                 )
         return out
 
-    def split(self, findings: Sequence[Finding]):
-        """(active findings, suppressed findings, stale baseline entries)."""
+    def split(self, findings: Sequence[Finding], rules: Optional[Sequence[str]] = None):
+        """(active findings, suppressed findings, stale baseline entries).
+
+        `rules` scopes the staleness check to one tier: the AST gate and the
+        program-contracts gate share this one baseline file, and each must
+        judge only its own suppressions stale (an entry for a rule the
+        current run never evaluates is the other tier's business)."""
+        suppressions = self.suppressions
+        if rules is not None:
+            wanted = set(rules)
+            suppressions = [e for e in suppressions if e.get("rule") in wanted]
         index: Dict[Tuple[str, str, str, str], dict] = {
             (e.get("rule", ""), e.get("path", ""), e.get("scope", ""), e.get("key", "")): e
-            for e in self.suppressions
+            for e in suppressions
         }
         matched = set()
         active, suppressed = [], []

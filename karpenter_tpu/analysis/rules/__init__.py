@@ -1,13 +1,21 @@
 """Rule registry for the static-analysis framework (analysis/core.py).
 
-Each rule is a callable `List[Module] -> List[Finding]`. Adding a rule here
-is the ONLY registration step: the CLI, the baseline machinery, and the
-fixture-test harness all iterate ALL_RULES.
+Two tiers share one baseline:
+
+- **AST tier** (ALL_RULES): callables `List[Module] -> List[Finding]` over
+  parsed source — import-light, runs on jax-free CI stages. Adding a rule
+  here is the ONLY registration step: the CLI, the baseline machinery, and
+  the fixture-test harness all iterate ALL_RULES.
+- **program tier** (programcheck / CONTRACT_RULE_NAMES): findings over the
+  jaxpr-level contracts (analysis/contracts.py) — needs jax, runs behind
+  `analyze --contracts`. Listed here by NAME ONLY so the shared baseline
+  machinery can split suppressions by tier without importing jax.
 """
 
 from __future__ import annotations
 
 from . import hygiene, jaxcheck, lockcheck
+from .programcheck import CONTRACT_RULE_NAMES
 
 ALL_RULES = (
     lockcheck.check,
@@ -25,4 +33,4 @@ RULE_NAMES = (
     hygiene.THREADS_RULE,
 )
 
-__all__ = ["ALL_RULES", "RULE_NAMES", "lockcheck", "jaxcheck", "hygiene"]
+__all__ = ["ALL_RULES", "RULE_NAMES", "CONTRACT_RULE_NAMES", "lockcheck", "jaxcheck", "hygiene"]

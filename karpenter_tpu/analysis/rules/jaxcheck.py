@@ -20,10 +20,16 @@ round trips. This rule finds the silent ones at lint time:
 Scope: functions REACHABLE from jit entry points in `solver/`, `ops/`, and
 `parallel/`. Entry points are functions decorated `@jax.jit` / `@jit` /
 `@partial(jax.jit, ...)` / `@pjit` / `@jax.pmap`, plus any function passed
-to a `jax.jit(...)`-shaped call. Reachability follows plain-name and
-`self.<name>` references transitively across the scanned modules — host-side
-orchestration code (e.g. solver/dense.py's dispatch loop) that merely CALLS
-jitted kernels is deliberately out of scope; it is allowed to sync.
+to a jit-wrapper call in ANY of the mesh-wrapper spellings `parallel/`
+uses: positional (`jax.jit(fn, in_shardings=...)`,
+`shard_map(fn, mesh=...)`), keyword (`shard_map(f=fn, ...)`), applied
+partial (`partial(shard_map, mesh=...)(fn)`), nested
+(`jax.jit(shard_map(fn, ...))`), and import-aliased
+(`from jax.experimental.shard_map import shard_map as shmap`).
+Reachability follows plain-name and `self.<name>` references transitively
+across the scanned modules — host-side orchestration code (e.g.
+solver/dense.py's dispatch loop) that merely CALLS jitted kernels is
+deliberately out of scope; it is allowed to sync.
 """
 
 from __future__ import annotations
@@ -38,20 +44,39 @@ RULE = "jaxcheck"
 SCOPE_PREFIXES = ("karpenter_tpu/solver/", "karpenter_tpu/ops/", "karpenter_tpu/parallel/")
 
 _JIT_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+# keyword names jit wrappers accept the wrapped function under
+# (shard_map(f=...), jax.jit(fun=...))
+_FN_KEYWORDS = {"f", "fun", "func"}
+
+
+def _jit_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to jit wrappers by aliased imports:
+    `from jax.experimental.shard_map import shard_map as shmap` makes
+    'shmap' a jit spelling for this module."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in _JIT_NAMES and alias.asname:
+                    aliases.add(alias.asname)
+    return aliases
+
+
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _NP_SYNC = {"np.asarray", "np.array", "onp.asarray", "onp.array", "numpy.asarray", "numpy.array"}
 _CONCRETIZERS = {"float", "int", "bool"}
 
 
-def _is_jit_expr(node: ast.AST) -> Tuple[bool, Set[str]]:
+def _is_jit_expr(node: ast.AST, aliases: Set[str] = frozenset()) -> Tuple[bool, Set[str]]:
     """(is this expression a jit wrapper?, static_argnames if readable)."""
     name = dotted_name(node.func) if isinstance(node, ast.Call) else dotted_name(node)
-    if name.rsplit(".", 1)[-1] in _JIT_NAMES:
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _JIT_NAMES or leaf in aliases:
         return True, set()
     # partial(jax.jit, static_argnames=(...)) / functools.partial(jit, ...)
     if isinstance(node, ast.Call) and decorator_name(node) == "partial" and node.args:
         inner = dotted_name(node.args[0])
-        if inner.rsplit(".", 1)[-1] in _JIT_NAMES:
+        if inner.rsplit(".", 1)[-1] in _JIT_NAMES or inner.rsplit(".", 1)[-1] in aliases:
             static: Set[str] = set()
             for kw in node.keywords:
                 if kw.arg == "static_argnames":
@@ -72,11 +97,12 @@ class _FunctionIndexer(ast.NodeVisitor):
         self.functions: Dict[str, ast.AST] = {}
         self.entries: Dict[str, Set[str]] = {}  # name -> static_argnames
         self._jit_wrapped_names: Set[str] = set()
+        self.aliases = _jit_aliases(module.tree)
 
     def _visit_function(self, node) -> None:
         self.functions.setdefault(node.name, node)
         for dec in node.decorator_list:
-            jitted, static = _is_jit_expr(dec)
+            jitted, static = _is_jit_expr(dec, self.aliases)
             if jitted:
                 self.entries.setdefault(node.name, set()).update(static)
         self.generic_visit(node)
@@ -84,16 +110,35 @@ class _FunctionIndexer(ast.NodeVisitor):
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
 
+    def _collect_wrapped(self, node: ast.Call) -> None:
+        """Record every function handed to a jit-wrapper call, positionally
+        or under a known fn-keyword (`shard_map(f=impl, mesh=...)`)."""
+        candidates = list(node.args) + [kw.value for kw in node.keywords if kw.arg in _FN_KEYWORDS]
+        for arg in candidates:
+            name = dotted_name(arg)
+            if name.rsplit(".", 1)[-1] in _JIT_NAMES or name in self.aliases:
+                continue  # partial(shard_map, ...): the wrapper is not the wrapped fn
+            if name and "." not in name:
+                self._jit_wrapped_names.add(name)
+            elif name.startswith("self."):
+                self._jit_wrapped_names.add(name[5:])
+
     def visit_Call(self, node: ast.Call) -> None:
-        # fn = jax.jit(impl) / dispatch = pjit(impl, ...) forms
-        jitted, _ = _is_jit_expr(node)
+        # fn = jax.jit(impl) / dispatch = pjit(impl, ...) / shard_map(f=impl)
+        jitted, _ = _is_jit_expr(node, self.aliases)
         if jitted:
-            for arg in node.args:
-                name = dotted_name(arg)
-                if name and "." not in name:
-                    self._jit_wrapped_names.add(name)
-                elif name.startswith("self."):
-                    self._jit_wrapped_names.add(name[5:])
+            self._collect_wrapped(node)
+        elif (
+            isinstance(node.func, ast.Call)
+            and decorator_name(node.func) == "partial"
+            and _is_jit_expr(node.func, self.aliases)[0]
+        ):
+            # applied partial ONLY: partial(shard_map, mesh=...)(impl) — the
+            # outer call's operands are the wrapped functions. A direct
+            # immediate invocation like jax.jit(impl)(batch) must NOT land
+            # here: its outer operands are runtime arguments, not functions
+            # (the inner jit call is visited separately and collects impl)
+            self._collect_wrapped(node)
         self.generic_visit(node)
 
     def finish(self) -> None:

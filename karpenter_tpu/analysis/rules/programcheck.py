@@ -1,0 +1,130 @@
+"""programcheck: findings over the jaxpr-level program contracts.
+
+The second analysis tier. Where the AST rules (jaxcheck & co.) read the
+solver's *source*, these rules read its *traced programs* — the contract
+dicts analysis/contracts.py extracts with `jax.make_jaxpr` over the bench
+shape grid — and emit `Finding`s through the exact same justified-baseline
+machinery (one baseline.json, one (rule, path, scope, key) shape). Scope is
+the jit entry's registered {fn} name (the flight recorder's label), never a
+line number, so suppressions survive unrelated edits — same anchoring
+discipline as the AST tier.
+
+Three rule classes:
+
+- **program-donation** — a device-resident input large enough to matter
+  (>= DONATION_MIN_BYTES at the base grid point) has a byte-size-matched
+  output buffer free to alias at EVERY grid point but is not donated
+  (`donate_argnums` debt the incremental steady-state solve needs paid);
+  or a donation is declared that XLA would reject (no matching output — a
+  warning-per-compile in production, and a false sense of reuse).
+- **program-promotion** — a 64-bit intermediate appears when the entry is
+  re-traced under enable_x64 with the same pinned 32-bit inputs (dtype
+  discipline leaning on the global flag: the program doubles its HBM and
+  recompiles differently depending on process config), or an output leaks
+  weak_type=True (a retrace hazard for any downstream consumer).
+- **program-constant** — concrete arrays closed over and baked into the
+  jaxpr above CONST_MIN_BYTES: every compiled executable carries them, and
+  a refactor that captures a catalog by accident ships it to the device
+  once per shape bucket. The current solver surface is pinned at ZERO
+  captured bytes — this rule keeps it there.
+
+Unlike the AST tier these rules import jax and the solver modules (the
+programs must be traced), so they are NOT in ALL_RULES; `analyze
+--contracts` is their entry point and tier-1 runs it as a subprocess gate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Finding
+
+DONATION_RULE = "program-donation"
+PROMOTION_RULE = "program-promotion"
+CONSTANT_RULE = "program-constant"
+
+CONTRACT_RULE_NAMES = (DONATION_RULE, PROMOTION_RULE, CONSTANT_RULE)
+
+# inputs below this (base grid point) aren't worth a donation finding: the
+# aliasing saves an allocation the size of the buffer, and sub-512B buffers
+# are noise next to the [P, T] surfaces
+DONATION_MIN_BYTES = 512
+
+# scalars traced into literals are free; a captured array above this is a
+# baked-in per-executable payload worth a finding
+CONST_MIN_BYTES = 64
+
+
+def findings_from_contracts(doc: dict) -> List[Finding]:
+    """Contract dict (analysis/contracts.py build_contracts) -> Findings,
+    sorted with the same key as the AST runner so output interleaves
+    deterministically."""
+    findings: List[Finding] = []
+    for name, entry in sorted(doc.get("entries", {}).items()):
+        path = entry.get("module", "")
+        donation: Dict[str, list] = entry.get("donation", {})
+        for arg in donation.get("candidates", ()):
+            findings.append(
+                Finding(
+                    rule=DONATION_RULE,
+                    path=path,
+                    line=1,
+                    scope=name,
+                    key=arg,
+                    message=(
+                        f"input {arg!r} has a byte-size-matched output buffer at every grid point "
+                        f"but is not donated — add donate_argnums (device-buffer reuse the "
+                        f"incremental solve depends on) or baseline with why the caller must "
+                        f"keep the input alive"
+                    ),
+                )
+            )
+        for arg in donation.get("rejected", ()):
+            findings.append(
+                Finding(
+                    rule=DONATION_RULE,
+                    path=path,
+                    line=1,
+                    scope=name,
+                    key=f"{arg}:rejected",
+                    message=(
+                        f"input {arg!r} is donated but no output of equal byte size exists to "
+                        f"alias — XLA rejects the donation (warning per compile, no reuse)"
+                    ),
+                )
+            )
+        for promo in entry.get("promotions", ()):
+            findings.append(
+                Finding(
+                    rule=PROMOTION_RULE,
+                    path=path,
+                    line=1,
+                    scope=name,
+                    key=promo,
+                    message=(
+                        f"{promo}: 64-bit/weak-typed value appears under enable_x64 with pinned "
+                        f"32-bit inputs — pin the dtype (e.g. lax.argmin index_dtype, explicit "
+                        f".astype) so the program is identical regardless of the global flag"
+                    ),
+                )
+            )
+        for const in entry.get("captured_consts", ()):
+            if const.get("bytes", 0) < CONST_MIN_BYTES:
+                continue
+            shape = "x".join(str(d) for d in const.get("shape", ()))
+            findings.append(
+                Finding(
+                    rule=CONSTANT_RULE,
+                    path=path,
+                    line=1,
+                    scope=name,
+                    key=f"const:{const.get('dtype')}[{shape}]",
+                    message=(
+                        f"captured constant {const.get('dtype')}[{shape}] "
+                        f"({const.get('bytes')} bytes) is baked into the compiled program — "
+                        f"pass it as an argument or baseline with why baking it in is right"
+                    ),
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings

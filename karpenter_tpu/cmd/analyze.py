@@ -1,18 +1,27 @@
-"""Static-analysis gate: lockcheck + jaxcheck + hygiene over karpenter_tpu/.
+"""Static-analysis gate: the AST tier (lockcheck + jaxcheck + hygiene) and
+the program-contracts tier (jaxpr-level donation/dtype/recompile-axis audit)
+over karpenter_tpu/.
 
-    python -m karpenter_tpu.cmd.analyze                   # report everything
-    python -m karpenter_tpu.cmd.analyze --check [root]    # CI gate
-    python -m karpenter_tpu.cmd.analyze --write-baseline  # (re)seed baseline
+    python -m karpenter_tpu.cmd.analyze                        # AST report
+    python -m karpenter_tpu.cmd.analyze --check [root]         # AST CI gate
+    python -m karpenter_tpu.cmd.analyze --contracts [root]     # contract report
+    python -m karpenter_tpu.cmd.analyze --contracts --check    # contract CI gate
+    python -m karpenter_tpu.cmd.analyze --contracts --write    # regen SOLVER_CONTRACTS.json
+    python -m karpenter_tpu.cmd.analyze --write-baseline [--contracts]
 
-Mirrors the `gen_docs --check` / `gen_manifests --check` contract: exit 0
-when the tree is clean (every finding either fixed or suppressed by a
-justified baseline entry), exit 1 with `path:line: rule[key]: message`
-lines on stderr otherwise. A baseline entry that no longer matches any
-finding is an error too — paid debt must be deleted.
+Both gates mirror the `gen_docs --check` / `gen_manifests --check` contract:
+exit 0 when clean, exit 1 with one line per problem on stderr otherwise.
+The AST tier runs on parsed source (jax-free); the contracts tier traces
+the registered jit entries with `jax.make_jaxpr` (compile-free, but needs
+jax importable) and additionally gates STALENESS: the committed
+SOLVER_CONTRACTS.json must equal the recomputed contract, exactly as
+gen_docs --check pins METRICS.md.
 
-`--write-baseline` regenerates analysis/baseline.json from the current
-findings with TODO justifications; the diff review that replaces each TODO
-with a real sentence IS the vetting step, and `--check` rejects TODOs.
+The two tiers share one baseline (analysis/baseline.json, split by rule
+name): `--write-baseline` seeds the AST tier; `--write-baseline
+--contracts` seeds both, deduping and preserving existing justifications.
+A baseline entry that no longer matches any finding of ITS OWN tier is an
+error — paid debt must be deleted.
 """
 
 from __future__ import annotations
@@ -22,47 +31,52 @@ import os
 import sys
 
 
-def run_check(root: str, baseline_path: str = None, out=sys.stderr) -> int:
-    from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
-
-    baseline_path = baseline_path or default_baseline_path()
-    modules = parse_modules(root)
-    findings = run_rules(modules)
-    baseline = Baseline.load(baseline_path)
+def _report_failures(active, stale, baseline, baseline_path, root, out, gate: str) -> int:
     failures = 0
     for error in baseline.errors():
-        print(f"analyze --check: {error}", file=out)
+        print(f"{gate}: {error}", file=out)
         failures += 1
-    active, suppressed, stale = baseline.split(findings)
     for finding in active:
-        print(f"analyze --check: {finding.render()}", file=out)
+        print(f"{gate}: {finding.render()}", file=out)
         failures += 1
     for entry in stale:
         print(
-            f"analyze --check: stale baseline entry {entry.get('rule')}:{entry.get('path')}:"
+            f"{gate}: stale baseline entry {entry.get('rule')}:{entry.get('path')}:"
             f"{entry.get('scope')}[{entry.get('key')}] matches no finding — delete it",
             file=out,
         )
         failures += 1
     if failures:
         print(
-            f"analyze --check: {failures} problem(s) ({len(active)} finding(s), "
+            f"{gate}: {failures} problem(s) ({len(active)} finding(s), "
             f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}); "
             f"fix them or add a justified suppression to {os.path.relpath(baseline_path, root)}",
             file=out,
         )
-        return 1
-    return 0
+    return failures
 
 
-def run_report(root: str, baseline_path: str = None, out=sys.stdout) -> int:
+def run_check(root: str, baseline_path: str = None, out=sys.stderr) -> int:
     from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+    from ..analysis.rules import RULE_NAMES
 
     baseline_path = baseline_path or default_baseline_path()
     modules = parse_modules(root)
     findings = run_rules(modules)
     baseline = Baseline.load(baseline_path)
-    active, suppressed, stale = baseline.split(findings)
+    active, suppressed, stale = baseline.split(findings, rules=RULE_NAMES)
+    return 1 if _report_failures(active, stale, baseline, baseline_path, root, out, "analyze --check") else 0
+
+
+def run_report(root: str, baseline_path: str = None, out=sys.stdout) -> int:
+    from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+    from ..analysis.rules import RULE_NAMES
+
+    baseline_path = baseline_path or default_baseline_path()
+    modules = parse_modules(root)
+    findings = run_rules(modules)
+    baseline = Baseline.load(baseline_path)
+    active, suppressed, stale = baseline.split(findings, rules=RULE_NAMES)
     for finding in active:
         print(finding.render(), file=out)
     for finding in suppressed:
@@ -75,12 +89,89 @@ def run_report(root: str, baseline_path: str = None, out=sys.stdout) -> int:
     return 0
 
 
-def write_baseline(root: str, baseline_path: str = None) -> int:
+# -- the program-contracts tier ------------------------------------------------
+
+
+def run_contracts_check(root: str, baseline_path: str = None, contracts_path: str = None, out=sys.stderr) -> int:
+    """The `--contracts --check` gate: staleness first (the committed
+    SOLVER_CONTRACTS.json must equal the recomputed contract), then
+    violations vs the shared baseline."""
+    from ..analysis import contracts
+    from ..analysis.core import Baseline, default_baseline_path
+    from ..analysis.rules.programcheck import CONTRACT_RULE_NAMES, findings_from_contracts
+
+    gate = "analyze --contracts --check"
+    baseline_path = baseline_path or default_baseline_path()
+    committed = contracts.load_committed(root, contracts_path)
+    current = contracts.build_contracts()
+    failures = 0
+    for error in contracts.staleness_errors(committed, current):
+        print(f"{gate}: {error}", file=out)
+        failures += 1
+    findings = findings_from_contracts(current)
+    baseline = Baseline.load(baseline_path)
+    active, suppressed, stale = baseline.split(findings, rules=CONTRACT_RULE_NAMES)
+    failures += _report_failures(active, stale, baseline, baseline_path, root, out, gate)
+    return 1 if failures else 0
+
+
+def run_contracts_report(root: str, baseline_path: str = None, contracts_path: str = None, out=sys.stdout) -> int:
+    from ..analysis import contracts
+    from ..analysis.core import Baseline, default_baseline_path
+    from ..analysis.rules.programcheck import CONTRACT_RULE_NAMES, findings_from_contracts
+
+    baseline_path = baseline_path or default_baseline_path()
+    current = contracts.build_contracts()
+    committed = contracts.load_committed(root, contracts_path)
+    stale_msgs = contracts.staleness_errors(committed, current)
+    findings = findings_from_contracts(current)
+    baseline = Baseline.load(baseline_path)
+    active, suppressed, stale = baseline.split(findings, rules=CONTRACT_RULE_NAMES)
+    for finding in active:
+        print(finding.render(), file=out)
+    for finding in suppressed:
+        print(f"{finding.render()} (baselined)", file=out)
+    for msg in stale_msgs:
+        print(msg, file=out)
+    entries = current.get("entries", {})
+    donated = sum(len(e["donation"]["donated"]) for e in entries.values())
+    const_bytes = sum(e["captured_const_bytes"] for e in entries.values())
+    print(
+        f"{len(entries)} jit entr{'y' if len(entries) == 1 else 'ies'} audited: "
+        f"{len(active)} active finding(s), {len(suppressed)} baselined, "
+        f"{donated} donated input(s), {const_bytes} captured-constant byte(s)",
+        file=out,
+    )
+    return 0
+
+
+def write_contracts(root: str, contracts_path: str = None) -> int:
+    from ..analysis import contracts
+
+    doc = contracts.write_contracts(root, contracts_path)
+    path = contracts_path or contracts.default_contracts_path(root)
+    print(f"wrote {len(doc['entries'])} entry contract(s) to {path}", file=sys.stderr)
+    return 0
+
+
+def write_baseline(root: str, baseline_path: str = None, include_contracts: bool = False) -> int:
+    """Seed/refresh the shared baseline. AST findings always; contract-tier
+    findings when include_contracts (the two tiers share one file, keyed by
+    rule name). Existing justifications are preserved; suppressions of the
+    OTHER tier are never dropped by a one-tier reseed."""
     from ..analysis.core import Baseline, default_baseline_path, parse_modules, run_rules
+    from ..analysis.rules import CONTRACT_RULE_NAMES, RULE_NAMES
 
     baseline_path = baseline_path or default_baseline_path()
     modules = parse_modules(root)
-    findings = run_rules(modules)
+    findings = list(run_rules(modules))
+    reseeded_rules = set(RULE_NAMES)
+    if include_contracts:
+        from ..analysis import contracts
+        from ..analysis.rules.programcheck import findings_from_contracts
+
+        findings.extend(findings_from_contracts(contracts.build_contracts()))
+        reseeded_rules |= set(CONTRACT_RULE_NAMES)
     existing = Baseline.load(baseline_path)
     justifications = {
         (e.get("rule"), e.get("path"), e.get("scope"), e.get("key")): e.get("justification", "")
@@ -88,6 +179,13 @@ def write_baseline(root: str, baseline_path: str = None) -> int:
     }
     entries = []
     seen = set()
+    # suppressions of the tier(s) NOT being reseeded survive verbatim
+    for e in existing.suppressions:
+        if e.get("rule") not in reseeded_rules:
+            key = (e.get("rule"), e.get("path"), e.get("scope"), e.get("key"))
+            if key not in seen:
+                seen.add(key)
+                entries.append(dict(e))
     for finding in findings:
         key = finding.suppression_key()
         if key in seen:  # several findings can share one (scope, key) site
@@ -102,11 +200,13 @@ def write_baseline(root: str, baseline_path: str = None) -> int:
                 "justification": justifications.get(key, "TODO"),
             }
         )
+    entries.sort(key=lambda e: (e["rule"], e["path"], e["scope"], e["key"]))
     doc = {
         "comment": (
-            "Vetted exceptions for `python -m karpenter_tpu.cmd.analyze --check`. "
-            "Entries match findings on (rule, path, scope, key) — line-independent. "
-            "Every entry needs a real justification; --check rejects TODO."
+            "Vetted exceptions for `python -m karpenter_tpu.cmd.analyze --check` (AST tier) "
+            "and `--contracts --check` (program tier). Entries match findings on "
+            "(rule, path, scope, key) — line-independent. Every entry needs a real "
+            "justification; --check rejects TODO."
         ),
         "suppressions": entries,
     }
@@ -119,14 +219,29 @@ def write_baseline(root: str, baseline_path: str = None) -> int:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    mode = "report"
-    if argv and argv[0] in ("--check", "--write-baseline"):
-        mode = argv.pop(0)
-    root = argv[0] if argv else os.getcwd()
-    if mode == "--check":
+    flags = {a for a in argv if a.startswith("--")}
+    rest = [a for a in argv if not a.startswith("--")]
+    unknown = flags - {"--check", "--write-baseline", "--contracts", "--write"}
+    if unknown:
+        print(f"analyze: unknown flag(s) {sorted(unknown)}", file=sys.stderr)
+        return 2
+    if "--write" in flags and "--contracts" not in flags:
+        print("analyze: --write requires --contracts (to reseed the baseline use --write-baseline)", file=sys.stderr)
+        return 2
+    if "--check" in flags and flags & {"--write", "--write-baseline"}:
+        print("analyze: --check cannot be combined with --write/--write-baseline", file=sys.stderr)
+        return 2
+    root = rest[0] if rest else os.getcwd()
+    if "--write-baseline" in flags:
+        return write_baseline(root, include_contracts="--contracts" in flags)
+    if "--contracts" in flags:
+        if "--write" in flags:
+            return write_contracts(root)
+        if "--check" in flags:
+            return run_contracts_check(root)
+        return run_contracts_report(root)
+    if "--check" in flags:
         return run_check(root)
-    if mode == "--write-baseline":
-        return write_baseline(root)
     return run_report(root)
 
 

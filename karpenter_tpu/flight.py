@@ -171,6 +171,11 @@ class SolveRecord:
     duration_seconds: float = 0.0
     recompile: bool = False
     compiled_fns: Dict[str, int] = field(default_factory=dict)  # entry -> compiles this solve
+    # entries whose executable cache was EMPTY when this solve started: their
+    # compile is that fn's first program build (a path engaging for the first
+    # time), not a retrace — the contract cross-check exempts them the way it
+    # exempts the process-wide ["cold-start"]
+    first_compiles: List[str] = field(default_factory=list)
     compile_seconds: float = 0.0
     # the dimensions whose cardinality changed vs the PREVIOUS recorded
     # solve — empty on a recompile with an unchanged signature (a new code
@@ -193,6 +198,7 @@ class SolveRecord:
             "duration_seconds": round(self.duration_seconds, 6),
             "recompile": self.recompile,
             "compiled_fns": self.compiled_fns,
+            "first_compiles": self.first_compiles,
             "compile_seconds": round(self.compile_seconds, 6),
             "recompile_attribution": self.recompile_attribution,
             "hbm_peak_bytes": self.hbm_peak_bytes,
@@ -277,6 +283,7 @@ class FlightRecorder:
 
             self.register_jit_entry("resource_fit", feasibility.resource_fit)
             self.register_jit_entry("feasibility_mask", feasibility.feasibility_mask)
+            self.register_jit_entry("availability_counts", feasibility.availability_counts)
             self.register_jit_entry("bucket_type_cost", feasibility.bucket_type_cost)
             self.register_jit_entry("bucket_type_cost_packed", feasibility.bucket_type_cost_packed)
             self.register_jit_entry("segment_usage", packing.segment_usage)
@@ -423,6 +430,9 @@ class FlightRecorder:
                 duration_seconds=duration,
                 recompile=bool(compiled),
                 compiled_fns=compiled,
+                first_compiles=sorted(
+                    name for name in compiled if name != "other" and token["sizes"].get(name, 0) == 0
+                ),
                 compile_seconds=seconds,
                 recompile_attribution=attribution,
                 hbm_peak_bytes=peak,

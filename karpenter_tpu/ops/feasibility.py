@@ -40,6 +40,24 @@ def feasibility_mask(requests: jax.Array, caps: jax.Array, compat: jax.Array, gr
 
 
 @jax.jit
+def availability_counts(pair: jax.Array, cube: jax.Array) -> jax.Array:
+    """[B, T] bool: bucket b and type t share >= 1 available (zone,
+    capacity-type) offering cell.
+
+    pair: [B, Z*C] f32 0/1 bucket allowances (zone x capacity-type outer
+    product, flattened); cube: [T, Z*C] f32 0/1 offering-availability cube
+    rows (quarantined pools are zeros). One fused matmul + threshold; the
+    bool download is a quarter of the f32 counts the host used to fetch.
+
+    The cube is an ARGUMENT, never a closure: closing over the per-catalog
+    cube here would bake it into every shape bucket's compiled executable
+    (the program-constant contract, analysis/rules/programcheck.py, pins
+    this surface at zero captured bytes).
+    """
+    return jnp.matmul(pair, cube.T) > 0.5
+
+
+@jax.jit
 def bucket_type_cost_packed(bucket_stats: jax.Array, caps: jax.Array, prices: jax.Array, allowed: jax.Array) -> jax.Array:
     """Transfer-minimal wrapper: bucket_stats = stack([sum, max]) [2, B, R];
     returns one packed int32 [3, B] = (tstar, bins, feasible). One upload of
@@ -78,7 +96,10 @@ def bucket_type_cost(sum_requests: jax.Array, max_requests: jax.Array, caps: jax
     # composite lexicographic-ish key; verified exactly at commit time
     key = frac_cost + bins * 1e-4 + prices[None, :] * 1e-7
     key = jnp.where(ok, key, jnp.inf)
-    tstar = jnp.argmin(key, axis=1).astype(jnp.int32)
-    chosen_bins = jnp.take_along_axis(bins, tstar[:, None].astype(jnp.int32), axis=1)[:, 0]
+    # lax.argmin with an explicit index_dtype: jnp.argmin's index type follows
+    # jax_enable_x64 (int64 under the flag), which makes the compiled program
+    # depend on process config — the program-promotion contract pins i32
+    tstar = jax.lax.argmin(key, 1, jnp.int32)
+    chosen_bins = jnp.take_along_axis(bins, tstar[:, None], axis=1)[:, 0]
     feasible_any = jnp.any(ok, axis=1)
     return tstar, chosen_bins.astype(jnp.int32), feasible_any

@@ -52,7 +52,10 @@ def make_sharded_solve_step(mesh: Mesh, num_bins: int):
         replicated(mesh),
     )
 
-    @partial(jax.jit, in_shardings=in_shardings, out_shardings=out_shardings)
+    # bin_ids is donated: it is a per-solve [P] i32 scratch input whose buffer
+    # XLA aliases onto the equal-sized best_type output (the program-donation
+    # contract; callers pass freshly placed arrays and never reuse the input)
+    @partial(jax.jit, in_shardings=in_shardings, out_shardings=out_shardings, donate_argnums=(8,))
     def solve_step(requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids):
         # --- [P, T] feasibility: resource fit x compat row. 2D-sharded
         # compute; XLA broadcasts pod shards against type shards over ICI.
@@ -62,7 +65,9 @@ def make_sharded_solve_step(mesh: Mesh, num_bins: int):
 
         feasible_any = jnp.any(feasible, axis=1)  # reduction over types axis
         cost = jnp.where(feasible, prices[None, :], jnp.inf)
-        best_type = jnp.argmin(cost, axis=1).astype(jnp.int32)  # types-axis argmin
+        # explicit index_dtype: jnp.argmin follows jax_enable_x64 (int64 under
+        # the flag) — the program-promotion contract pins the surface to i32
+        best_type = jax.lax.argmin(cost, 1, jnp.int32)  # types-axis argmin
 
         # --- bucket -> type choice (ops/feasibility.py:bucket_type_cost
         # inlined so the whole step is one program): types axis sharded.
@@ -75,7 +80,7 @@ def make_sharded_solve_step(mesh: Mesh, num_bins: int):
         pod_fits = jnp.all(bucket_max[:, None, :] <= caps[None, :, :] + 1e-6, axis=-1)
         ok = allowed & pod_fits & jnp.isfinite(frac)
         key = jnp.where(ok, frac * prices[None, :] + bins * 1e-4 + prices[None, :] * 1e-7, jnp.inf)
-        tstar = jnp.argmin(key, axis=1).astype(jnp.int32)
+        tstar = jax.lax.argmin(key, 1, jnp.int32)
         chosen_bins = jnp.take_along_axis(bins, tstar[:, None], axis=1)[:, 0].astype(jnp.int32)
 
         # --- audit reductions over the pod shards
@@ -89,6 +94,12 @@ def make_sharded_solve_step(mesh: Mesh, num_bins: int):
 
 def sharded_solve_step(mesh: Mesh, requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids, num_bins: int):
     fn = make_sharded_solve_step(mesh, num_bins)
+    from ..flight import FLIGHT
+
+    if FLIGHT.enabled:
+        # per-mesh wrappers share one {fn} label so compile attribution and
+        # the program contract join on the same name; registration dedupes
+        FLIGHT.register_jit_entry("sharded_solve_step", fn)
     return fn(requests, group_ids, compat, caps, prices, allowed, bucket_sum, bucket_max, bin_ids)
 
 

@@ -1499,13 +1499,17 @@ class DenseSolver:
         pair = (zmask[:, :, None] & cmask[:, None, :]).reshape(B, Z * C).astype(np.float32)
         cube = avail.reshape(T, Z * C).astype(np.float32)
         try:
-            import jax.numpy as jnp
+            # one fused jitted program (registered flight/contract entry)
+            # instead of the former eager asarray/matmul/compare chain; the
+            # cube rides as an argument — see availability_counts' docstring
+            # for why closing over it would violate the program-constant
+            # contract
+            from ..ops.feasibility import availability_counts
 
-            counts = np.asarray(jnp.matmul(jnp.asarray(pair), jnp.asarray(cube).T))
+            return np.asarray(availability_counts(pair, cube))
         except Exception as exc:  # noqa: BLE001 - the mask must never fail a solve
             log.warning("availability-mask device dispatch failed; numpy fallback: %r", exc)
-            counts = pair @ cube.T
-        return counts > 0.5
+            return (pair @ cube.T) > 0.5
 
     def _device_solve(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], taken: Optional[np.ndarray] = None):
         """Bucket→type choice on device; packing via counts (see

@@ -203,6 +203,89 @@ class TestJaxcheck:
             f"stdlib random + np.random flagged, jax.random exempt: {flagged}"
         )
 
+    def test_mesh_wrapper_spellings_are_entries(self, tmp_path):
+        """Every jit-entry spelling `parallel/` uses makes the wrapped fn an
+        entry for reachability: positional shard_map, keyword (f=), applied
+        partial, nested jit(shard_map(...)), and import-aliased."""
+        findings = _findings(tmp_path, {
+            "parallel/wrappers.py": """
+                from functools import partial
+                import jax
+                from jax.experimental.shard_map import shard_map
+                from jax.experimental.shard_map import shard_map as shmap
+
+                def pos_impl(x):
+                    return x.sum().item()
+
+                def kw_impl(x):
+                    return x.tolist()
+
+                def applied_impl(x):
+                    return float(x.sum())
+
+                def nested_impl(x):
+                    return x.max().item()
+
+                def aliased_impl(x):
+                    return x.min().item()
+
+                step_pos = shard_map(pos_impl, mesh=MESH, in_specs=P(), out_specs=P())
+                step_kw = shard_map(f=kw_impl, mesh=MESH, in_specs=P(), out_specs=P())
+                step_applied = partial(shard_map, mesh=MESH, in_specs=P(), out_specs=P())(applied_impl)
+                step_nested = jax.jit(shard_map(nested_impl, mesh=MESH, in_specs=P(), out_specs=P()))
+                step_aliased = shmap(aliased_impl, mesh=MESH, in_specs=P(), out_specs=P())
+            """,
+        })
+        keys = _keys(findings)
+        assert ("jaxcheck", "pos_impl", "item") in keys
+        assert ("jaxcheck", "kw_impl", "tolist") in keys
+        assert ("jaxcheck", "applied_impl", "float") in keys
+        assert ("jaxcheck", "nested_impl", "item") in keys
+        assert ("jaxcheck", "aliased_impl", "item") in keys
+
+    def test_non_jit_wrappers_do_not_create_entries(self, tmp_path):
+        """Negative control for the mesh-wrapper discovery: handing a fn to
+        an ordinary call (even under an f= keyword) or naming the wrapper
+        itself inside partial() must NOT make anything an entry."""
+        findings = _findings(tmp_path, {
+            "parallel/host.py": """
+                from functools import partial
+                from jax.experimental.shard_map import shard_map
+
+                def host_helper(x):
+                    return x.sum().item()  # host-side: allowed to sync
+
+                def submit(executor):
+                    executor.submit(f=host_helper)
+                    return partial(print, host_helper)
+
+                make_step = partial(shard_map, mesh=MESH)  # wrapper named, nothing wrapped
+            """,
+        })
+        assert [f for f in findings if f.rule == "jaxcheck"] == []
+
+    def test_direct_jit_invocation_arguments_are_not_entries(self, tmp_path):
+        """`jax.jit(impl)(batch)`: the outer call's operands are runtime
+        arguments — only `impl` becomes an entry, never a host function that
+        happens to share an argument's name."""
+        findings = _findings(tmp_path, {
+            "solver/direct.py": """
+                import jax
+
+                def impl(x):
+                    return x.sum().item()  # jitted: must be flagged
+
+                def batch(rows):
+                    return rows.tolist()  # host-side, shares the argument's name
+
+                def run(batch):
+                    return jax.jit(impl)(batch)
+            """,
+        })
+        keys = _keys(findings)
+        assert ("jaxcheck", "impl", "item") in keys
+        assert ("jaxcheck", "batch", "tolist") not in keys
+
     def test_host_orchestration_code_not_flagged(self, tmp_path):
         findings = _findings(tmp_path, {
             "solver/host.py": """
@@ -391,6 +474,15 @@ class TestBaseline:
         active, suppressed, stale = baseline.split([self._finding()])
         assert len(active) == 1 and suppressed == [] and len(stale) == 1
 
+    def test_unknown_rule_name_is_an_error(self):
+        """split() filters staleness by tier, so an entry naming a rule that
+        exists in NEITHER tier would be invisible to both gates — errors()
+        must reject it instead."""
+        baseline = Baseline(suppressions=[{
+            "rule": "jaxchek", "path": "p", "scope": "s", "key": "k", "justification": "typo'd rule",
+        }])
+        assert any("unknown rule" in e for e in baseline.errors())
+
     def test_unjustified_entry_is_an_error(self):
         for bad in ("  ", "TODO", "todo"):
             baseline = Baseline(suppressions=[{
@@ -460,3 +552,353 @@ class TestAnalyzeCheckRepo:
         baseline = tmp_path / "baseline.json"
         baseline.write_text(json.dumps({"suppressions": []}))
         assert analyze.run_check(root, str(baseline), out=sys.stderr) == 1
+
+
+# -- the program-contracts tier (jaxpr audit) ----------------------------------
+
+
+def _seeded_contract_doc():
+    """A contract doc over four tiny seeded entries, one drift per rule
+    class: an undonated byte-matched buffer, a donation XLA would reject, an
+    x64-sensitive promotion, and a captured constant."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.analysis import contracts
+    from karpenter_tpu.analysis.contracts import ArgSpec, EntrySpec
+
+    @jax.jit
+    def undonated(x):  # [P] f32 -> [P] f32: byte-matched output at every grid point
+        return x + 1.0
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def over_donated(x):  # donated, but only a scalar output exists to alias
+        return x.sum()
+
+    @jax.jit
+    def promoting(x):  # jnp.argmin's index dtype follows jax_enable_x64
+        return jnp.argmin(x, axis=1)
+
+    baked = jnp.arange(64, dtype=jnp.float32)  # 256 bytes >= CONST_MIN_BYTES
+
+    @jax.jit
+    def capturing(x):
+        return x[:64] + baked
+
+    def spec(name, fn, axes):
+        return EntrySpec(
+            name=name, module="karpenter_tpu/ops/fake.py",
+            resolve=lambda dims, fn=fn: fn,
+            args=(ArgSpec("x", axes, "float32"),), varying=("pods",),
+        )
+
+    return contracts.build_contracts(entries=(
+        spec("seed_undonated", undonated, ("pods",)),
+        spec("seed_over_donated", over_donated, ("pods",)),
+        spec("seed_promoting", promoting, ("pods", "resources")),
+        spec("seed_capturing", capturing, ("pods",)),
+    ))
+
+
+SEEDED_KEYS = {
+    ("program-donation", "seed_undonated", "x"),
+    ("program-donation", "seed_over_donated", "x:rejected"),
+    ("program-promotion", "seed_promoting", "argmin:int64"),
+    ("program-constant", "seed_capturing", "const:float32[64]"),
+}
+
+
+class TestProgramContracts:
+    """Contract-drift negative controls: each rule class fails `--contracts
+    --check` with the right (rule, key), a stale SOLVER_CONTRACTS.json fails
+    the staleness gate, and the recompile cross-check enforces the declared
+    varying-axis set."""
+
+    @pytest.fixture(scope="class")
+    def seeded_doc(self):
+        return _seeded_contract_doc()
+
+    def test_each_seeded_drift_yields_its_finding(self, seeded_doc):
+        from karpenter_tpu.analysis.rules.programcheck import findings_from_contracts
+
+        findings = findings_from_contracts(seeded_doc)
+        assert {(f.rule, f.scope, f.key) for f in findings} == SEEDED_KEYS
+        # every finding anchors to the entry's module path (line-independent)
+        assert {f.path for f in findings} == {"karpenter_tpu/ops/fake.py"}
+
+    def test_seeded_drifts_fail_the_gate_with_rule_and_key(self, seeded_doc, tmp_path, monkeypatch, capsys):
+        from karpenter_tpu.analysis import contracts
+
+        monkeypatch.setattr(contracts, "build_contracts", lambda entries=None: seeded_doc)
+        contracts_path = tmp_path / "SOLVER_CONTRACTS.json"
+        contracts_path.write_text(json.dumps(seeded_doc))  # committed == current: staleness green
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"suppressions": []}))
+        rc = analyze.run_contracts_check(str(tmp_path), str(baseline), str(contracts_path), out=sys.stdout)
+        out = capsys.readouterr().out
+        assert rc == 1
+        for rule, scope, key in SEEDED_KEYS:
+            assert f"{rule}[{key}]" in out, f"missing {rule}[{key}] in:\n{out}"
+
+    def test_justified_baseline_suppresses_the_gate(self, seeded_doc, tmp_path, monkeypatch):
+        from karpenter_tpu.analysis import contracts
+        from karpenter_tpu.analysis.rules.programcheck import findings_from_contracts
+
+        monkeypatch.setattr(contracts, "build_contracts", lambda entries=None: seeded_doc)
+        contracts_path = tmp_path / "SOLVER_CONTRACTS.json"
+        contracts_path.write_text(json.dumps(seeded_doc))
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"suppressions": [
+            {"rule": f.rule, "path": f.path, "scope": f.scope, "key": f.key, "justification": "seeded fixture"}
+            for f in findings_from_contracts(seeded_doc)
+        ]}))
+        assert analyze.run_contracts_check(str(tmp_path), str(baseline), str(contracts_path), out=sys.stderr) == 0
+
+    def test_stale_contracts_file_fails_the_staleness_gate(self, seeded_doc, tmp_path, monkeypatch, capsys):
+        from karpenter_tpu.analysis import contracts
+        from karpenter_tpu.analysis.rules.programcheck import findings_from_contracts
+
+        monkeypatch.setattr(contracts, "build_contracts", lambda entries=None: seeded_doc)
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"suppressions": [
+            {"rule": f.rule, "path": f.path, "scope": f.scope, "key": f.key, "justification": "seeded fixture"}
+            for f in findings_from_contracts(seeded_doc)
+        ]}))
+        contracts_path = tmp_path / "SOLVER_CONTRACTS.json"
+        # missing file: the gate demands a committed contract
+        assert analyze.run_contracts_check(str(tmp_path), str(baseline), str(contracts_path), out=sys.stdout) == 1
+        assert "missing" in capsys.readouterr().out
+        # tampered file (an entry dropped): stale, and the diff names the entry
+        tampered = json.loads(json.dumps(seeded_doc))
+        del tampered["entries"]["seed_capturing"]
+        contracts_path.write_text(json.dumps(tampered))
+        assert analyze.run_contracts_check(str(tmp_path), str(baseline), str(contracts_path), out=sys.stdout) == 1
+        out = capsys.readouterr().out
+        assert "stale" in out and "seed_capturing" in out
+
+    def test_staleness_diff_names_changed_fields(self, seeded_doc):
+        from karpenter_tpu.analysis import contracts
+
+        tampered = json.loads(json.dumps(seeded_doc))
+        tampered["digest"] = "0" * 16
+        tampered["entries"]["seed_undonated"]["varying_axes"] = ["types"]
+        errors = contracts.staleness_errors(tampered, seeded_doc)
+        assert any("seed_undonated" in e and "varying_axes" in e for e in errors)
+
+
+class TestRecompileContract:
+    """The runtime cross-check: flight-recorder recompile attribution must be
+    a subset of the contract's declared varying axes (the bench --smoke
+    steady-state gate calls exactly this)."""
+
+    @pytest.fixture(scope="class")
+    def committed(self):
+        return json.loads((REPO_ROOT / "SOLVER_CONTRACTS.json").read_text())
+
+    def _record(self, fns, attribution, signature=None):
+        return {
+            "id": 7, "recompile": bool(attribution), "recompile_attribution": attribution,
+            "compiled_fns": fns, "signature": signature or {},
+        }
+
+    def test_declared_static_axis_recompile_is_a_violation_naming_the_axis(self, committed):
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        # resource_fit declares `resources` static: a recompile attributed to
+        # it must fail, and the message must name the axis and both sides
+        record = self._record({"resource_fit": 1}, ["resources"], {"resources": 4})
+        (violation,) = recompile_violations([record], committed)
+        assert "resource_fit" in violation and "resources" in violation
+        assert "varying=" in violation and "static=" in violation
+
+    def test_declared_varying_axis_recompile_is_contract_explained(self, committed):
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        record = self._record({"resource_fit": 1}, ["pods"], {"pods": 1024})
+        assert recompile_violations([record], committed) == []
+
+    def test_cold_start_and_unattributed_compiles_are_out_of_scope(self, committed):
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        records = [
+            self._record({"resource_fit": 1}, ["cold-start"]),
+            self._record({"other": 1}, ["resources"]),
+            self._record({}, []),
+        ]
+        assert recompile_violations(records, committed) == []
+
+    def test_per_fn_first_compile_is_exempt(self, committed):
+        """An entry whose executable cache was empty at solve start (e.g. the
+        pallas flavor engaging mid-run) compiled for the first time, not
+        retraced: the solve-level shape delta says nothing about it. The same
+        record WITHOUT the first-compile marker is a violation — `pods` is
+        declared static for bucket_type_cost_pallas."""
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        record = self._record({"bucket_type_cost_pallas": 1}, ["pods"], {"pods": 900})
+        (violation,) = recompile_violations([record], committed)
+        assert "bucket_type_cost_pallas" in violation
+        record["first_compiles"] = ["bucket_type_cost_pallas"]
+        assert recompile_violations([record], committed) == []
+
+    def test_contract_dims_are_the_flight_recorders(self):
+        """The contract vocabulary is imported from flight.py, never
+        duplicated: a dimension added there can't silently read as
+        declared-static here."""
+        from karpenter_tpu.analysis.contracts import FLIGHT_DIMS
+        from karpenter_tpu.flight import _SIGNATURE_DIMS
+
+        assert FLIGHT_DIMS == tuple(_SIGNATURE_DIMS)
+
+    def test_unregistered_entry_recompile_is_a_violation(self, committed):
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        record = self._record({"mystery_fn": 1}, ["resources"])
+        (violation,) = recompile_violations([record], committed)
+        assert "mystery_fn" in violation and "no contract entry" in violation
+
+    def test_missing_contract_doc_is_itself_a_violation(self):
+        from karpenter_tpu.analysis.contracts import recompile_violations
+
+        assert recompile_violations([], None)
+
+    def test_every_registered_entry_has_a_committed_contract(self, committed):
+        """The registry (flight.py + per-mesh wrappers) and the contract
+        must stay in lockstep: every registered {fn} label has an entry with
+        declared varying axes, donation coverage, and a dtype surface."""
+        expected = {
+            "resource_fit", "feasibility_mask", "availability_counts",
+            "bucket_type_cost", "bucket_type_cost_packed", "segment_usage",
+            "audit_layout", "warm_fill_counts", "warm_fill_counts_pallas",
+            "bucket_type_cost_pallas", "sharded_solve_step", "sharded_bucket_cost",
+        }
+        assert set(committed["entries"]) == expected
+        for name, entry in committed["entries"].items():
+            assert entry["varying_axes"], name
+            assert "donation" in entry and "promotions" in entry, name
+            assert entry["args"] and all(a["dtype"] for a in entry["args"]), name
+            assert entry["captured_const_bytes"] == 0, (
+                f"{name}: the solver surface is pinned at zero captured bytes"
+            )
+
+    def test_sharded_step_donates_bin_ids(self, committed):
+        """The one legal donation the audit surfaced: sharded_solve_step's
+        [P] i32 scratch input aliases the equal-sized best_type output."""
+        entry = committed["entries"]["sharded_solve_step"]
+        assert entry["donation"]["donated"] == ["bin_ids"]
+        assert entry["donation"]["rejected"] == []
+
+
+class TestContractsBaselineRoundTrip:
+    """`--write-baseline --contracts` seeds both tiers into ONE baseline:
+    dedup, existing justifications preserved, and a one-tier reseed never
+    drops the other tier's suppressions."""
+
+    def test_round_trip_preserves_justifications_across_tiers(self, tmp_path, monkeypatch):
+        from karpenter_tpu.analysis import contracts
+
+        seeded_doc = _seeded_contract_doc()
+        monkeypatch.setattr(contracts, "build_contracts", lambda entries=None: seeded_doc)
+        root = _tree(tmp_path, {
+            "mod.py": """
+                def loop():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+            """,
+        })
+        from karpenter_tpu.analysis.core import parse_modules, run_rules
+
+        (ast_finding,) = run_rules(parse_modules(root))
+        baseline_path = tmp_path / "baseline.json"
+        baseline_path.write_text(json.dumps({"suppressions": [
+            {  # AST tier, already vetted
+                "rule": ast_finding.rule, "path": ast_finding.path, "scope": ast_finding.scope,
+                "key": ast_finding.key, "justification": "vetted ast entry",
+            },
+            {  # program tier, already vetted
+                "rule": "program-donation", "path": "karpenter_tpu/ops/fake.py",
+                "scope": "seed_undonated", "key": "x", "justification": "vetted donation entry",
+            },
+        ]}))
+
+        assert analyze.write_baseline(root, str(baseline_path), include_contracts=True) == 0
+        doc = json.loads(baseline_path.read_text())
+        by_key = {(e["rule"], e["scope"], e["key"]): e["justification"] for e in doc["suppressions"]}
+        # both tiers seeded, deduped, existing justifications preserved
+        assert by_key[(ast_finding.rule, ast_finding.scope, ast_finding.key)] == "vetted ast entry"
+        assert by_key[("program-donation", "seed_undonated", "x")] == "vetted donation entry"
+        assert by_key[("program-donation", "seed_over_donated", "x:rejected")] == "TODO"
+        assert by_key[("program-promotion", "seed_promoting", "argmin:int64")] == "TODO"
+        assert by_key[("program-constant", "seed_capturing", "const:float32[64]")] == "TODO"
+        assert len(doc["suppressions"]) == len(by_key), "deduped"
+        assert doc["suppressions"] == sorted(
+            doc["suppressions"], key=lambda e: (e["rule"], e["path"], e["scope"], e["key"])
+        )
+
+        # an AST-only reseed must keep the program tier's entries verbatim
+        assert analyze.write_baseline(root, str(baseline_path), include_contracts=False) == 0
+        after = json.loads(baseline_path.read_text())
+        after_keys = {(e["rule"], e["scope"], e["key"]): e["justification"] for e in after["suppressions"]}
+        assert after_keys[("program-donation", "seed_undonated", "x")] == "vetted donation entry"
+        assert after_keys[("program-constant", "seed_capturing", "const:float32[64]")] == "TODO"
+
+    def test_staleness_is_judged_per_tier(self):
+        """An AST-tier split must not flag a program-tier suppression stale
+        (and vice versa): the two gates share one file but judge only their
+        own rules."""
+        from karpenter_tpu.analysis.rules import CONTRACT_RULE_NAMES, RULE_NAMES
+
+        baseline = Baseline(suppressions=[
+            {"rule": "program-donation", "path": "p", "scope": "s", "key": "k", "justification": "other tier"},
+        ])
+        active, suppressed, stale = baseline.split([], rules=RULE_NAMES)
+        assert stale == [], "AST gate must ignore program-tier entries"
+        active, suppressed, stale = baseline.split([], rules=CONTRACT_RULE_NAMES)
+        assert len(stale) == 1, "the contracts gate owns its own staleness"
+
+
+class TestAnalyzeFlagContract:
+    def test_conflicting_or_incomplete_flag_combinations_are_rejected(self, capsys):
+        """`--write` without `--contracts` must not silently run a report and
+        exit 0 with nothing written; `--check` combined with a write mode is
+        ambiguous and refused."""
+        assert analyze.main(["--write"]) == 2
+        assert analyze.main(["--check", "--write-baseline"]) == 2
+        assert analyze.main(["--check", "--contracts", "--write"]) == 2
+        assert analyze.main(["--bogus"]) == 2
+
+
+class TestContractsCheckRepo:
+    def test_contracts_check_exits_zero_on_the_repo(self):
+        """The tier-1 CI gate: the committed SOLVER_CONTRACTS.json + baseline
+        audit clean against the live solver surface (staleness + violations),
+        mirroring the `analyze --check` subprocess gate."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.cmd.analyze", "--contracts", "--check"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, f"analyze --contracts --check failed:\n{proc.stderr}"
+
+    def test_contracts_check_catches_a_tampered_contract(self, tmp_path):
+        """Subprocess negative control: a root whose committed contract has
+        drifted from the real solver surface exits 1 naming the staleness."""
+        committed = json.loads((REPO_ROOT / "SOLVER_CONTRACTS.json").read_text())
+        committed["entries"]["resource_fit"]["varying_axes"] = ["zones"]
+        committed["digest"] = "0" * 16
+        (tmp_path / "SOLVER_CONTRACTS.json").write_text(json.dumps(committed))
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.cmd.analyze", "--contracts", "--check", str(tmp_path)],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 1
+        assert "stale" in proc.stderr and "resource_fit" in proc.stderr

@@ -29,11 +29,20 @@ def test_bench_smoke():
     # the steady-state recompile gate ran and held: re-solving warm shapes
     # compiled nothing (the flight recorder's headline property)
     assert summary.pop("steady_state_recompiles") == 0
+    # the recompile-axis contract cross-check ran against the committed
+    # SOLVER_CONTRACTS.json and every attributed recompile was explained by
+    # a declared-varying axis (analysis/contracts.py recompile_violations)
+    assert summary.pop("contract_recompile_violations") == 0
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name
         # the per-pod fill routing counters are part of the schema
         assert "fill_pods_vectorized" in info and "fill_pods_host" in info, name
+        # host-fallback residue gate (ROADMAP item 5): no smoke workload
+        # carries a multi-rule affinity cohort, so the host fill loop must
+        # see zero pods on every config
+        assert name in bench.SMOKE_ZERO_HOST_FILL_CONFIGS, name
+        assert info["fill_pods_host"] == 0, (name, info["fill_pods_host"])
         # the offering-availability mask stat + phase key are part of the
         # schema for EVERY config (PR 9 follow-up: previously only the
         # ice_mask shape was asserted)
