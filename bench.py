@@ -68,6 +68,119 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# -- bench --compare: per-config, per-phase regression diff -------------------
+
+# the phase keys judged for regression (ms medians in the phases block) plus
+# `compilations` — a steady-state compile-count increase is a regression by
+# definition, not noise. Informational keys (hbm, fill routing, span trees)
+# are diffed in the report but never gate.
+COMPARE_PHASE_KEYS = ("encode", "fill", "device", "mask", "assemble", "commit", "fill_device", "compilations")
+COMPARE_DEFAULT_THRESHOLD = 10.0  # percent
+
+
+def _compare_payload(doc: dict) -> dict:
+    """Accept either bench.py's own emitted JSON (configs/phases at the top)
+    or the committed BENCH_r0x wrapper shape ({"parsed": {...}, ...})."""
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "configs" not in doc and "phases" not in doc:
+        return parsed
+    return doc
+
+
+def compare_phases(old_doc: dict, new_doc: dict, threshold_pct: float = COMPARE_DEFAULT_THRESHOLD):
+    """Diff two bench artifacts per config and per phase. Returns
+    (report_lines, regressions): every compared value is a report line;
+    a value that grew by more than `threshold_pct` percent (strictly, and
+    from a nonzero base — a phase appearing from zero is reported as `new`
+    but judged only when it is a counter) is also a regression."""
+    old_doc, new_doc = _compare_payload(old_doc), _compare_payload(new_doc)
+    lines: list = []
+    regressions: list = []
+
+    def judge(where: str, old_v, new_v, gate: bool) -> None:
+        if old_v is None:
+            lines.append(f"  {where}: (new) {new_v}")
+            return
+        if new_v is None:
+            lines.append(f"  {where}: {old_v} -> (gone)")
+            return
+        if old_v > 0:
+            pct = (new_v - old_v) / old_v * 100.0
+            verdict = ""
+            if gate and pct > threshold_pct:
+                verdict = f"  REGRESSION (> {threshold_pct:g}%)"
+                regressions.append(f"{where}: {old_v} -> {new_v} (+{pct:.1f}% > {threshold_pct:g}%)")
+            lines.append(f"  {where}: {old_v} -> {new_v} ({pct:+.1f}%){verdict}")
+        elif new_v > 0 and gate and where.endswith("compilations"):
+            # a counter stepping off zero has no percentage; it still gates
+            regressions.append(f"{where}: 0 -> {new_v} (compile churn from zero)")
+            lines.append(f"  {where}: 0 -> {new_v}  REGRESSION (compile churn from zero)")
+        else:
+            lines.append(f"  {where}: {old_v} -> {new_v}")
+
+    old_configs = old_doc.get("configs") or {}
+    new_configs = new_doc.get("configs") or {}
+    lines.append("configs (total ms):")
+    for name in sorted(set(old_configs) | set(new_configs)):
+        judge(name, old_configs.get(name), new_configs.get(name), gate=True)
+
+    old_phases = old_doc.get("phases") or {}
+    new_phases = new_doc.get("phases") or {}
+    for name in sorted(set(old_phases) | set(new_phases)):
+        lines.append(f"phases [{name}]:")
+        old_block, new_block = old_phases.get(name, {}), new_phases.get(name, {})
+        for key in COMPARE_PHASE_KEYS:
+            if key in old_block or key in new_block:
+                judge(f"{name}.{key}", old_block.get(key), new_block.get(key), gate=True)
+        # informational-only numeric keys: visible in the diff, never gating
+        for key in sorted(set(old_block) | set(new_block)):
+            if key in COMPARE_PHASE_KEYS:
+                continue
+            old_v, new_v = old_block.get(key), new_block.get(key)
+            if isinstance(old_v, (int, float)) or isinstance(new_v, (int, float)):
+                judge(f"{name}.{key}", old_v, new_v, gate=False)
+    return lines, regressions
+
+
+def compare_main(argv) -> int:
+    """`bench.py --compare OLD.json NEW.json [--threshold PCT]`: per-config,
+    per-phase regression diff of two bench phases artifacts. Exit 0 when NEW
+    is within the threshold of OLD everywhere, 1 with the regressions listed
+    on stderr otherwise (the BENCH_r0x trajectory, tooled). Pure JSON — runs
+    without jax, so CI can gate artifacts on any box."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="bench.py --compare")
+    parser.add_argument("old", help="baseline bench phases JSON (or BENCH_r0x wrapper)")
+    parser.add_argument("new", help="candidate bench phases JSON (or BENCH_r0x wrapper)")
+    parser.add_argument(
+        "--threshold", type=float, default=COMPARE_DEFAULT_THRESHOLD,
+        help=f"regression threshold in percent (default {COMPARE_DEFAULT_THRESHOLD:g})",
+    )
+    args = parser.parse_args(argv)
+    if args.threshold < 0:
+        parser.error("--threshold must be non-negative")
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path, encoding="utf-8") as f:
+                docs.append(json.load(f))
+        except (OSError, json.JSONDecodeError) as err:
+            print(f"bench --compare: cannot read {path}: {err}", file=sys.stderr)
+            return 2
+    lines, regressions = compare_phases(docs[0], docs[1], threshold_pct=args.threshold)
+    print(f"bench --compare: {args.old} -> {args.new} (threshold {args.threshold:g}%)")
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"{len(regressions)} regression(s) past {args.threshold:g}%:", file=sys.stderr)
+        for regression in regressions:
+            print(f"  {regression}", file=sys.stderr)
+        return 1
+    print("no regressions")
+    return 0
+
+
 def profile_config(name, pods, provider, provisioners, solver, state_nodes=()):
     """Per-config profile artifacts (the scheduling_benchmark_test.go:76-108
     CPU/heap-profile grid analog): one profiled solve per config emitting
@@ -792,6 +905,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        sys.exit(compare_main(sys.argv[sys.argv.index("--compare") + 1 :]))
     if "--smoke" in sys.argv:
         print(json.dumps(smoke()))
         sys.exit(0)

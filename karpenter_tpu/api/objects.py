@@ -265,6 +265,11 @@ class PodStatus:
     phase: str = "Pending"
     conditions: List[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
+    # stamped by the bind verb with the store's clock (the PodStatus.startTime
+    # analog): the one authoritative bind instant, so every observer of the
+    # creation->bind interval (SLO accountant, lifecycle journal) reads the
+    # SAME number instead of measuring watch-dispatch time independently
+    start_time: Optional[float] = None
 
 
 @dataclass

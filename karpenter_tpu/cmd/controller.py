@@ -126,6 +126,13 @@ def main(argv=None) -> int:
 
         extra_routes.update(flight.routes())
         debug_descriptions.update(flight.route_descriptions())
+    if options.enable_journal:
+        # lifecycle journal read surface: the pod/node transition stream and
+        # the pending-latency waterfall decomposition on the metrics port
+        from .. import journal
+
+        extra_routes.update(journal.routes())
+        debug_descriptions.update(journal.route_descriptions())
     extra_routes["/debug"] = debug_index_route(debug_descriptions)
     obs = ObservabilityServer(
         healthy=runtime.healthy,

@@ -23,6 +23,7 @@ from typing import List, Optional
 from ...api import labels as lbl
 from ...api.objects import Node, OwnerReference
 from ...api.provisioner import Provisioner
+from ...journal import JOURNAL
 from ...kube.cluster import KubeCluster
 from ...logsetup import get_logger
 from ...utils import pod as podutils
@@ -102,6 +103,8 @@ class NodeController:
         if not self._extended_resources_registered(node):
             return False
         node.metadata.labels[lbl.LABEL_NODE_INITIALIZED] = "true"
+        if JOURNAL.enabled:
+            JOURNAL.node_event(node.name, "initialized", provisioner=provisioner.name)
         log.info("node %s initialized (ready, startup taints cleared, extended resources registered)", node.name)
         return True
 

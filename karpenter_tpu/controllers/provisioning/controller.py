@@ -7,6 +7,7 @@ does the rest.
 
 from __future__ import annotations
 
+from ...journal import JOURNAL
 from ...kube.cluster import DELETED, KubeCluster, WatchEvent
 from ...utils import pod as podutils
 from .provisioner import ProvisionerController
@@ -27,4 +28,8 @@ class ProvisioningReconciler:
         if event.type == DELETED:
             return
         if podutils.is_provisionable(event.obj):
+            if JOURNAL.enabled:
+                # `queued`: the pod entered the batch window — the boundary
+                # between the waterfall's queue_wait and batch_wait segments
+                JOURNAL.pod_event(event.obj.metadata.name, "queued")
             self.provisioner.trigger()

@@ -26,6 +26,8 @@ from ...events import Recorder
 from ...kube.cluster import Conflict, KubeCluster
 from ...metrics import REGISTRY
 from ...cloudprovider.errors import InsufficientCapacityError
+from ...flight import FLIGHT
+from ...journal import JOURNAL
 from ...scheduler import SchedulerOptions, build_scheduler
 from ...scheduler.scheduler import SchedulingResults
 from ...tracing import DECISIONS, OUTCOME_FAILED, TRACER, DecisionRecord
@@ -181,8 +183,12 @@ class ProvisionerController:
         with TRACER.span("batch") as sp:
             pods = self.get_pods()
             sp.set(pods=len(pods), state_nodes=len(state_nodes))
+        if JOURNAL.enabled:
+            trace_id = TRACER.current_trace_id() or ""
+            for pod in pods:
+                JOURNAL.pod_event(pod.metadata.name, "batch-admitted", trace_id=trace_id)
         start = self.clock.now()
-        results = self.schedule(pods, state_nodes)
+        results = self._schedule_journaled(pods, state_nodes)
         ice_failed: List[object] = []
         launched = self.launch_nodes(results, ice_failures=ice_failed)
         # fallback re-solve: a typed insufficient-capacity launch failure
@@ -197,7 +203,7 @@ class ProvisionerController:
                 break
             retry_pods = [p for vn in ice_failed for p in vn.pods]
             with TRACER.span("ice-resolve", attempt=attempt + 1, pods=len(retry_pods)):
-                retry_results = self.schedule(retry_pods, self.cluster.nodes_snapshot())
+                retry_results = self._schedule_journaled(retry_pods, self.cluster.nodes_snapshot())
                 any_unschedulable |= bool(retry_results.unschedulable)
                 ice_failed = []
                 launched += self.launch_nodes(retry_results, ice_failures=ice_failed)
@@ -228,6 +234,43 @@ class ProvisionerController:
             )
         return results
 
+    def _schedule_journaled(self, pods: Sequence[Pod], state_nodes: Sequence[object]) -> SchedulingResults:
+        """schedule() plus per-pod lifecycle events — ONLY for the real
+        provisioning round (simulation re-solves through schedule() directly
+        and must journal nothing, like the decision log)."""
+        if not JOURNAL.enabled:
+            return self.schedule(pods, state_nodes)
+        rid_before = FLIGHT.last_record_id()
+        results = self.schedule(pods, state_nodes)
+        rid = FLIGHT.last_record_id()
+        self._journal_solve_results(results, rid if rid != rid_before else None)
+        return results
+
+    def _journal_solve_results(self, results: SchedulingResults, flight_record) -> None:
+        """Per-pod `solved`/`failed` journal events cross-linked to the
+        round's trace and (when the dense path dispatched) the flight-record
+        solve id. First occurrence wins in the journal, so an ICE re-solve
+        never rewrites a pod's original solve instant."""
+        trace_id = TRACER.current_trace_id() or ""
+        for vn in results.new_nodes:
+            if not vn.pods:
+                continue
+            instance_type = vn.instance_type_options[0].name() if vn.instance_type_options else ""
+            for pod in vn.pods:
+                JOURNAL.pod_event(
+                    pod.metadata.name, "solved", placement="new", provisioner=vn.provisioner_name,
+                    instance_type=instance_type, trace_id=trace_id, flight_record=flight_record,
+                )
+        for view in results.existing_nodes:
+            provisioner = view.node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, "")
+            for pod in view.pods:
+                JOURNAL.pod_event(
+                    pod.metadata.name, "solved", placement="existing", provisioner=provisioner,
+                    node=view.node.name, trace_id=trace_id, flight_record=flight_record,
+                )
+        for pod, err in results.unschedulable.items():
+            JOURNAL.pod_event(pod.metadata.name, "failed", error=str(err)[:200], trace_id=trace_id)
+
     def _park_ice_failures(self, failed_nodes) -> None:
         """Terminal rung of the escalation ladder: every re-solve attempt
         still hit insufficient capacity. Mark each pod unschedulable — an
@@ -240,6 +283,10 @@ class ProvisionerController:
                 self.recorder.pod_failed_to_schedule(
                     pod, "insufficient capacity: every offering exhausted; backing off"
                 )
+                if JOURNAL.enabled:
+                    JOURNAL.pod_event(
+                        pod.metadata.name, "failed", error="insufficient capacity: escalation exhausted"
+                    )
                 if TRACER.enabled:
                     DECISIONS.record(
                         DecisionRecord(
@@ -427,12 +474,15 @@ class ProvisionerController:
         # nominate pods onto existing nodes they were scheduled against
         with TRACER.span("bind") as sp:
             nominated = 0
+            journal_on = JOURNAL.enabled
             for view in results.existing_nodes:
                 if view.pods:
                     self.cluster.nominate_node_for_pod(view.node.name)
                     for pod in view.pods:
                         self.recorder.nominate_pod(pod, view.node)
                         nominated += 1
+                        if journal_on:
+                            JOURNAL.pod_event(pod.metadata.name, "nominated", node=view.node.name)
             sp.set(nominated=nominated)
         return launched
 
@@ -443,6 +493,12 @@ class ProvisionerController:
             return self._launch_one(virtual_node, sp, ice_failures)
 
     def _launch_one(self, virtual_node, sp, ice_failures: Optional[List[object]] = None) -> Optional[str]:
+        requested_as = getattr(virtual_node, "_hostname", "")
+        if JOURNAL.enabled and requested_as:
+            JOURNAL.node_event(
+                requested_as, "launch-requested", provisioner=virtual_node.provisioner_name,
+                pods=len(virtual_node.pods), trace_id=TRACER.current_trace_id() or "",
+            )
         try:
             node = self.cloud_provider.create(
                 NodeRequest(template=virtual_node.template, instance_type_options=virtual_node.instance_type_options)
@@ -467,6 +523,14 @@ class ProvisionerController:
             for pod in virtual_node.pods:
                 self.recorder.pod_failed_to_schedule(pod, f"launch failed: {e}")
             return None
+        if JOURNAL.enabled:
+            # `launched` (cloud instance exists) precedes `registered` (the
+            # node object lands in the API on the create below)
+            JOURNAL.node_event(
+                node.name, "launched", requested_as=requested_as,
+                instance_type=node.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE, ""),
+                provisioner=virtual_node.provisioner_name, trace_id=TRACER.current_trace_id() or "",
+            )
         try:
             self.kube.create(node)
         except Conflict:
@@ -485,8 +549,11 @@ class ProvisionerController:
             )
         self.recorder.launching_node(node, f"for {len(virtual_node.pods)} pod(s)")
         self.cluster.nominate_node_for_pod(node.name)
+        journal_on = JOURNAL.enabled
         for pod in virtual_node.pods:
             self.recorder.nominate_pod(pod, node)
+            if journal_on:
+                JOURNAL.pod_event(pod.metadata.name, "nominated", node=node.name)
         return node.name
 
     def _provisioner_usage(self, provisioner_name: str) -> Dict[str, float]:

@@ -458,6 +458,14 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring) if self._ring is not None else []
 
+    def last_record_id(self) -> Optional[int]:
+        """Id of the newest recorded solve (the journal's per-pod `solved`
+        events cross-link to it); None when nothing is recorded."""
+        with self._lock:
+            if not self._ring:
+                return None
+            return self._ring[-1].id
+
     def record_by_id(self, record_id: int) -> Optional[SolveRecord]:
         with self._lock:
             if self._ring is None:

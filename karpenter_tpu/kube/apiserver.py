@@ -355,6 +355,9 @@ class APIServerState:
             pod.setdefault("spec", {})["nodeName"] = node_name
             status = pod.setdefault("status", {})
             status["phase"] = "Running"
+            # the authoritative bind instant (PodStatus.startTime): watchers
+            # measure creation->bind off this stamp, not their dispatch time
+            status["startTime"] = self._now()
             status["conditions"] = [c for c in status.get("conditions", []) if c.get("type") != "PodScheduled"]
             self._bump(pod)
             self._emit("Pod", "MODIFIED", pod)

@@ -214,6 +214,9 @@ class KubeCluster:
         test environment uses it the way expectations.ExpectScheduled does."""
         pod.spec.node_name = node_name
         pod.status.phase = "Running"
+        # the authoritative bind instant (PodStatus.startTime): watchers
+        # measure creation->bind off this stamp, not their dispatch time
+        pod.status.start_time = self.clock.now()
         pod.status.conditions = [c for c in pod.status.conditions if c.type != "PodScheduled"]
         self.update(pod)
 

@@ -32,6 +32,7 @@ from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
 from .logsetup import configure as configure_logging, get_logger, set_level
 from .flight import FLIGHT
+from .journal import JOURNAL
 from .metrics import REGISTRY
 from .slo import SLO
 from .tracing import TRACER
@@ -91,6 +92,17 @@ class Runtime:
             # records, XLA compile-churn attribution, HBM gauges — served
             # over /debug/solver on the metrics port
             FLIGHT.enable(capacity=self.options.flight_ring_size)
+        if self.options.enable_journal:
+            # the lifecycle journal (journal.py): pod/node transition stream
+            # + the pending-latency waterfall over /debug/journal and
+            # /debug/waterfall. The watch hooks attach below, AFTER the kube
+            # backend exists but BEFORE the SLO accountant's (the journal's
+            # bound handler must complete a pod's waterfall before the SLO
+            # hook cross-feeds the observed pending duration into it)
+            JOURNAL.enable(capacity=self.options.journal_ring_size)
+            if self.options.journal_spool:
+                JOURNAL.set_spool(self.options.journal_spool, self.options.journal_spool_max_bytes)
+            JOURNAL.attach(self.kube)
         self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree

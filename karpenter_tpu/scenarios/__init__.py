@@ -20,6 +20,7 @@ from .primitives import (
     SpotReclaimWave,
     TransportChaos,
 )
+from .replay import ReplayTrace
 from .schema import scenario_doc_errors
 from .standin import WorkloadStandIn, workload_pod
 
@@ -34,6 +35,7 @@ __all__ = [
     "PoolCapacity",
     "Primitive",
     "ProcessCrash",
+    "ReplayTrace",
     "ScaleTo",
     "Scenario",
     "ScenarioContext",

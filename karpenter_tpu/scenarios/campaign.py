@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import flight, slo
+from .. import flight, journal, slo
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
 from ..api.provisioner import Budget, Consolidation, Disruption, Provisioner, ProvisionerSpec
@@ -258,11 +258,17 @@ class CampaignRunner:
         transports=TRANSPORTS,
         sample_period: float = 0.4,
         convergence_timeout: float = 60.0,
+        journal_dir: Optional[str] = None,
     ):
         self.out_dir = out_dir
         self.transports = tuple(transports)
         self.sample_period = sample_period
         self.convergence_timeout = convergence_timeout
+        # when set, each run spools its lifecycle journal to
+        # <journal_dir>/JOURNAL_<scenario>_<transport>.jsonl — the captured
+        # arrival trace ReplayTrace replays (the SCENARIO artifacts stay the
+        # committed record; journals are capture output, not comparison data)
+        self.journal_dir = journal_dir
 
     # -- one scenario on one transport ----------------------------------------
 
@@ -271,6 +277,7 @@ class CampaignRunner:
             raise ValueError(f"unknown transport {transport!r}; one of {TRANSPORTS}")
         slo.SLO.reset()
         flight.FLIGHT.reset()  # per-run solver-latency quantiles + records
+        journal.JOURNAL.reset()  # per-run lifecycle events + waterfalls
         kube = KubeCluster()
         backend = CloudBackend(clock=kube.clock)
         backend.notifications.visibility_timeout = 1.0
@@ -308,6 +315,11 @@ class CampaignRunner:
                     # recompiles_total (must be 0 for a settled cluster
                     # re-solving under churn) + solver-latency p95
                     enable_solver_telemetry=True,
+                    # the lifecycle journal decomposes every pod's pending
+                    # latency into waterfall segments (scored below, with
+                    # the conservation invariant enforced) and records the
+                    # arrival trace replay builds on
+                    enable_journal=True,
                     gc_interval=1.0,
                     gc_registration_grace=3.0,
                     # scenario timescales are seconds: a parked pod must
@@ -317,6 +329,11 @@ class CampaignRunner:
             )
 
         runtime = runtime_factory()
+        if self.journal_dir is not None:
+            os.makedirs(self.journal_dir, exist_ok=True)
+            journal.JOURNAL.set_spool(
+                os.path.join(self.journal_dir, f"JOURNAL_{scenario.name}_{transport}.jsonl")
+            )
         provisioner = _provisioner(scenario)
         kube.create(provisioner)
         ctx = ScenarioContext(
@@ -365,6 +382,14 @@ class CampaignRunner:
             ctx.runtime.slo_metrics.compute_drift()
             violations += self._sample(ctx, provisioner, samples, start)
             snapshot = slo.SLO.snapshot()
+            # the conservation invariant, enforced at emit time like the
+            # schema: every completed pod's segments must sum to the pending
+            # duration the SLO accountant independently observed
+            conservation = journal.JOURNAL.conservation_errors()
+            if conservation:
+                raise AssertionError(
+                    f"[{scenario.name}/{transport}] waterfall conservation violated: {conservation[:5]}"
+                )
             pods = live_pods(kube)
             run = {
                 "transport": transport,
@@ -388,6 +413,7 @@ class CampaignRunner:
                     "unschedulable_pod_seconds": _unschedulable_pod_seconds(samples),
                     "recompiles_total": flight.FLIGHT.compilations_total() - recompiles_at_start,
                     "solver_latency_p95_seconds": _solver_latency_p95(),
+                    "waterfall": journal.JOURNAL.segment_quantiles(),
                 },
                 "samples": samples,
             }
@@ -414,6 +440,8 @@ class CampaignRunner:
             # run_one re-enables through its own Runtime)
             slo.SLO.disable()
             flight.FLIGHT.disable()
+            journal.JOURNAL.set_spool(None)  # close (and keep) the capture
+            journal.JOURNAL.disable()
 
     @staticmethod
     def _run_primitive(ctx: ScenarioContext, primitive) -> None:
@@ -637,6 +665,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--transports", default=",".join(TRANSPORTS), help="comma-separated: inprocess,http")
     parser.add_argument("--smoke", action="store_true", help="run the tier-1 smoke campaign instead of the full one")
     parser.add_argument("--scenarios", default="", help="comma-separated subset of scenario names")
+    parser.add_argument(
+        "--journal-dir", default=None,
+        help="spool each run's lifecycle journal to JOURNAL_<scenario>_<transport>.jsonl here (replay capture)",
+    )
     args = parser.parse_args(argv)
     scenarios = smoke_campaign() if args.smoke else default_campaign()
     if args.scenarios:
@@ -644,7 +676,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         scenarios = [s for s in scenarios if s.name in wanted]
         if not scenarios:
             parser.error(f"no scenario matches {sorted(wanted)}")
-    runner = CampaignRunner(out_dir=args.out, transports=tuple(args.transports.split(",")))
+    runner = CampaignRunner(out_dir=args.out, transports=tuple(args.transports.split(",")), journal_dir=args.journal_dir)
     docs = runner.run(scenarios)
     summary = {
         doc["scenario"]: {

@@ -40,7 +40,18 @@ SCORE_KEYS = (
     # Scheduler.solve wall-clock (null when the run solved nothing)
     "recompiles_total",
     "solver_latency_p95_seconds",
+    # the pending-latency waterfall (journal.py): per-segment p50/p95/p99
+    # decomposing creation->bind into queue_wait / batch_wait / solve /
+    # launch / node_ready / bind — the runner asserts the conservation
+    # invariant (segments sum to the observed pending duration) before
+    # this block is allowed to land in the artifact
+    "waterfall",
 )
+
+# the journal's waterfall segment vocabulary (journal.SEGMENTS mirrored by
+# name only — the schema stays importable without the journal's witness/
+# metrics imports in consumers that just validate files)
+WATERFALL_SEGMENTS = ("queue_wait", "batch_wait", "solve", "launch", "node_ready", "bind")
 QUANTILE_KEYS = ("p50", "p95", "p99", "count")
 SAMPLE_KEYS = ("t", "pending_pods", "nodes", "cost_per_hour", "disrupting")
 
@@ -84,6 +95,23 @@ def run_errors(run, where: str = "run") -> List[str]:
         if p95 is not None and (not isinstance(p95, (int, float)) or isinstance(p95, bool) or p95 < 0):
             errs.append(f"{where}.scores.solver_latency_p95_seconds must be null or a non-negative number")
         errs.extend(_quantile_errors(scores.get("pending_latency_seconds", {}), f"{where}.scores.pending_latency_seconds"))
+        waterfall = scores.get("waterfall")
+        if isinstance(waterfall, dict):
+            for segment, entry in waterfall.items():
+                if segment not in WATERFALL_SEGMENTS:
+                    errs.append(
+                        f"{where}.scores.waterfall[{segment!r}] is not a waterfall segment"
+                        f" (one of {list(WATERFALL_SEGMENTS)})"
+                    )
+                    continue
+                if not isinstance(entry, dict):
+                    errs.append(f"{where}.scores.waterfall[{segment!r}] must be a dict, got {type(entry).__name__}")
+                    continue
+                for key in QUANTILE_KEYS:
+                    if key not in entry:
+                        errs.append(f"{where}.scores.waterfall[{segment!r}] missing {key!r}")
+        elif waterfall is not None:
+            errs.append(f"{where}.scores.waterfall must be a dict of per-segment quantiles")
     elif scores is not None:
         errs.append(f"{where}.scores must be a dict")
     samples = run.get("samples")

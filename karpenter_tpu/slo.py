@@ -39,6 +39,7 @@ import threading
 from typing import Dict, Optional
 
 from .api import labels as lbl
+from .journal import JOURNAL
 from .metrics import REGISTRY
 
 NOT_APPLICABLE = "N/A"
@@ -175,12 +176,23 @@ class SLOAccountant:
             PENDING_PODS.set(float(len(self._pending)))
         if start is None:
             return  # bound before we ever saw it pending (attach mid-flight)
+        # the interval ends at the bind verb's authoritative stamp, NOT at
+        # this handler's dispatch time: on the HTTP transport the node lookup
+        # below is a network round trip that must not inflate the latency
+        # (and the journal's waterfall conserves against this same stamp)
+        end = pod.status.start_time if pod.status.start_time is not None else kube.clock.now()
         node = kube.get_node(pod.spec.node_name)
         if node is not None:
             provisioner = node.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL, NOT_APPLICABLE)
         else:
             provisioner = pod.spec.node_selector.get(lbl.PROVISIONER_NAME_LABEL, NOT_APPLICABLE)
-        PENDING_LATENCY.observe(max(0.0, kube.clock.now() - start), provisioner=provisioner)
+        observed = max(0.0, end - start)
+        PENDING_LATENCY.observe(observed, provisioner=provisioner)
+        if JOURNAL.enabled:
+            # cross-feed the journal's waterfall: the conservation invariant
+            # checks the per-segment decomposition against THIS independent
+            # measurement of the same creation->bind interval
+            JOURNAL.note_observed_pending(pod.metadata.name, observed)
 
     def _on_node_event(self, kube, event) -> None:
         if not self.enabled:

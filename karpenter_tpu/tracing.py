@@ -501,6 +501,16 @@ class DecisionLog:
         with self._lock:
             return [r.to_dict() for r in self._ring if r.pod == pod]
 
+    def latest_outcome_for(self, pod: str) -> Optional[dict]:
+        """The newest decision record for one pod (the journal's waterfall
+        detail joins it so /debug/waterfall?pod= answers outcome + rejection
+        tallies in the same page); None when the ring holds nothing."""
+        with self._lock:
+            for record in reversed(self._ring):
+                if record.pod == pod:
+                    return record.to_dict()
+        return None
+
     def recent(self, limit: int = 100, outcome: Optional[str] = None) -> List[dict]:
         """Newest-first records, bounded by `limit`; `outcome` filters to one
         outcome class BEFORE bounding (so ?outcome=failed&limit=50 is the

@@ -47,6 +47,17 @@ class Options:
     # on the solve path (same bar as tracing)
     enable_solver_telemetry: bool = False
     flight_ring_size: int = 128  # per-solve records retained (bounded ring)
+    # lifecycle journal (journal.py): pod/node transition stream + the
+    # pending-latency waterfall, served on /debug/journal and /debug/waterfall
+    # over the metrics port. Off by default — a disabled journal is a true
+    # no-op: no ring, no watch hooks, one attribute read per event site
+    enable_journal: bool = False
+    journal_ring_size: int = 8192  # lifecycle events retained (bounded ring)
+    # append-only JSONL spool for the journal (the on-disk trace format the
+    # replay harness consumes); empty = in-memory only. The spool is
+    # size-bounded: live + one rotation never exceed journal_spool_max_bytes
+    journal_spool: str = ""
+    journal_spool_max_bytes: int = 16 * 2**20
     leader_elect: bool = True
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -117,6 +128,10 @@ class Options:
             errs.append("trace ring size must be positive")
         if self.flight_ring_size <= 0:
             errs.append("flight ring size must be positive")
+        if self.journal_ring_size <= 0:
+            errs.append("journal ring size must be positive")
+        if self.journal_spool_max_bytes <= 0:
+            errs.append("journal spool max bytes must be positive")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -148,8 +163,12 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--enable-slo", action="store_true", default=_env("ENABLE_SLO", defaults.enable_slo))
     parser.add_argument("--enable-lock-witness", action="store_true", default=_env("ENABLE_LOCK_WITNESS", defaults.enable_lock_witness))
     parser.add_argument("--enable-solver-telemetry", action="store_true", default=_env("ENABLE_SOLVER_TELEMETRY", defaults.enable_solver_telemetry))
+    parser.add_argument("--enable-journal", action="store_true", default=_env("ENABLE_JOURNAL", defaults.enable_journal))
     parser.add_argument("--trace-ring-size", type=int, default=_env("TRACE_RING_SIZE", defaults.trace_ring_size))
     parser.add_argument("--flight-ring-size", type=int, default=_env("FLIGHT_RING_SIZE", defaults.flight_ring_size))
+    parser.add_argument("--journal-ring-size", type=int, default=_env("JOURNAL_RING_SIZE", defaults.journal_ring_size))
+    parser.add_argument("--journal-spool", default=_env("JOURNAL_SPOOL", defaults.journal_spool))
+    parser.add_argument("--journal-spool-max-bytes", type=int, default=_env("JOURNAL_SPOOL_MAX_BYTES", defaults.journal_spool_max_bytes))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
     parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))

@@ -83,3 +83,96 @@ def test_bench_smoke():
         queue_attrs
     )
     assert queue_attrs["dead_letter_depth"] == 1
+
+
+class TestBenchCompare:
+    """`bench.py --compare OLD.json NEW.json`: the BENCH_r0x trajectory,
+    tooled — per-config, per-phase regression diff with a threshold flag and
+    a nonzero exit on regression. Pure-JSON: the subprocess gate runs the
+    real CLI the way CI would, with a seeded regression as negative control."""
+
+    @staticmethod
+    def _artifact(device_ms: float, compilations: int = 0) -> dict:
+        return {
+            "configs": {"anti_spread_10k_x_500": 400.0 + device_ms, "ffd_parity_1k_x_50": 50.0},
+            "phases": {
+                "anti_spread_10k_x_500": {
+                    "encode": 40.0,
+                    "fill": 10.0,
+                    "device": device_ms,
+                    "mask": 1.0,
+                    "assemble": 5.0,
+                    "commit": 20.0,
+                    "fill_device": 0.0,
+                    "compilations": compilations,
+                    "hbm_peak_bytes": 1_000_000,
+                }
+            },
+        }
+
+    def _run(self, tmp_path, old, new, *extra):
+        import json as _json
+        import subprocess
+        import sys
+
+        old_path, new_path = tmp_path / "old.json", tmp_path / "new.json"
+        old_path.write_text(_json.dumps(old))
+        new_path.write_text(_json.dumps(new))
+        return subprocess.run(
+            [sys.executable, "bench.py", "--compare", str(old_path), str(new_path), *extra],
+            cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+
+    def test_within_threshold_exits_zero(self, tmp_path):
+        proc = self._run(tmp_path, self._artifact(100.0), self._artifact(105.0))
+        assert proc.returncode == 0, proc.stderr
+        assert "no regressions" in proc.stdout
+
+    def test_seeded_regression_exits_nonzero_naming_config_and_phase(self, tmp_path):
+        # negative control: device phase +50% past the default 10% threshold
+        proc = self._run(tmp_path, self._artifact(100.0), self._artifact(150.0))
+        assert proc.returncode == 1, proc.stdout
+        assert "anti_spread_10k_x_500.device" in proc.stderr
+        assert "+50.0%" in proc.stderr
+
+    def test_threshold_flag_loosens_the_gate(self, tmp_path):
+        proc = self._run(tmp_path, self._artifact(100.0), self._artifact(150.0), "--threshold", "60")
+        assert proc.returncode == 0, proc.stderr
+
+    def test_compile_churn_from_zero_gates(self, tmp_path):
+        # a compile count stepping off zero has no percentage but still gates
+        proc = self._run(tmp_path, self._artifact(100.0), self._artifact(100.0, compilations=3))
+        assert proc.returncode == 1
+        assert "compile churn" in proc.stderr
+
+    def test_wrapper_shape_accepted(self, tmp_path):
+        # the committed BENCH_r0x artifacts wrap the payload under "parsed"
+        proc = self._run(tmp_path, {"parsed": self._artifact(100.0), "rc": 0}, self._artifact(104.0))
+        assert proc.returncode == 0, proc.stderr
+
+    def test_unreadable_input_exits_two(self, tmp_path):
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--compare", str(tmp_path / "missing.json"), str(tmp_path / "also.json")],
+            cwd=str(Path(__file__).resolve().parent.parent),
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "cannot read" in proc.stderr
+
+    def test_compare_phases_unit(self):
+        import bench
+
+        lines, regressions = bench.compare_phases(self._artifact(100.0), self._artifact(150.0))
+        assert any("device" in r for r in regressions)
+        # informational keys (hbm) are diffed but never gate
+        assert any("hbm_peak_bytes" in line for line in lines)
+        assert not any("hbm" in r for r in regressions)

@@ -368,11 +368,11 @@ class TestDebugIndexRoute:
         """Every debug module's route_descriptions() must key exactly its
         routes() — cmd/controller.py builds the /debug index from these
         pairs, so a drifted key would list a dead path or hide a live one."""
-        from karpenter_tpu import slo, tracing
+        from karpenter_tpu import journal, slo, tracing
         from karpenter_tpu.analysis import witness
         from karpenter_tpu.profiling import LiveProfiler
 
-        for mod in (tracing, slo, witness, flight):
+        for mod in (tracing, slo, witness, flight, journal):
             assert set(mod.route_descriptions()) == set(mod.routes()), mod.__name__
         profiler = LiveProfiler()
         assert set(profiler.route_descriptions()) == set(profiler.routes())
@@ -405,6 +405,7 @@ def test_live_process_serves_debug_and_solver_json():
             "--disable-dense-solver",
             "--enable-solver-telemetry",
             "--enable-tracing",
+            "--enable-journal",
             "--health-probe-port", str(health_port),
             "--metrics-port", str(metrics_port),
         ],
@@ -427,8 +428,8 @@ def test_live_process_serves_debug_and_solver_json():
         assert status == 200, "controller never served /debug"
         index = json.loads(body)
         paths = {e["path"] for e in index["endpoints"]}
-        # both wired features are discoverable, each with a description
-        assert {"/debug/solver", "/debug/traces", "/debug/decisions"} <= paths
+        # every wired feature is discoverable, each with a description
+        assert {"/debug/solver", "/debug/traces", "/debug/decisions", "/debug/journal", "/debug/waterfall"} <= paths
         assert all(e["description"] for e in index["endpoints"])
         status, body = _get(metrics_port, "/debug/solver")
         assert status == 200
@@ -438,6 +439,20 @@ def test_live_process_serves_debug_and_solver_json():
         status, body = _get(metrics_port, "/debug/solver?id=12345")
         assert status == 404
         assert json.loads(body)["status"] == 404
+        # the lifecycle journal's waterfall surface, from the same process:
+        # an empty index (nothing bound yet) and the 404 detail contract
+        status, body = _get(metrics_port, "/debug/waterfall")
+        assert status == 200
+        waterfall = json.loads(body)
+        assert waterfall["enabled"] is True
+        assert waterfall["pods_completed"] == 0
+        assert waterfall["conservation"]["violations"] == 0
+        status, body = _get(metrics_port, "/debug/waterfall?pod=ghost")
+        assert status == 404
+        assert json.loads(body)["status"] == 404
+        status, body = _get(metrics_port, "/debug/journal")
+        assert status == 200
+        assert json.loads(body)["enabled"] is True
     finally:
         proc.terminate()
         try:

@@ -1,0 +1,658 @@
+"""Lifecycle journal (journal.py) + JSONL schema + journal->replay capture.
+
+The load-bearing tests are the bounds-under-load suite (ring eviction
+counted, spool rotation inside the size budget, monotonic timestamps under
+a compressed clock), the disabled-is-free guard at the tracing bar, the
+waterfall conservation invariant, and the pod_burst round trip: a journal
+captured from a LIVE scenario run replays through ReplayTrace with the
+recorded arrival count and inter-arrival ordering reproduced exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import journal as journal_mod
+from karpenter_tpu.journal import (
+    JOURNAL,
+    KIND_NODE,
+    KIND_POD,
+    NODE_EVENTS,
+    POD_EVENTS,
+    SEGMENTS,
+    Journal,
+)
+from karpenter_tpu.journal_schema import (
+    JournalSchemaError,
+    event_errors,
+    journal_lines_errors,
+    load_journal,
+)
+from karpenter_tpu.api import labels as lbl
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scenarios import ReplayTrace
+from karpenter_tpu.utils.clock import FakeClock
+from tests.helpers import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
+@pytest.fixture
+def journal():
+    """A fresh enabled Journal on a stepped fake clock — no process-wide
+    state, so bounds/waterfall tests can't leak into each other."""
+    j = Journal()
+    clock = FakeClock()
+    j.enable(clock=clock)
+    return j, clock
+
+
+def _cluster():
+    clock = FakeClock()
+    kube = KubeCluster(clock=clock)
+    return kube, clock
+
+
+def _ready_node(name="node-j-1", provisioner="default"):
+    return make_node(
+        name=name,
+        labels={lbl.PROVISIONER_NAME_LABEL: provisioner, lbl.LABEL_INSTANCE_TYPE: "fake-it-1"},
+        allocatable={"cpu": 16, "memory": "32Gi", "pods": 100},
+    )
+
+
+class TestRecording:
+    def test_transition_vocabularies_enforced(self, journal):
+        j, _ = journal
+        with pytest.raises(ValueError, match="unknown journal kind"):
+            j.record("replicaset", "rs-1", "created")
+        with pytest.raises(ValueError, match="unknown pod transition"):
+            j.pod_event("p-1", "launched")  # a node event, not a pod event
+        with pytest.raises(ValueError, match="unknown node transition"):
+            j.node_event("n-1", "queued")
+
+    def test_first_occurrence_wins_per_entity(self, journal):
+        """Watch redeliveries and ICE retry rounds must not skew the
+        waterfall: the FIRST instance of each (entity, event) sticks."""
+        j, clock = journal
+        first = j.pod_event("p-1", "created")
+        clock.step(1.0)
+        assert j.pod_event("p-1", "created") is None  # deduped
+        assert j.pod_event("p-2", "created") is not None  # other entity fine
+        events = j.events(entity="p-1")
+        assert len(events) == 1
+        assert events[0]["t"] == first.t
+
+    def test_events_newest_first_bounded_and_filtered(self, journal):
+        j, clock = journal
+        for i in range(5):
+            j.pod_event(f"p-{i}", "created")
+            clock.step(0.1)
+        out = j.events(limit=2)
+        assert [e["entity"] for e in out] == ["p-4", "p-3"]
+        out = j.events(entity="p-0")
+        assert [e["entity"] for e in out] == ["p-0"]
+
+    def test_cross_links_carried_in_attrs(self, journal):
+        j, _ = journal
+        j.pod_event("p-1", "solved", trace_id="t-abc", flight_record=7, provisioner="default")
+        (event,) = j.events(entity="p-1")
+        assert event["attrs"]["trace_id"] == "t-abc"
+        assert event["attrs"]["flight_record"] == 7
+
+
+class TestBoundsUnderLoad:
+    def test_ring_eviction_counted(self):
+        j = Journal(capacity=8)
+        j.enable(clock=FakeClock())
+        dropped_before = journal_mod.EVENTS_DROPPED.value()
+        for i in range(20):
+            j.pod_event(f"p-{i}", "created")
+        assert len(j._ring) == 8
+        assert journal_mod.EVENTS_DROPPED.value() - dropped_before == 12
+        assert j.stats()["events_total"] == 20
+        # the newest events survived eviction
+        assert j.events(limit=1)[0]["entity"] == "p-19"
+
+    def test_milestone_and_completed_maps_bounded(self, journal, monkeypatch):
+        """The per-entity maps must not grow without bound under sustained
+        load — oldest entity evicted, newest retained."""
+        j, clock = journal
+        monkeypatch.setattr(journal_mod, "MAX_ENTITIES", 4)
+        monkeypatch.setattr(journal_mod, "MAX_COMPLETED", 3)
+        for i in range(10):
+            j.pod_event(f"p-{i}", "created")
+            clock.step(0.1)
+            j.pod_event(f"p-{i}", "bound", node="", provisioner="default")
+            clock.step(0.1)
+        assert len(j._milestones) <= 4
+        assert len(j._completed) <= 3
+        assert "p-9" in {e["pod"] for e in j.completed()}
+
+    def test_spool_rotation_never_exceeds_size_budget(self, journal, tmp_path):
+        j, clock = journal
+        path = str(tmp_path / "journal.jsonl")
+        budget = 4096
+        rotations_before = journal_mod.SPOOL_ROTATIONS.value()
+        j.set_spool(path, max_bytes=budget)
+        for i in range(400):
+            j.pod_event(f"pod-under-load-{i}", "created", note="x" * 40)
+            clock.step(0.01)
+            if i % 25 == 0:
+                j.flush_spool()
+                on_disk = os.path.getsize(path) + (
+                    os.path.getsize(path + ".1") if os.path.exists(path + ".1") else 0
+                )
+                assert on_disk <= budget, f"event {i}: {on_disk} bytes on disk > {budget} budget"
+        j.flush_spool()
+        assert journal_mod.SPOOL_ROTATIONS.value() - rotations_before >= 1, "load never rotated the spool"
+        # both generations are independently schema-valid JSONL
+        for p in (path, path + ".1"):
+            with open(p, encoding="utf-8") as f:
+                _, errs = journal_lines_errors(f, where=p)
+            assert errs == [], p
+        j.set_spool(None)
+
+    def test_spool_write_failure_disables_spool_not_journal(self, journal, tmp_path):
+        j, _ = journal
+        path = str(tmp_path / "journal.jsonl")
+        j.set_spool(path)
+        j._spool.close()  # simulate the disk dying under the journal
+        j.pod_event("p-1", "created")
+        assert j._spool is None, "a dead spool must disable itself"
+        assert j.events(entity="p-1"), "the in-memory journal must keep recording"
+
+    def test_monotonic_timestamps_under_compressed_clock(self, journal, tmp_path):
+        """Two threads can stamp then dispatch out of order by microseconds;
+        under a compressed campaign clock those inversions are whole ticks.
+        The journal clamps forward, so the stream (and the spool replay
+        feeds on) is monotonic by construction."""
+        j, clock = journal
+        path = str(tmp_path / "journal.jsonl")
+        j.set_spool(path)
+        j.pod_event("p-1", "created")  # t = 1000.0
+        clock.step(0.5)
+        j.pod_event("p-2", "created")  # t = 1000.5
+        # a stamped-earlier event dispatching late: clamped to the stream head
+        j.pod_event("p-3", "created", t=999.0)
+        clock.step(0.5)
+        j.pod_event("p-4", "created")
+        times = [e["t"] for e in reversed(j.events())]
+        assert times == sorted(times)
+        assert times[2] == pytest.approx(1000.5)  # p-3 clamped, not reordered
+        j.flush_spool()
+        with open(path, encoding="utf-8") as f:
+            _, errs = journal_lines_errors(f, where=path)
+        assert errs == [], "the spool must satisfy the monotonic schema it is validated against"
+        j.set_spool(None)
+
+
+class TestDisabledIsFree:
+    def test_disabled_journal_allocates_nothing(self):
+        """The acceptance bar: --enable-journal off is a true no-op — no
+        ring, no milestone maps, nothing recorded through the watch path."""
+        fresh = Journal()
+        kube, clock = _cluster()
+        fresh.attach(kube)
+        node = _ready_node()
+        kube.create(node)
+        for _ in range(10):
+            pod = make_pod()
+            kube.create(pod)
+            kube.bind_pod(pod, node.name)
+            kube.delete(pod, grace=False)
+        assert fresh._ring is None, "disabled journal must not allocate its ring"
+        assert fresh._milestones is None
+        assert fresh._completed is None
+        assert fresh.record(KIND_POD, "p-x", "created") is None
+        assert fresh.events() == [] and fresh.completed() == []
+
+    def test_enabled_overhead_within_bound(self):
+        """Regression tripwire at the tracing bar: journaling the watch hot
+        path (create/bind/delete) must stay within 3x + 0.25s of the
+        disabled path, whose cost is one attribute read per event site."""
+        j = Journal()
+
+        def churn_once(enabled: bool) -> float:
+            kube, _ = _cluster()
+            j.enabled = enabled
+            j.attach(kube)
+            if enabled:
+                j.reset()
+            node = _ready_node()
+            kube.create(node)
+            start = time.perf_counter()
+            for _ in range(300):
+                pod = make_pod()
+                kube.create(pod)
+                kube.bind_pod(pod, node.name)
+                kube.delete(pod, grace=False)
+            return time.perf_counter() - start
+
+        j.enable(clock=FakeClock())
+        j.disable()
+        plain, journaled = [], []
+        for _ in range(3):
+            plain.append(churn_once(False))
+            journaled.append(churn_once(True))
+        base, with_journal = min(plain), min(journaled)
+        assert with_journal <= base * 3.0 + 0.25, (
+            f"journal overhead too high: {with_journal * 1000:.1f}ms enabled vs {base * 1000:.1f}ms disabled"
+        )
+
+
+class TestWaterfall:
+    def _drive_full_chain(self, j, clock):
+        """One pod through every milestone with known segment durations."""
+        j.pod_event("p-1", "created")  # t0 = 1000
+        clock.step(1.0)
+        j.pod_event("p-1", "queued")  # queue_wait = 1
+        clock.step(2.0)
+        j.pod_event("p-1", "batch-admitted")  # batch_wait = 2
+        clock.step(3.0)
+        j.pod_event("p-1", "solved", provisioner="default", trace_id="t-1", flight_record=None)  # solve = 3
+        clock.step(4.0)
+        j.node_event("n-1", "launched")
+        j.pod_event("p-1", "nominated", node="n-1")  # launch = 4
+        clock.step(5.0)
+        j.node_event("n-1", "ready")  # node_ready = 5
+        clock.step(6.0)
+        j.pod_event("p-1", "bound", node="n-1", provisioner="default")  # bind = 6
+
+    def test_segments_decompose_and_conserve(self, journal):
+        j, clock = journal
+        self._drive_full_chain(j, clock)
+        entry = j.waterfall_for("p-1")
+        assert entry["segments"] == {
+            "queue_wait": 1.0, "batch_wait": 2.0, "solve": 3.0, "launch": 4.0, "node_ready": 5.0, "bind": 6.0,
+        }
+        assert entry["pending_seconds"] == 21.0
+        assert entry["provisioner"] == "default"
+        assert entry["trace_id"] == "t-1"
+        assert j.conservation_errors() == []
+
+    def test_skipped_milestones_score_zero_and_stay_gapless(self, journal):
+        """A pod bound straight onto existing capacity skips solve/launch
+        milestones; their segments score zero and conservation still holds
+        exactly — the chain carries boundaries forward instead of gapping."""
+        j, clock = journal
+        j.pod_event("p-1", "created")
+        clock.step(2.5)
+        j.pod_event("p-1", "bound", node="", provisioner="default")
+        entry = j.waterfall_for("p-1")
+        assert sum(entry["segments"].values()) == pytest.approx(2.5)
+        assert entry["segments"]["bind"] == pytest.approx(2.5)
+        assert all(entry["segments"][s] == 0.0 for s in SEGMENTS if s != "bind")
+        assert j.conservation_errors() == []
+
+    def test_node_ready_before_nomination_clamps_to_zero(self, journal):
+        """Existing capacity: the node's ready instant long precedes the
+        pod — node_ready clamps to zero rather than going negative."""
+        j, clock = journal
+        j.node_event("n-old", "registered")
+        j.node_event("n-old", "ready")
+        clock.step(10.0)
+        j.pod_event("p-1", "created")
+        clock.step(1.0)
+        j.pod_event("p-1", "solved", provisioner="default")
+        clock.step(1.0)
+        j.pod_event("p-1", "bound", node="n-old", provisioner="default")
+        entry = j.waterfall_for("p-1")
+        assert entry["segments"]["node_ready"] == 0.0
+        assert j.conservation_errors() == []
+
+    def test_sload_cross_feed_checks_the_independent_observation(self, journal):
+        """Conservation is two-observer: the SLO accountant's independently
+        measured pending duration is preferred, and a mismatch is a
+        violation with the pod named."""
+        j, clock = journal
+        self._drive_full_chain(j, clock)
+        j.note_observed_pending("p-1", 21.0)
+        assert j.conservation_errors() == []
+        j.note_observed_pending("p-1", 30.0)
+        errors = j.conservation_errors()
+        assert len(errors) == 1 and "p-1" in errors[0]
+
+    def test_deleted_pod_name_reuse_journals_fresh(self, journal):
+        """StatefulSet-style name reuse: deletion drops the pod's milestones,
+        so the next incarnation under the same name journals its own chain
+        (and the SLO cross-feed lands on ITS waterfall) instead of hitting
+        the first-occurrence dedupe — which would fabricate a conservation
+        violation out of two different pods' observations."""
+        j, clock = journal
+        self._drive_full_chain(j, clock)  # incarnation 1: pending 21s
+        j.pod_event("p-1", "deleted")
+        clock.step(100.0)
+        j.pod_event("p-1", "created")  # incarnation 2, same name
+        assert j.events(entity="p-1")[0]["event"] == "created"  # not deduped
+        clock.step(2.0)
+        j.pod_event("p-1", "bound", node="", provisioner="default")
+        entry = j.waterfall_for("p-1")
+        assert entry["pending_seconds"] == pytest.approx(2.0)  # incarnation 2's chain
+        j.note_observed_pending("p-1", 2.0)  # the SLO accountant's view of #2
+        assert j.conservation_errors() == []
+
+    def test_segment_quantiles_and_index(self, journal):
+        j, clock = journal
+        self._drive_full_chain(j, clock)
+        quantiles = j.segment_quantiles()
+        assert set(quantiles) == set(SEGMENTS)
+        assert quantiles["solve"]["count"] == 1
+        assert quantiles["solve"]["p50"] == quantiles["solve"]["p99"] == 3.0
+        index = j.waterfall_index()
+        assert index["pods_completed"] == 1
+        assert index["per_provisioner"]["default"]["bind"]["p50"] == 6.0
+        assert index["conservation"]["violations"] == 0
+
+    def test_waterfall_summary_observed_per_segment(self):
+        """The metrics export: each completed pod feeds every segment into
+        karpenter_waterfall_segment_seconds{segment,provisioner}."""
+        j = Journal()
+        clock = FakeClock()
+        j.enable(clock=clock)
+        before = {s: journal_mod.WATERFALL_SEGMENT.series() for s in ("_",)}["_"]
+        TestWaterfall()._drive_full_chain(j, clock)
+        series = journal_mod.WATERFALL_SEGMENT.series()
+        segments = {row["segment"] for row in series if row.get("provisioner") == "default"}
+        assert set(SEGMENTS) <= segments
+
+
+class TestWatchDriven:
+    def test_watch_hooks_record_created_and_bound(self):
+        j = Journal()
+        kube, clock = _cluster()
+        j.enable(clock=clock)
+        j.attach(kube)
+        node = _ready_node()
+        kube.create(node)
+        pod = make_pod()
+        kube.create(pod)
+        clock.step(1.5)
+        kube.bind_pod(pod, node.name)
+        name = pod.metadata.name
+        entry = j.waterfall_for(name)
+        assert entry is not None, "bind through the watch must complete the waterfall"
+        assert entry["pending_seconds"] == pytest.approx(1.5)
+        assert entry["node"] == node.name
+        assert entry["provisioner"] == "default"  # from the node's label
+        assert j.conservation_errors() == []
+        # node transitions came through the same watch
+        node_events = {e["event"] for e in j.events(entity=node.name)}
+        assert {"registered", "ready"} <= node_events
+
+    def test_deleted_pod_and_node_record_terminal_events(self):
+        j = Journal()
+        kube, clock = _cluster()
+        j.enable(clock=clock)
+        j.attach(kube)
+        pod = make_pod()
+        kube.create(pod)
+        kube.delete(pod, grace=False)
+        assert "deleted" in {e["event"] for e in j.events(entity=pod.metadata.name)}
+
+    def test_attach_is_idempotent_per_backend(self):
+        j = Journal()
+        kube, clock = _cluster()
+        j.enable(clock=clock)
+        j.attach(kube)
+        j.attach(kube)  # second attach must not double-subscribe
+        pod = make_pod()
+        kube.create(pod)
+        assert len(j.events(entity=pod.metadata.name)) == 1
+
+
+class TestRoutes:
+    @pytest.fixture()
+    def server(self):
+        from karpenter_tpu.observability import ObservabilityServer, debug_index_route
+
+        JOURNAL.enable(clock=FakeClock())
+        JOURNAL.reset()
+        routes = dict(journal_mod.routes())
+        routes["/debug"] = debug_index_route(journal_mod.route_descriptions())
+        srv = ObservabilityServer(
+            healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=0, extra_routes=routes
+        )
+        srv.start()
+        yield srv.ports[0]
+        srv.stop()
+        JOURNAL.disable()
+        JOURNAL.reset()
+
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    def test_journal_index_and_entity_filter(self, server):
+        JOURNAL.pod_event("p-1", "created")
+        JOURNAL.pod_event("p-2", "created")
+        status, body = self._get(server, "/debug/journal")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["events_stored"] == 2
+        assert len(payload["events"]) == 2
+        status, body = self._get(server, "/debug/journal?entity=p-2&limit=10")
+        assert status == 200
+        payload = json.loads(body)
+        assert [e["entity"] for e in payload["events"]] == ["p-2"]
+
+    def test_unknown_entity_pod_and_bad_limit_are_404_json(self, server):
+        for path in ("/debug/journal?entity=ghost", "/debug/journal?limit=soon", "/debug/waterfall?pod=ghost"):
+            status, body = self._get(server, path)
+            assert status == 404, path
+            payload = json.loads(body)
+            assert payload["status"] == 404 and payload["error"], path
+
+    def test_waterfall_index_and_pod_detail(self, server):
+        clock = JOURNAL.clock
+        JOURNAL.pod_event("p-1", "created")
+        clock.step(1.0)
+        JOURNAL.pod_event("p-1", "solved", provisioner="default", trace_id="t-9")
+        clock.step(1.0)
+        JOURNAL.pod_event("p-1", "bound", node="", provisioner="default")
+        status, body = self._get(server, "/debug/waterfall")
+        assert status == 200
+        index = json.loads(body)
+        assert index["pods_completed"] == 1
+        assert index["conservation"]["violations"] == 0
+        assert index["segments"] == list(SEGMENTS)
+        status, body = self._get(server, "/debug/waterfall?pod=p-1")
+        assert status == 200
+        detail = json.loads(body)
+        assert detail["pod"] == "p-1"
+        assert set(detail["segments"]) == set(SEGMENTS)
+        assert detail["trace_id"] == "t-9"
+        assert [e["event"] for e in detail["events"]] == ["created", "solved", "bound"]
+
+    def test_route_descriptions_match_routes(self):
+        # the /debug index lockstep contract every debug module carries
+        assert set(journal_mod.route_descriptions()) == set(journal_mod.routes())
+
+
+class TestJournalSchema:
+    def _lines(self, *events):
+        return [json.dumps(e) for e in events]
+
+    def _event(self, seq=0, t=1.0, kind="pod", entity="p-1", event="created", **extra):
+        return {"seq": seq, "t": t, "kind": kind, "entity": entity, "event": event, **extra}
+
+    def test_valid_lines_pass(self):
+        events, errs = journal_lines_errors(
+            self._lines(
+                self._event(0, 1.0),
+                self._event(1, 1.0, entity="p-2"),
+                self._event(2, 2.0, kind="node", entity="n-1", event="launched", attrs={"x": 1}),
+            )
+        )
+        assert errs == []
+        assert len(events) == 3
+
+    def test_malformations_carry_line_numbers(self):
+        lines = self._lines(self._event(0, 1.0))
+        lines.append('{"seq": 1, "t": 2.0, "kind": "pod", "entity": "p-2", "ev')  # truncated write
+        lines.append("")  # blank
+        lines.append(json.dumps(self._event(2, 3.0, kind="deployment")))
+        lines.append(json.dumps(self._event(3, 4.0, event="launched")))  # node event on a pod
+        _, errs = journal_lines_errors(lines, where="j")
+        assert any(e.startswith("j line 2:") and "invalid JSON" in e for e in errs)
+        assert any(e.startswith("j line 3:") and "blank" in e for e in errs)
+        assert any(e.startswith("j line 4:") and "kind" in e for e in errs)
+        assert any(e.startswith("j line 5:") and "launched" in e for e in errs)
+
+    def test_non_monotonic_seq_and_time_rejected(self):
+        _, errs = journal_lines_errors(
+            self._lines(self._event(5, 2.0), self._event(5, 2.5, entity="p-2"), self._event(6, 1.0, entity="p-3"))
+        )
+        assert any("seq 5 does not increase" in e for e in errs)
+        assert any("goes backwards" in e for e in errs)
+
+    def test_event_shape_errors_typed(self):
+        assert event_errors([]) == ["event: must be a JSON object, got list"]
+        errs = event_errors({"seq": True, "t": float("inf"), "kind": "pod", "entity": "", "event": "created"})
+        assert any("seq" in e for e in errs)
+        assert any("finite" in e for e in errs)
+        assert any("entity" in e for e in errs)
+
+    def test_load_journal_raises_line_numbered(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        path.write_text(json.dumps(self._event(0, 1.0)) + "\n" + '{"truncat\n')
+        with pytest.raises(JournalSchemaError) as err:
+            load_journal(str(path))
+        assert "line 2" in str(err.value)
+        assert err.value.path == str(path)
+
+    def test_load_journal_round_trips_a_real_spool(self, tmp_path):
+        j = Journal()
+        clock = FakeClock()
+        j.enable(clock=clock)
+        path = str(tmp_path / "spool.jsonl")
+        j.set_spool(path)
+        for i in range(5):
+            j.pod_event(f"p-{i}", "created")
+            clock.step(0.25)
+        j.set_spool(None)
+        events = load_journal(path)
+        assert [e["entity"] for e in events] == [f"p-{i}" for i in range(5)]
+
+
+class TestReplayTrace:
+    def _created(self, seq, t, name):
+        return {"seq": seq, "t": t, "kind": "pod", "entity": name, "event": "created"}
+
+    def test_inter_arrival_structure_preserved_and_compressed(self):
+        events = [
+            self._created(0, 10.0, "a"),
+            {"seq": 1, "t": 10.5, "kind": "node", "entity": "n", "event": "launched"},  # not an arrival
+            self._created(2, 12.0, "b"),
+            self._created(3, 15.0, "c"),
+        ]
+        trace = ReplayTrace.from_events(events, compress=2.0)
+        assert trace.schedule() == [(0.0, "a"), (1.0, "b"), (1.5, "c")]
+        assert trace.total_seconds() == pytest.approx(2.5)
+
+    def test_invalid_events_fail_loudly(self):
+        with pytest.raises(JournalSchemaError):
+            ReplayTrace.from_events([{"seq": 0}])
+        with pytest.raises(ValueError, match="compress"):
+            ReplayTrace.from_events([self._created(0, 1.0, "a")], compress=0.0)
+
+    def test_same_schedule_same_digest(self):
+        events = [self._created(0, 1.0, "a"), self._created(1, 2.0, "b")]
+        one = ReplayTrace.from_events(events, compress=1.0)
+        two = ReplayTrace.from_events(list(events), compress=1.0)
+        assert one.source_digest == two.source_digest
+        faster = ReplayTrace.from_events(events, compress=2.0)
+        assert faster.source_digest != one.source_digest
+
+    def test_config_summarizes_without_inlining_the_schedule(self):
+        events = [self._created(i, float(i), f"p-{i}") for i in range(100)]
+        config = ReplayTrace.from_events(events, compress=4.0, source="unit").config()
+        assert config["arrivals"] == 100
+        assert config["compress"] == 4.0
+        assert "schedule" not in config and len(json.dumps(config)) < 500
+
+    def test_replay_presents_arrivals_to_the_context(self):
+        class Ctx:
+            def __init__(self):
+                self.added = 0
+                self.slept = []
+
+            def sleep(self, seconds):
+                self.slept.append(seconds)
+                return False
+
+            def add_desired(self, delta):
+                self.added += delta
+                return self.added
+
+        trace = ReplayTrace.from_events(
+            [self._created(0, 0.0, "a"), self._created(1, 1.0, "b"), self._created(2, 1.0, "c")]
+        )
+        ctx = Ctx()
+        trace.run(ctx)
+        assert ctx.added == 3
+        assert ctx.slept == [1.0]  # zero-delay arrivals never sleep
+
+
+def test_pod_burst_journal_replays_exactly(tmp_path):
+    """The acceptance round trip, tier-1: capture a journal from the LIVE
+    pod_burst scenario, replay it through ReplayTrace, and the replayed
+    schedule reproduces the recorded arrival count and inter-arrival
+    ordering exactly (clock-compressed); the replayed scenario then runs
+    live and binds exactly the recorded arrivals."""
+    from karpenter_tpu.scenarios import CampaignRunner, Scenario, default_campaign
+
+    (pod_burst,) = [s for s in default_campaign() if s.name == "pod_burst"]
+    runner = CampaignRunner(
+        out_dir=str(tmp_path), transports=("inprocess",), convergence_timeout=40.0,
+        journal_dir=str(tmp_path),
+    )
+    (doc,) = runner.run([pod_burst])
+    assert doc["runs"][0]["converged"] is True
+
+    captured = tmp_path / "JOURNAL_pod_burst_inprocess.jsonl"
+    assert captured.exists(), "the campaign runner must spool the run's journal"
+    events = load_journal(str(captured))  # schema-valid by construction
+    created = [e for e in events if e["kind"] == "pod" and e["event"] == "created"]
+    assert len(created) == 28, "pod_burst lands 28 replicas"
+
+    compress = 2.0
+    trace = ReplayTrace.from_journal(str(captured), compress=compress)
+    schedule = trace.schedule()
+    # arrival count reproduced exactly
+    assert len(schedule) == len(created) == 28
+    # inter-arrival ordering and structure reproduced exactly, compressed:
+    # the schedule is the recorded created-stream's gaps divided by compress
+    assert [name for _, name in schedule] == [e["entity"] for e in created]
+    recorded_gaps = [0.0] + [
+        (b["t"] - a["t"]) / compress for a, b in zip(created, created[1:])
+    ]
+    assert [delay for delay, _ in schedule] == pytest.approx(recorded_gaps, abs=1e-6)
+
+    # and the captured trace drives a live scenario end to end
+    replayed = Scenario(
+        name="pod_burst_replayed",
+        desired=0,
+        duration=trace.total_seconds() + 2.0,
+        primitives=[trace],
+        description="pod_burst, replayed from its captured journal",
+    )
+    (replay_doc,) = runner.run([replayed])
+    run = replay_doc["runs"][0]
+    assert run["converged"] is True
+    assert run["scores"]["pods_bound"] == run["scores"]["pods_desired"] == 28
+    assert run["scores"]["lost_pods"] == 0
