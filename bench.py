@@ -562,7 +562,7 @@ def _smoke() -> dict:
     from karpenter_tpu import flight
     from karpenter_tpu.api.objects import Taint
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
-    from karpenter_tpu.solver import DenseSolver
+    from karpenter_tpu.solver import DenseSolver, faults as solver_faults
     from tests.helpers import make_pod, make_provisioner
 
     summary: dict = {}
@@ -570,6 +570,17 @@ def _smoke() -> dict:
     # hold earlier records): everything after this id is ours
     _prior = flight.FLIGHT.records()
     smoke_first_record_id = (_prior[-1].id + 1) if _prior else 0
+    # solver fault-domain baseline: healthy hardware + steady traffic must
+    # produce ZERO classified faults and ZERO degradation-ladder rungs
+    # across the whole smoke run, and the circuit breaker must never open
+    # (deltas, not absolutes — a shared tier-1 process may have run the
+    # injection suites first; the breaker is RESET and any leaked FaultPlan
+    # disarmed for the same reason: an aborted injection suite must not
+    # leave either active under the smoke)
+    solver_faults.BREAKER.reset()
+    solver_faults.FAULTS.clear()
+    faults_base = solver_faults.faults_total()
+    degraded_base = solver_faults.degraded_total()
 
     def check(name, pods, provider, provisioners, state_nodes=(), repack=False):
         solver = DenseSolver(min_batch=1)
@@ -745,6 +756,26 @@ def _smoke() -> dict:
     violations = _contracts.recompile_violations(smoke_records, doc)
     assert not violations, "recompile-axis contract violations:\n" + "\n".join(violations)
     summary["contract_recompile_violations"] = len(violations)
+
+    # solver fault-domain steady-state gate (solver/faults.py): every smoke
+    # solve ran on healthy hardware, so the taxonomy counters must not have
+    # moved, no solve may have taken a degradation-ladder rung, the breaker
+    # must still be CLOSED, and every smoke flight record must agree
+    log("smoke: zero-fault steady-state gate")
+    smoke_faults = solver_faults.faults_total() - faults_base
+    smoke_degraded = solver_faults.degraded_total() - degraded_base
+    assert smoke_faults == 0, f"smoke run classified {smoke_faults} solver fault(s) on healthy hardware"
+    assert smoke_degraded == 0, f"smoke run took {smoke_degraded} degradation-ladder rung(s) on healthy hardware"
+    assert solver_faults.BREAKER.state == solver_faults.STATE_CLOSED, (
+        f"solver circuit breaker {solver_faults.BREAKER.state!r} after a healthy smoke run"
+    )
+    for record in smoke_records:
+        assert not record.faults and not record.rungs, (
+            f"flight record {record.id} carries faults/rungs on a healthy run: {record.faults} {record.rungs}"
+        )
+    summary["solver_faults_total"] = smoke_faults
+    summary["degraded_solves_total"] = smoke_degraded
+    summary["breaker_state"] = solver_faults.BREAKER.state
 
     summary["provenance"] = bench_provenance("smoke")
     summary["ok"] = True

@@ -183,6 +183,13 @@ class SolveRecord:
     recompile_attribution: List[str] = field(default_factory=list)
     hbm_peak_bytes: int = 0
     hbm_live_bytes: int = 0
+    # solver fault domain (solver/faults.py): classified device faults this
+    # solve hit (taxonomy kind -> count), the degradation-ladder rungs it
+    # took (in escalation order), and the circuit-breaker state at record
+    # time — a healthy solve records {}, [], "closed"
+    faults: Dict[str, int] = field(default_factory=dict)
+    rungs: List[str] = field(default_factory=list)
+    breaker: str = "closed"
 
     def to_dict(self) -> dict:
         return {
@@ -203,6 +210,9 @@ class SolveRecord:
             "recompile_attribution": self.recompile_attribution,
             "hbm_peak_bytes": self.hbm_peak_bytes,
             "hbm_live_bytes": self.hbm_live_bytes,
+            "faults": self.faults,
+            "rungs": self.rungs,
+            "breaker": self.breaker,
         }
 
     def summary(self) -> dict:
@@ -218,6 +228,9 @@ class SolveRecord:
             "recompile": self.recompile,
             "recompile_attribution": self.recompile_attribution,
             "hbm_peak_bytes": self.hbm_peak_bytes,
+            "faults": self.faults,
+            "rungs": self.rungs,
+            "breaker": self.breaker,
         }
 
 
@@ -265,13 +278,19 @@ class FlightRecorder:
 
     def reset(self) -> None:
         """Drop records and attribution state (per-run harness reset; the
-        monotonic compile counters survive — consumers score deltas)."""
+        monotonic compile counters survive — consumers score deltas). The
+        HBM gauges zero too: they mean "at the last recorded solve", and a
+        stale reading from a previous run would otherwise pre-trip the
+        solver's --solver-hbm-budget chunking before this run's first
+        solve ever reaches the device."""
         with self._lock:
             if self._ring is not None:
                 self._ring.clear()
             self._prev_signature = None
         RECORDS_STORED.set(0)
         SOLVE_LATENCY.clear()
+        HBM_PEAK.set(0.0)
+        HBM_LIVE.set(0.0)
 
     # -- compile instruments ---------------------------------------------------
 
@@ -376,6 +395,9 @@ class FlightRecorder:
         pods_committed: int,
         pods_to_host: int,
         duration: float,
+        faults: Optional[Dict[str, int]] = None,
+        rungs: Optional[List[str]] = None,
+        breaker: str = "closed",
     ) -> Optional[SolveRecord]:
         """Close the window begin_solve() opened: compute per-entry compile
         deltas, attribute them to the changed shape dimensions, snapshot
@@ -437,6 +459,9 @@ class FlightRecorder:
                 recompile_attribution=attribution,
                 hbm_peak_bytes=peak,
                 hbm_live_bytes=live,
+                faults=dict(faults or {}),
+                rungs=list(rungs or []),
+                breaker=breaker,
             )
             self._next_id += 1
             self._prev_signature = dict(signature)
@@ -477,9 +502,23 @@ class FlightRecorder:
 
     def snapshot(self) -> dict:
         """The /debug/solver index payload: newest-first record summaries
-        plus the process-wide compile tallies."""
+        plus the process-wide compile tallies and the solver fault-domain
+        state (taxonomy counters, degradation-ladder tallies, breaker)."""
+        # imported lazily: solver/__init__ pulls in the full dense solver,
+        # and this module must stay importable without it (gen_docs, tests)
+        from .solver.faults import BREAKER, DEGRADED_SOLVES, SOLVER_FAULTS
+
         records = self.records()
         events, seconds = _TALLY.snapshot()
+        fault_domain = {
+            "breaker": BREAKER.snapshot(),
+            "faults_total": {
+                (labels[0] or "unclassified"): int(value) for labels, value in SOLVER_FAULTS.values().items()
+            },
+            "degraded_solves_total": {
+                (labels[0] or "unknown"): int(value) for labels, value in DEGRADED_SOLVES.values().items()
+            },
+        }
         return {
             "enabled": self.enabled,
             "records": [r.summary() for r in reversed(records)],
@@ -491,6 +530,7 @@ class FlightRecorder:
             },
             "hbm_peak_bytes": int(HBM_PEAK.value()),
             "hbm_live_bytes": int(HBM_LIVE.value()),
+            "fault_domain": fault_domain,
         }
 
 
@@ -534,5 +574,5 @@ def routes() -> dict:
 def route_descriptions() -> dict:
     """/debug-index descriptions, keyed like routes() (see tracing.py)."""
     return {
-        "/debug/solver": "solver flight recorder: per-solve shapes/phases, recompile attribution, HBM; ?id= detail",
+        "/debug/solver": "solver flight recorder: per-solve shapes/phases, recompile attribution, HBM, fault-domain breaker/ladder state; ?id= detail",
     }

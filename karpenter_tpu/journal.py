@@ -65,10 +65,16 @@ DEFAULT_SPOOL_MAX_BYTES = 16 * 2**20  # total on-disk budget (live + rotated)
 
 KIND_POD = "pod"
 KIND_NODE = "node"
+KIND_SOLVER = "solver"
 
 # the transition vocabularies; journal_schema.py validates files against them
 POD_EVENTS = ("created", "queued", "batch-admitted", "solved", "nominated", "bound", "failed", "deleted")
 NODE_EVENTS = ("launch-requested", "launched", "registered", "ready", "initialized", "terminated")
+# solver fault-domain events (solver/faults.py + solver/dense.py): unlike
+# pod/node milestones these are a STREAM — a solve may hit the same fault
+# kind twice, the breaker re-opens — so they bypass the first-occurrence
+# dedupe and never participate in the waterfall
+SOLVER_EVENTS = ("fault", "degraded", "breaker-opened", "breaker-half-open", "breaker-closed")
 
 # waterfall segments, in chain order: consecutive sub-intervals of
 # created->bound, so their sum IS the pending duration (conservation)
@@ -282,18 +288,25 @@ class Journal:
 
     # -- recording -------------------------------------------------------------
 
-    def record(self, kind: str, entity: str, event: str, t: Optional[float] = None, **attrs) -> Optional[JournalEvent]:
+    def record(
+        self, kind: str, entity: str, event: str, t: Optional[float] = None, attrs: Optional[dict] = None, **kwattrs
+    ) -> Optional[JournalEvent]:
         """Append one transition. First-occurrence semantics per (entity,
         event): a transition already journaled for this entity is a no-op,
         so watch redeliveries and retry rounds cannot skew the waterfall
         (the FIRST batch admission / solve is the one that decomposes the
-        pending time). Returns the event, or None when disabled/deduped."""
+        pending time). Returns the event, or None when disabled/deduped.
+        Attributes arrive as keywords or — for names that would collide
+        with this signature, e.g. the solver events' `kind` — via `attrs`."""
         if not self.enabled:
             return None
+        attrs = {**(attrs or {}), **kwattrs}
         if kind == KIND_POD:
             vocab = POD_EVENTS
         elif kind == KIND_NODE:
             vocab = NODE_EVENTS
+        elif kind == KIND_SOLVER:
+            vocab = SOLVER_EVENTS
         else:
             raise ValueError(f"unknown journal kind {kind!r}")
         if event not in vocab:
@@ -313,19 +326,23 @@ class Journal:
             raw_t = t
             t = max(t, self._last_t)
             self._last_t = t
-            milestones = self._milestones.get((kind, entity))
-            if milestones is None:
-                milestones = {}
-                self._milestones[(kind, entity)] = milestones
-                while len(self._milestones) > MAX_ENTITIES:
-                    self._milestones.popitem(last=False)
-            elif event in milestones:
-                return None  # first occurrence wins
-            milestones[event] = raw_t
-            if kind == KIND_POD and event == "solved":
-                # the cross-link payload (trace id, flight-record solve id)
-                # survives ring eviction with the milestone map
-                milestones["_solved_attrs"] = dict(attrs)
+            if kind != KIND_SOLVER:
+                # solver fault-domain events are a stream (the same fault
+                # kind can legitimately repeat), so only pod/node milestones
+                # carry the first-occurrence dedupe + waterfall bookkeeping
+                milestones = self._milestones.get((kind, entity))
+                if milestones is None:
+                    milestones = {}
+                    self._milestones[(kind, entity)] = milestones
+                    while len(self._milestones) > MAX_ENTITIES:
+                        self._milestones.popitem(last=False)
+                elif event in milestones:
+                    return None  # first occurrence wins
+                milestones[event] = raw_t
+                if kind == KIND_POD and event == "solved":
+                    # the cross-link payload (trace id, flight-record solve
+                    # id) survives ring eviction with the milestone map
+                    milestones["_solved_attrs"] = dict(attrs)
             record = JournalEvent(seq=self._seq, t=t, kind=kind, entity=entity, event=event, attrs=dict(attrs))
             self._seq += 1
             evicting = len(self._ring) == self._ring.maxlen
@@ -358,6 +375,13 @@ class Journal:
 
     def node_event(self, name: str, event: str, t: Optional[float] = None, **attrs) -> Optional[JournalEvent]:
         return self.record(KIND_NODE, name, event, t=t, **attrs)
+
+    def solver_event(self, entity: str, event: str, t: Optional[float] = None, **attrs) -> Optional[JournalEvent]:
+        """One solver fault-domain transition (solver/faults.py): a
+        classified fault, a degradation-ladder rung, or a circuit-breaker
+        state change. `entity` names the emitting component ('dense',
+        'breaker'); unlike pod/node milestones these are never deduped."""
+        return self.record(KIND_SOLVER, entity, event, t=t, attrs=attrs)
 
     def note_observed_pending(self, pod: str, seconds: float) -> None:
         """Cross-feed from the SLO accountant: the independently-measured

@@ -140,6 +140,12 @@ def pad_batch(bucket_stats, allowed):
 
 def bucket_type_cost_padded(sum_p, max_p, caps_t, prices_p, allowed_p):
     """One fused kernel dispatch on pre-padded inputs → [3, Bp] int32."""
+    # solver fault-domain injection seam (solver/faults.py): chaos tests
+    # raise exactly the typed fault they claim to test at THIS device
+    # boundary; one attribute read when no plan is installed
+    from ..solver.faults import FAULTS
+
+    FAULTS.check("pallas")
     return _bucket_type_cost_padded(sum_p, max_p, caps_t, prices_p, allowed_p, jax.default_backend() != "tpu")
 
 

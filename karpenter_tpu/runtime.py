@@ -92,6 +92,17 @@ class Runtime:
             # records, XLA compile-churn attribution, HBM gauges — served
             # over /debug/solver on the metrics port
             FLIGHT.enable(capacity=self.options.flight_ring_size)
+        # solver circuit breaker (solver/faults.py): tune the process-wide
+        # breaker and re-wire its clock to this runtime's seam WITHOUT
+        # resetting state — the device is the same device across restarts,
+        # so a crash/restart inherits the open/closed history
+        from .solver.faults import BREAKER
+
+        BREAKER.configure(
+            threshold=self.options.solver_breaker_threshold,
+            backoff=self.options.solver_breaker_backoff,
+            clock=self.kube.clock,
+        )
         if self.options.enable_journal:
             # the lifecycle journal (journal.py): pod/node transition stream
             # + the pending-latency waterfall over /debug/journal and
@@ -123,7 +134,9 @@ class Runtime:
                 from .solver.dense import measure_dense_crossover
 
                 min_batch = measure_dense_crossover()
-            self.dense_solver = DenseSolver(min_batch=min_batch)
+            self.dense_solver = DenseSolver(
+                min_batch=min_batch, hbm_budget_bytes=self.options.solver_hbm_budget_bytes
+            )
         remote_solver = None
         if self.options.solver_service_address:
             from .service.client import SolverClient

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import flight, journal, slo
+from ..solver import faults as solver_faults
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
 from ..api.provisioner import Budget, Consolidation, Disruption, Provisioner, ProvisionerSpec
@@ -221,6 +222,34 @@ def _solver_latency_p95():
     return None if math.isnan(value) else round(value, 6)
 
 
+def breaker_reclosed(ctx: ScenarioContext) -> bool:
+    """The device-fault-storm convergence bar: at least one planned fault
+    fired (the plan carries one spec per dispatch flavor, so only the active
+    flavor's triggers are consumable), repeated faults actually opened the
+    circuit breaker (the device attempt stopped being paid), and a half-open
+    recovery probe has since re-admitted the fast path — CLOSED at
+    convergence, not permanently abandoned."""
+    plan = solver_faults.FAULTS.plan
+    if plan is None or plan.fired() < 1:
+        return False
+    breaker = solver_faults.BREAKER
+    return breaker.opened_total >= 1 and breaker.state == solver_faults.STATE_CLOSED
+
+
+def hbm_degraded_settled(ctx: ScenarioContext) -> bool:
+    """The hbm-pressure convergence bar: the planned HBM faults fired, THIS
+    run's chunked-solve rung absorbed the pressure (the counter is process-
+    lifetime monotonic — score the delta over the run-start stamp, or a
+    prior run in the same process pre-satisfies the bar), and the breaker
+    NEVER opened — memory pressure is degradation, not an outage."""
+    plan = solver_faults.FAULTS.plan
+    if plan is None or plan.fired() < 1:
+        return False
+    chunked = solver_faults.DEGRADED_SOLVES.value(rung=solver_faults.RUNG_CHUNKED) - ctx.solver_chunked_at_start
+    breaker = solver_faults.BREAKER
+    return chunked >= 1 and breaker.opened_total == 0 and breaker.state == solver_faults.STATE_CLOSED
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -278,6 +307,17 @@ class CampaignRunner:
         slo.SLO.reset()
         flight.FLIGHT.reset()  # per-run solver-latency quantiles + records
         journal.JOURNAL.reset()  # per-run lifecycle events + waterfalls
+        # solver fault domain (solver/faults.py): each run starts from a
+        # CLOSED breaker and scores only its own fault/degradation deltas;
+        # a device-chaos scenario installs its seeded FaultPlan for the
+        # whole run so both transports inject the identical fault sequence
+        solver_faults.BREAKER.reset()
+        faults_at_start = solver_faults.faults_total()
+        degraded_at_start = solver_faults.degraded_total()
+        if scenario.fault_specs:
+            solver_faults.FAULTS.install(
+                solver_faults.FaultPlan.from_specs(scenario.fault_specs, seed=scenario.fault_seed)
+            )
         kube = KubeCluster()
         backend = CloudBackend(clock=kube.clock)
         backend.notifications.visibility_timeout = 1.0
@@ -305,7 +345,15 @@ class CampaignRunner:
                 cloud_provider=provider,
                 options=Options(
                     leader_elect=False,
-                    dense_solver_enabled=False,
+                    # the device-chaos scenarios run the dense device path
+                    # (min_batch=1: every provisioning batch dispatches, so
+                    # the fault-injection seam sits under real traffic); all
+                    # other scenarios keep the host loop
+                    dense_solver_enabled=scenario.dense_solver,
+                    dense_min_batch=1,
+                    solver_breaker_threshold=scenario.solver_breaker_threshold,
+                    solver_breaker_backoff=scenario.solver_breaker_backoff,
+                    solver_hbm_budget_bytes=scenario.solver_hbm_budget_bytes,
                     batch_max_duration=0.3,
                     batch_idle_duration=0.05,
                     interruption_queue="interruptions",
@@ -339,6 +387,7 @@ class CampaignRunner:
         ctx = ScenarioContext(
             kube, backend, runtime, service=service, pod_cpu=scenario.pod_cpu, runtime_factory=runtime_factory
         )
+        ctx.solver_chunked_at_start = solver_faults.DEGRADED_SOLVES.value(rung=solver_faults.RUNG_CHUNKED)
         stand_in = WorkloadStandIn(ctx)
         reclaim_thread = threading.Thread(
             target=self._reclaimer, args=(ctx,), name="cloud-reclaimer", daemon=True
@@ -414,6 +463,10 @@ class CampaignRunner:
                     "recompiles_total": flight.FLIGHT.compilations_total() - recompiles_at_start,
                     "solver_latency_p95_seconds": _solver_latency_p95(),
                     "waterfall": journal.JOURNAL.segment_quantiles(),
+                    "solver_faults_total": int(solver_faults.faults_total() - faults_at_start),
+                    "degraded_solves_total": int(solver_faults.degraded_total() - degraded_at_start),
+                    "solver_faults_injected": int(solver_faults.FAULTS.fired()),
+                    "breaker_state": solver_faults.BREAKER.state,
                 },
                 "samples": samples,
             }
@@ -442,6 +495,7 @@ class CampaignRunner:
             flight.FLIGHT.disable()
             journal.JOURNAL.set_spool(None)  # close (and keep) the capture
             journal.JOURNAL.disable()
+            solver_faults.FAULTS.clear()  # never leak a fault plan past its run
 
     @staticmethod
     def _run_primitive(ctx: ScenarioContext, primitive) -> None:
@@ -628,6 +682,69 @@ def default_campaign() -> List[Scenario]:
                 "correlated spot loss with the reclaimed pools quarantined by the interruption "
                 "controller: every replacement must route AROUND the collapsing pools (other-zone "
                 "spot or on-demand), never back into them"
+            ),
+        ),
+        Scenario(
+            name="device_fault_storm",
+            desired=0,
+            duration=7.0,
+            dense_solver=True,
+            solver_breaker_threshold=3,
+            solver_breaker_backoff=1.5,
+            # the plan speaks the typed taxonomy: the first three device
+            # dispatches of whichever flavor runs (plain single-device,
+            # the sharded mesh, or the Pallas kernel on real TPU hardware —
+            # a pallas fault retires that flavor, so its later dispatches
+            # land on the plain spec) die with a device-lost fault — three
+            # consecutive classified faults is exactly the breaker
+            # threshold, so the fourth burst solves against an OPEN breaker
+            # (host loop, no device attempt) and the last burst lands after
+            # the backoff as the half-open recovery probe
+            fault_specs=[
+                {"kind": "device-lost", "entry": "plain", "nth": 1, "count": 3},
+                {"kind": "device-lost", "entry": "sharded", "nth": 1, "count": 3},
+                {"kind": "device-lost", "entry": "pallas", "nth": 1, "count": 3},
+            ],
+            settled=breaker_reclosed,
+            primitives=[
+                Burst(offset=0.3, count=5),
+                Burst(offset=1.3, count=5),
+                Burst(offset=2.3, count=5),
+                Burst(offset=3.5, count=5),  # breaker OPEN: host fallback, no device attempt
+                Burst(offset=5.5, count=4),  # after backoff: the half-open recovery probe
+            ],
+            description=(
+                "typed device-lost faults on three consecutive solves trip the solver circuit "
+                "breaker (host loop owns every batch, no device attempt paid), then a half-open "
+                "recovery probe re-admits the fast path: converge with zero lost pods and the "
+                "breaker CLOSED"
+            ),
+        ),
+        Scenario(
+            name="hbm_pressure",
+            desired=0,
+            duration=6.0,
+            dense_solver=True,
+            # a ~1 KiB budget is below any real dispatch surface, so once
+            # the flight recorder's HBM-peak gauge is primed by the first
+            # recorded solve, every later solve chunks PRE-EMPTIVELY —
+            # the budget rung, on top of the injected reactive HBM faults
+            solver_hbm_budget_bytes=1024,
+            fault_specs=[
+                {"kind": "hbm", "entry": "plain", "nth": 1, "count": 2},
+                {"kind": "hbm", "entry": "sharded", "nth": 1, "count": 2},
+                {"kind": "hbm", "entry": "pallas", "nth": 1, "count": 2},
+            ],
+            settled=hbm_degraded_settled,
+            primitives=[
+                Burst(offset=0.3, count=8),
+                Burst(offset=2.0, count=8),
+                Burst(offset=3.8, count=8),
+            ],
+            description=(
+                "HBM RESOURCE_EXHAUSTED faults plus a pre-solve HBM budget drive the chunked-solve "
+                "rung: the pod batch splits and re-dispatches on a smaller device surface, nothing "
+                "is lost, and the breaker never opens — memory pressure degrades, it does not outage"
             ),
         ),
         Scenario(

@@ -45,6 +45,11 @@ class ScenarioContext:
         # predicates can scope assertions to REPLACEMENT nodes (a survivor
         # legitimately keeps running inside a quarantined pool)
         self.reclaim_started_at: Optional[float] = None
+        # stamped by the campaign runner at run start: the process-lifetime
+        # chunked-rung counter is monotonic, so settled predicates must
+        # score this run's delta, not the absolute (a prior run in the same
+        # process would pre-satisfy the bar)
+        self.solver_chunked_at_start = 0
         self.stop = threading.Event()
         self._lock = threading.Lock()
         self._desired = 0
@@ -296,6 +301,20 @@ class Scenario:
     # hash); not part of the config hash — predicates describe WHEN the run
     # may stop, not WHAT it did
     settled: Optional[Callable[[ScenarioContext], bool]] = None
+    # solver fault-domain seams (solver/faults.py): dense_solver=True runs
+    # the scenario's Runtime with the dense device path on (min_batch=1, so
+    # every provisioning batch dispatches); fault_specs is a list of
+    # FaultSpec dicts installed as a seeded FaultPlan for the whole run —
+    # the device-chaos scenarios inject exactly the typed fault class they
+    # claim to test, deterministically. The breaker/budget knobs mirror the
+    # --solver-breaker-threshold / --solver-breaker-backoff /
+    # --solver-hbm-budget runtime flags on the scenario's timescale.
+    dense_solver: bool = False
+    fault_specs: Optional[List[dict]] = None
+    fault_seed: int = 0
+    solver_breaker_threshold: int = 3
+    solver_breaker_backoff: float = 1.5
+    solver_hbm_budget_bytes: int = 0
     description: str = ""
 
     def config(self) -> dict:
@@ -311,5 +330,11 @@ class Scenario:
             "ttl_seconds_after_empty": self.ttl_seconds_after_empty,
             "consolidation": self.consolidation,
             "offering_ttl": self.offering_ttl,
+            "dense_solver": self.dense_solver,
+            "fault_specs": self.fault_specs,
+            "fault_seed": self.fault_seed,
+            "solver_breaker_threshold": self.solver_breaker_threshold,
+            "solver_breaker_backoff": self.solver_breaker_backoff,
+            "solver_hbm_budget_bytes": self.solver_hbm_budget_bytes,
             "primitives": [p.config() for p in self.primitives],
         }

@@ -46,7 +46,20 @@ SCORE_KEYS = (
     # invariant (segments sum to the observed pending duration) before
     # this block is allowed to land in the artifact
     "waterfall",
+    # solver fault-domain scores (solver/faults.py): classified device
+    # faults observed during the run (every taxonomy kind summed), the
+    # degradation-ladder rungs taken (flavor/chunked/host summed), the
+    # faults the run's FaultPlan actually injected (faults_total >=
+    # injected is the chaos-scenario acceptance bar), and the circuit
+    # breaker's state at convergence — CLOSED proves the device path was
+    # re-admitted, not permanently abandoned
+    "solver_faults_total",
+    "degraded_solves_total",
+    "solver_faults_injected",
+    "breaker_state",
 )
+
+BREAKER_STATES = ("closed", "half-open", "open")
 
 # the journal's waterfall segment vocabulary (journal.SEGMENTS mirrored by
 # name only — the schema stays importable without the journal's witness/
@@ -84,10 +97,16 @@ def run_errors(run, where: str = "run") -> List[str]:
         for key in SCORE_KEYS:
             if key not in scores:
                 errs.append(f"{where}.scores missing key {key!r}")
-        for field in ("lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures", "recompiles_total"):
+        for field in (
+            "lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures",
+            "recompiles_total", "solver_faults_total", "degraded_solves_total", "solver_faults_injected",
+        ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
                 errs.append(f"{where}.scores.{field} must be an int, got {type(value).__name__}")
+        breaker = scores.get("breaker_state")
+        if breaker is not None and breaker not in BREAKER_STATES:
+            errs.append(f"{where}.scores.breaker_state must be one of {list(BREAKER_STATES)}, got {breaker!r}")
         ups = scores.get("unschedulable_pod_seconds")
         if ups is not None and (not isinstance(ups, (int, float)) or isinstance(ups, bool) or ups < 0):
             errs.append(f"{where}.scores.unschedulable_pod_seconds must be a non-negative number")

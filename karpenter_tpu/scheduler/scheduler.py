@@ -169,12 +169,37 @@ class Scheduler:
 
         # TPU fast path: one dense batch solve proposes placements; commits
         # run through the exact host protocol below. On any failure, fall back
-        # to scheduling exactly the pods not already committed.
+        # to scheduling exactly the pods not already committed — but through
+        # the typed fault taxonomy (solver/faults.py): classified device
+        # faults are routed (and counted) by the solver's degradation ladder
+        # before they reach this boundary, so what escapes here is either a
+        # fault the ladder re-raised or an exception `classify` has no name
+        # for. The UNCLASSIFIED case still fails open (solving must never
+        # break) but counts into a distinct taxonomy label and logs at
+        # ERROR — a new JAX failure mode cannot hide as routine fallback.
         if self.dense_solver is not None:
             try:
                 queue_pods = self.dense_solver.presolve(self, queue_pods)
-            except Exception:  # noqa: BLE001 - dense path must never break solving
-                log.exception("dense presolve failed; falling back to host scheduling for the remainder")
+            except Exception as exc:  # noqa: BLE001 - dense path must never break solving
+                from ..solver.faults import KIND_UNCLASSIFIED, SOLVER_FAULTS, classify
+
+                fault = classify(exc)
+                if fault is None:
+                    SOLVER_FAULTS.inc(kind=KIND_UNCLASSIFIED)
+                    log.error(
+                        "dense presolve failed with an UNCLASSIFIED exception (new device failure"
+                        " mode? extend solver/faults.classify); falling back to host scheduling",
+                        exc_info=True,
+                    )
+                else:
+                    # a classified fault that escaped the ladder (raised
+                    # outside a dispatch boundary's handlers): count its kind
+                    # so the taxonomy stays complete even off the hot path
+                    SOLVER_FAULTS.inc(kind=fault.kind)
+                    log.warning(
+                        "dense presolve failed with a %s fault; falling back to host scheduling: %s",
+                        fault.kind, exc, exc_info=True,
+                    )
                 committed = {p.uid for n in self.nodes for p in n.pods}
                 committed.update(p.uid for v in self.existing_nodes for p in v.pods)
                 queue_pods = [p for p in pods if p.uid not in committed]

@@ -54,9 +54,23 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
-from ..flight import FLIGHT
+from ..flight import FLIGHT, HBM_PEAK
 from ..ir.encode import DenseProblem, GroupKind, catalog_key, catalog_pin, encode_catalog, encode_problem, resource_vector
+from ..journal import JOURNAL
 from ..tracing import TRACER
+from .faults import (
+    BREAKER,
+    DEGRADED_SOLVES,
+    FAULTS,
+    KIND_HBM,
+    KIND_UNCLASSIFIED,
+    RUNG_CHUNKED,
+    RUNG_FLAVOR,
+    RUNG_HOST,
+    SOLVER_FAULTS,
+    SolverFault,
+    classify,
+)
 from ..scheduling.requirement import Requirement
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
@@ -238,10 +252,26 @@ class DenseSolver:
     # axes from ~400-500 up (2000: host 531ms/$589.5 vs dense 124ms/$539.2).
     # The fixed dense cost is device dispatch + encode, not compute, so the
     # crossover is stable across catalog sizes.
-    def __init__(self, min_batch: int = MIN_BATCH_DEFAULT, num_slots: int = 8, mesh=None, peer_fabric=None):
+    def __init__(
+        self,
+        min_batch: int = MIN_BATCH_DEFAULT,
+        num_slots: int = 8,
+        mesh=None,
+        peer_fabric=None,
+        hbm_budget_bytes: int = 0,
+        use_mesh: bool = True,
+    ):
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # solver fault domain (faults.py): pre-solve HBM pressure budget —
+        # when the flight recorder's HBM-peak gauge exceeds this many bytes
+        # the dispatch surface chunks pre-emptively (--solver-hbm-budget;
+        # 0 = no budget). Per-solve fault/rung accounting feeds the flight
+        # record and the degradation-ladder counters.
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        self._solve_faults: Dict[str, int] = {}
+        self._solve_rungs: List[str] = []
         # per-solve memos (reset at each presolve; see _accepting_view_free)
         self._view_free_memo: Dict[int, Optional[np.ndarray]] = {}
         self._view_accepts_memo: Dict[tuple, bool] = {}
@@ -272,9 +302,11 @@ class DenseSolver:
         # CatalogEncoding — holds refs to the keyed lists, so FIFO eviction
         # here also releases them)
         self._catalog_encodings: Dict[tuple, object] = {}
-        # explicit mesh wins; otherwise auto-detect on first device solve
+        # explicit mesh wins; otherwise auto-detect on first device solve.
+        # use_mesh=False pins the plain single-device flavor (deterministic
+        # dispatch sequences for the fault-injection chaos scenarios)
         self._mesh = mesh
-        self._mesh_checked = mesh is not None
+        self._mesh_checked = mesh is not None or not use_mesh
 
     def _active_mesh(self):
         """The (pods x types) device mesh when >1 device is visible.
@@ -343,6 +375,21 @@ class DenseSolver:
             return pods
         if not any(scheduler.instance_types.get(t.provisioner_name) for t in scheduler.node_templates):
             return pods
+        # solver fault domain (faults.py): an OPEN breaker short-circuits the
+        # whole device attempt — no encode, no dispatch, the exact host loop
+        # owns the batch until a half-open probe re-admits the fast path.
+        # Simulation re-solves share the state (they skip the device path
+        # while it is open) but never become the probe.
+        sim = bool(scheduler.opts.simulation_mode)
+        FAULTS.set_simulation(sim)  # this thread's dispatch boundaries bypass injection for what-ifs
+        if not BREAKER.admit(simulation=sim):
+            if not sim:
+                DEGRADED_SOLVES.inc(rung=RUNG_HOST)
+                if JOURNAL.enabled:
+                    JOURNAL.solver_event("dense", "degraded", rung=RUNG_HOST, reason="breaker-open")
+            return pods
+        self._solve_faults = {}
+        self._solve_rungs = []
         self.stats.batches += 1
         self.stats.pods_in += len(pods)
         # reset the per-solve memos over (group, existing-view) queries:
@@ -438,13 +485,43 @@ class DenseSolver:
             buckets = [b for b in expanded if b.pod_rows]
         t1 = time.perf_counter()
         if buckets:
-            prep = self._device_solve(scheduler, problem, buckets, taken)
+            try:
+                prep = self._device_solve(scheduler, problem, buckets, taken)
+            except SolverFault as fault:
+                # classified device fault the ladder could not absorb (or
+                # that was fatal by kind): the final rung — the exact host
+                # loop takes every un-taken pod. Counted at the dispatch
+                # site for ladder-internal faults; faults raised straight
+                # from the seam (injected typed, or classified here) are
+                # counted by _note_fault's per-solve dedupe-free tally.
+                self._note_fault(fault.kind, "device")
+                self._note_rung(RUNG_HOST, kind=fault.kind)
+                BREAKER.record_fault(fault.kind, simulation=sim)
+                # exc_info: classification is textual — if a software bug was
+                # misclassified as a device fault, the traceback is the only
+                # way to notice
+                log.warning("device solve hit a %s fault; host loop takes the batch: %s", fault.kind, fault, exc_info=True)
+                prep = None
+            except Exception as exc:  # noqa: BLE001 - classify, then re-raise the truly unknown
+                fault = classify(exc)
+                if fault is None:
+                    raise  # unclassified: the scheduler boundary counts + logs it at ERROR
+                self._note_fault(fault.kind, "device")
+                self._note_rung(RUNG_HOST, kind=fault.kind)
+                BREAKER.record_fault(fault.kind, simulation=sim)
+                log.warning("device solve hit a %s fault; host loop takes the batch: %s", fault.kind, exc, exc_info=True)
+                prep = None
             t2 = time.perf_counter()
-            if self._node_guard_tripped(problem, buckets, prep, taken):
-                # dense would open pathologically many nodes vs the
-                # algorithm-independent floor: fail open, the exact host
-                # loop repacks every un-taken pod (warm commits stand —
-                # they went through the exact protocol)
+            if prep is not None:
+                # the device attempt succeeded (any rung that still reached
+                # the device); the node guard below is a packing-quality
+                # fail-open, not a device fault — it must not trip the breaker
+                BREAKER.record_success(simulation=sim)
+            if prep is None or self._node_guard_tripped(problem, buckets, prep, taken):
+                # fault fallback, or dense would open pathologically many
+                # nodes vs the algorithm-independent floor: fail open, the
+                # exact host loop repacks every un-taken pod (warm commits
+                # stand — they went through the exact protocol)
                 unassigned = np.arange(problem.P) if taken is None else np.nonzero(~taken)[0]
                 committed, fallback_rows = 0, [int(r) for r in unassigned]
             else:
@@ -496,6 +573,9 @@ class DenseSolver:
                 pods_committed=committed,
                 pods_to_host=len(leftover),
                 duration=t3 - t0,
+                faults=dict(self._solve_faults),
+                rungs=list(self._solve_rungs),
+                breaker=BREAKER.state,
             )
         if TRACER.enabled:
             # the measured phase boundaries as completed child spans under the
@@ -1479,6 +1559,83 @@ class DenseSolver:
                 cls._pallas_ok = False
         return cls._pallas_ok
 
+    # -- solver fault domain (faults.py) ---------------------------------------
+
+    def _note_fault(self, kind: str, entry: str) -> None:
+        """Count one classified device fault: the taxonomy counter, this
+        solve's flight-record tally, and a journal `solver` event."""
+        SOLVER_FAULTS.inc(kind=kind)
+        self._solve_faults[kind] = self._solve_faults.get(kind, 0) + 1
+        if JOURNAL.enabled:
+            JOURNAL.solver_event("dense", "fault", kind=kind, entry=entry)
+
+    def _note_rung(self, rung: str, **attrs) -> None:
+        """Count a degradation-ladder transition, once per rung per solve."""
+        if rung in self._solve_rungs:
+            return
+        self._solve_rungs.append(rung)
+        DEGRADED_SOLVES.inc(rung=rung)
+        if JOURNAL.enabled:
+            JOURNAL.solver_event("dense", "degraded", rung=rung, **attrs)
+
+    def _ladder_action(self, exc: Exception, flavor: str) -> str:
+        """Classify a device-dispatch failure and pick the next rung.
+
+        Returns 'chunk' (HBM pressure: split the surface and re-dispatch)
+        or 'retire' (pallas/mesh flavor retirement to plain jnp). Faults the
+        plain flavor cannot absorb raise TYPED so presolve's handler runs
+        the final rung (host fill + breaker); truly unclassifiable plain
+        failures re-raise raw so the scheduler boundary counts them as
+        `kind="unclassified"` at ERROR — never silently."""
+        fault = classify(exc)
+        if fault is None:
+            if flavor in ("pallas", "sharded"):
+                # preserve the pre-taxonomy resilience: an unknown pallas/
+                # mesh failure retires the flavor rather than losing the
+                # whole device path — but it is still counted distinctly
+                self._note_fault(KIND_UNCLASSIFIED, flavor)
+                return "retire"
+            raise exc
+        if fault.kind == KIND_HBM:
+            self._note_fault(fault.kind, flavor)
+            return "chunk"
+        if flavor in ("pallas", "sharded"):
+            self._note_fault(fault.kind, flavor)
+            return "retire"
+        raise fault from (exc if exc is not fault else None)
+
+    def _hbm_over_budget(self) -> bool:
+        """Pre-solve HBM-pressure check: the flight recorder's HBM-peak
+        gauge against --solver-hbm-budget (0 / telemetry off = no budget)."""
+        if self.hbm_budget_bytes <= 0 or not FLIGHT.enabled:
+            return False
+        return HBM_PEAK.value() > self.hbm_budget_bytes
+
+    _CHUNK_SPLIT = 2
+
+    def _chunked_dispatch(self, bucket_stats: np.ndarray, allowed: np.ndarray, catalog: tuple) -> np.ndarray:
+        """The HBM-pressure rung: split the bucket axis and dispatch the
+        plain path per chunk, shrinking the live [B, T] device surface.
+        Synchronous by design (degraded mode trades the speculation overlap
+        for memory headroom). Returns packed [3, B]; a chunk failure
+        propagates for presolve's final-rung handler to classify."""
+        import jax.numpy as jnp
+
+        from ..ops.feasibility import bucket_type_cost_packed
+
+        caps_dev, prices_dev = catalog
+        B = bucket_stats.shape[1]
+        step = max(1, -(-B // self._CHUNK_SPLIT))
+        parts: List[np.ndarray] = []
+        for lo in range(0, B, step):
+            hi = min(B, lo + step)
+            FAULTS.check("chunk")
+            part = bucket_type_cost_packed(
+                jnp.asarray(bucket_stats[:, lo:hi]), caps_dev, prices_dev, jnp.asarray(allowed[lo:hi])
+            )
+            parts.append(np.asarray(part))
+        return np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
     # -- step 3: device solve -------------------------------------------------
 
     def _availability_mask(self, avail: np.ndarray, zmask: np.ndarray, cmask: np.ndarray) -> np.ndarray:
@@ -1644,6 +1801,7 @@ class DenseSolver:
             return catalog
 
         def _plain_dispatch():
+            FAULTS.check("plain")
             caps_dev, prices_dev = _catalog("plain")
             return bucket_type_cost_packed(jnp.asarray(bucket_stats), caps_dev, prices_dev, jnp.asarray(allowed))
 
@@ -1652,7 +1810,24 @@ class DenseSolver:
                 return self._sharded_dispatch(mesh, _catalog("sharded"), bucket_stats, allowed)
             return _plain_dispatch()
 
-        if use_pallas:
+        def _flight_plain():
+            if getattr(self, "_flight_dispatch", None) is not None:
+                self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
+
+        def _chunk(reason: str):
+            self._note_rung(RUNG_CHUNKED, reason=reason)
+            _flight_plain()
+            return self._chunked_dispatch(bucket_stats, allowed, _catalog("plain"))
+
+        packed_fut = None
+        packed_np: Optional[np.ndarray] = None  # set when a degraded rung already materialized the result
+        if self._hbm_over_budget():
+            # pre-solve HBM pressure over --solver-hbm-budget: don't build
+            # the full dispatch surface at all — straight to the chunked rung
+            use_pallas = False
+            mesh = None
+            packed_np = _chunk("hbm-budget")
+        elif use_pallas:
             try:
                 from ..ops.pallas_kernels import bucket_type_cost_padded, pad_batch
 
@@ -1666,28 +1841,39 @@ class DenseSolver:
                     jnp.asarray(sum_p), jnp.asarray(max_p), caps_dev, prices_dev, jnp.asarray(allowed_p)
                 )
             except Exception as exc:  # unexpected shape class the kernel can't compile
-                type(self)._pallas_ok = False
                 use_pallas = False
-                log.warning("retiring Pallas kernel (compile/dispatch failure), falling back to jnp path: %r", exc)
-                if getattr(self, "_flight_dispatch", None) is not None:
-                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
-                packed_fut = _jnp_dispatch()
+                if self._ladder_action(exc, "pallas") == "chunk":
+                    packed_np = _chunk("hbm-fault")
+                else:
+                    type(self)._pallas_ok = False
+                    self._note_rung(RUNG_FLAVOR, retired="pallas")
+                    log.warning("retiring Pallas kernel (compile/dispatch failure), falling back to jnp path: %r", exc)
+                    _flight_plain()
+                    packed_fut = _jnp_dispatch()
         else:
             try:
                 packed_fut = _jnp_dispatch()
             except Exception as exc:
                 if mesh is None:
-                    raise
-                # mesh is an optimization, never a failure mode: retire it for
-                # this solver (chip dropout, placement failure) and continue
-                # single-device
-                self._mesh = None
-                mesh = None
-                log.warning("retiring solver mesh (dispatch failure), falling back to single device: %r", exc)
-                if getattr(self, "_flight_dispatch", None) is not None:
-                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
-                packed_fut = _plain_dispatch()
-        if mesh is not None:
+                    # plain flavor: _ladder_action raises for everything the
+                    # chunked rung cannot absorb (typed for classified, raw
+                    # for unclassified — the scheduler boundary counts those)
+                    self._ladder_action(exc, "plain")
+                    packed_np = _chunk("hbm-fault")
+                elif self._ladder_action(exc, "sharded") == "chunk":
+                    mesh = None
+                    packed_np = _chunk("hbm-fault")
+                else:
+                    # mesh is an optimization, never a failure mode: retire it
+                    # for this solver (chip dropout, placement failure) and
+                    # continue single-device
+                    self._mesh = None
+                    mesh = None
+                    self._note_rung(RUNG_FLAVOR, retired="sharded")
+                    log.warning("retiring solver mesh (dispatch failure), falling back to single device: %r", exc)
+                    _flight_plain()
+                    packed_fut = _plain_dispatch()
+        if mesh is not None and packed_fut is not None:
             self.stats.sharded_batches += 1
         # start the device->host copy as soon as the result is ready, so the
         # fetch overlaps the speculation below instead of starting at the
@@ -1737,25 +1923,38 @@ class DenseSolver:
         prep = self._prepare_commit(scheduler, problem, buckets, sol, taken)
         self.stats.assemble_seconds += time.perf_counter() - t_asm
 
-        try:
-            packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
-        except Exception as exc:
-            if use_pallas:
-                type(self)._pallas_ok = False  # runtime failure: retire the kernel
-                log.warning("retiring Pallas kernel (runtime failure), falling back to jnp path: %r", exc)
-                if getattr(self, "_flight_dispatch", None) is not None:
-                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
-                packed = np.asarray(_jnp_dispatch())[:, :B]
-            elif mesh is not None:
-                self._mesh = None
-                mesh = None
-                log.warning("retiring solver mesh (runtime failure), falling back to single device: %r", exc)
-                self.stats.sharded_batches -= 1
-                if getattr(self, "_flight_dispatch", None) is not None:
-                    self._flight_dispatch.update(flavor="plain", buckets_padded=B, types_padded=problem.T)
-                packed = np.asarray(_plain_dispatch())[:, :B]
-            else:
-                raise
+        if packed_np is not None:
+            packed = packed_np[:, :B]  # a degraded rung already fetched it
+        else:
+            try:
+                packed = np.asarray(packed_fut)[:, :B]  # blocks until the device result lands
+            except Exception as exc:
+                if use_pallas:
+                    if self._ladder_action(exc, "pallas") == "chunk":
+                        packed = _chunk("hbm-fault")
+                    else:
+                        type(self)._pallas_ok = False  # runtime failure: retire the kernel
+                        self._note_rung(RUNG_FLAVOR, retired="pallas")
+                        log.warning("retiring Pallas kernel (runtime failure), falling back to jnp path: %r", exc)
+                        _flight_plain()
+                        packed = np.asarray(_jnp_dispatch())[:, :B]
+                elif mesh is not None:
+                    self.stats.sharded_batches -= 1
+                    if self._ladder_action(exc, "sharded") == "chunk":
+                        mesh = None
+                        packed = _chunk("hbm-fault")
+                    else:
+                        self._mesh = None
+                        mesh = None
+                        self._note_rung(RUNG_FLAVOR, retired="sharded")
+                        log.warning("retiring solver mesh (runtime failure), falling back to single device: %r", exc)
+                        _flight_plain()
+                        packed = np.asarray(_plain_dispatch())[:, :B]
+                else:
+                    # plain flavor: chunk absorbs HBM pressure; everything
+                    # else raises through _ladder_action for the host rung
+                    self._ladder_action(exc, "plain")
+                    packed = _chunk("hbm-fault")
         tstar, feasible = packed[0], packed[2].astype(bool)
         changed = False
         for b, bucket in enumerate(buckets):
@@ -1812,6 +2011,7 @@ class DenseSolver:
         to the catalog's padded width, places inputs with the mesh's own
         shardings (parallel/sharded.py:place — never default-device), and
         runs the sharded jit. Result is packed [3, Bp]; the caller trims."""
+        FAULTS.check("sharded")
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.sharded import make_sharded_bucket_cost, place

@@ -1,0 +1,467 @@
+"""Solver fault domain: typed device-failure taxonomy, deterministic fault
+injection, and the host-fallback circuit breaker.
+
+PR 9 gave the *cloud* side a typed failure family (`cloudprovider/errors.py`)
+so the provisioner could dispatch on WHAT failed instead of retrying blindly.
+The solver side had nothing comparable: every device fault — an XLA compile
+failure, an HBM RESOURCE_EXHAUSTED, a Pallas kernel error, a lost device —
+collapsed into one catch-all at the scheduler boundary, indistinguishable,
+re-paid from scratch every solve, and invisible to the flight recorder and
+campaign scoring. This module is the solver's mirror of that discipline:
+
+- **taxonomy** — `SolverCompileError` / `SolverHbmExhaustedError` /
+  `SolverKernelError` / `SolverDeviceLostError`, plus `classify(exc)`
+  mapping raw JAX/XLA exception surfaces (RESOURCE_EXHAUSTED, INTERNAL,
+  Mosaic/Pallas failures, dead-backend shapes) into it. `classify` is
+  text-based by necessity — jaxlib's error types are version-soup — and an
+  unmatchable exception returns None so a NEW failure mode surfaces as
+  `kind="unclassified"` instead of hiding as routine fallback.
+- **injection seam** — `FaultPlan` + the process-wide `FAULTS` injector:
+  seeded, per-entry-name, nth-call triggers consulted at every device
+  dispatch boundary (`solver/dense.py` plain/sharded/chunk sites,
+  `ops/pallas_kernels.py`, the warm-fill surface). Unset, the seam is one
+  attribute read (the tracing/SLO/FLIGHT disabled-is-free bar); installed,
+  the same seed + plan produce the identical fault sequence on every run —
+  chaos tests inject exactly the fault class they claim to test.
+  Simulation-mode re-solves (consolidation / SLO what-ifs) bypass the
+  injector entirely: their epoch-driven timing would otherwise consume
+  triggers nondeterministically out from under the real provisioner.
+- **degradation ladder accounting** — `karpenter_solver_faults_total{kind}`
+  and `karpenter_solver_degraded_solves_total{rung}` count every classified
+  fault and every rung transition (`flavor` retirement -> `chunked`
+  HBM-pressure solve -> `host` fill); the dense solver records the same
+  transitions on its flight records and as journal `solver` events.
+- **circuit breaker** — `SolverCircuitBreaker` (process-wide `BREAKER`, the
+  FLIGHT/TRACER singleton pattern): `threshold` CONSECUTIVE classified
+  device faults open it, an open breaker short-circuits the device attempt
+  (the exact host loop owns every batch, no encode, no dispatch), and after
+  `backoff` seconds (clock-seam timed) the next REAL solve runs a half-open
+  recovery probe — success re-admits the fast path, failure re-opens.
+  Simulation-mode solves share the state (they skip the device path while
+  it is open) but never trip it, never probe it, and never reset it: a
+  consolidation what-if burning the real provisioner's recovery probe would
+  be cross-loop interference. State is served inside `/debug/solver`.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..analysis.guards import guarded_by
+from ..analysis.witness import WITNESS
+from ..journal import JOURNAL
+from ..logsetup import get_logger
+from ..metrics import REGISTRY
+from ..utils.clock import Clock
+
+log = get_logger("solver.faults")
+
+# -- the taxonomy ---------------------------------------------------------------
+
+KIND_COMPILE = "compile"
+KIND_HBM = "hbm"
+KIND_KERNEL = "kernel"
+KIND_DEVICE_LOST = "device-lost"
+KIND_UNCLASSIFIED = "unclassified"
+
+KINDS = (KIND_COMPILE, KIND_HBM, KIND_KERNEL, KIND_DEVICE_LOST, KIND_UNCLASSIFIED)
+
+# ladder rungs, in escalation order: retire the kernel/mesh flavor, chunk
+# the dispatch surface under HBM pressure, hand the batch to the host loop
+RUNG_FLAVOR = "flavor"
+RUNG_CHUNKED = "chunked"
+RUNG_HOST = "host"
+RUNGS = (RUNG_FLAVOR, RUNG_CHUNKED, RUNG_HOST)
+
+
+class SolverFault(RuntimeError):
+    """Base of the typed device-failure family (the solver-side analog of
+    cloudprovider/errors.py). `kind` is the metric label."""
+
+    kind = KIND_UNCLASSIFIED
+
+
+class SolverCompileError(SolverFault):
+    """XLA/Mosaic failed to BUILD a program for this shape class (lowering
+    or compilation): retrying the same dispatch cannot succeed, but another
+    flavor (plain jnp instead of Pallas) may compile fine."""
+
+    kind = KIND_COMPILE
+
+
+class SolverHbmExhaustedError(SolverFault):
+    """The device ran out of memory (RESOURCE_EXHAUSTED / OOM): the same
+    work in smaller pieces can still succeed — the chunked-solve rung."""
+
+    kind = KIND_HBM
+
+
+class SolverKernelError(SolverFault):
+    """A compiled program failed at RUN time (INTERNAL, a Pallas/Mosaic
+    runtime fault): the flavor is suspect, not the device."""
+
+    kind = KIND_KERNEL
+
+
+class SolverDeviceLostError(SolverFault):
+    """The device (or its transport) is gone — dead backend, lost
+    connection, halted chip. Nothing dispatched this pass can succeed."""
+
+    kind = KIND_DEVICE_LOST
+
+
+_FAULT_BY_KIND = {
+    KIND_COMPILE: SolverCompileError,
+    KIND_HBM: SolverHbmExhaustedError,
+    KIND_KERNEL: SolverKernelError,
+    KIND_DEVICE_LOST: SolverDeviceLostError,
+}
+
+# textual signatures per kind, checked in order: jaxlib raises version-soup
+# exception types, but the gRPC status words and the XLA error vocabulary
+# are stable across releases. HBM first (an OOM message often also says
+# INTERNAL), device-lost before compile/kernel (a dead backend wraps
+# whatever it was doing when it died).
+_HBM_MARKS = ("resource_exhausted", "resource exhausted", "out of memory", "oom", "hbm")
+# bare common words ("internal", "aborted", "unavailable") would reclassify
+# ordinary software bugs raised inside the dispatch try-blocks as device
+# faults and feed them to the breaker — the gRPC status vocabulary always
+# arrives colon-anchored ("UNAVAILABLE: socket closed"), so anchor those
+_DEVICE_LOST_MARKS = (
+    "device lost",
+    "unavailable:",
+    "socket closed",
+    "connection reset",
+    "failed to connect",
+    "dead backend",
+    "backend was destroyed",
+    "halted",
+    "aborted:",
+)
+_COMPILE_MARKS = ("compilation", "compile", "lowering", "unimplemented")
+_KERNEL_MARKS = ("internal:", "internal error", "pallas", "mosaic", "kernel")
+
+
+def classify(exc: BaseException) -> Optional[SolverFault]:
+    """Map a raw device-path exception into the typed family; an already-
+    typed fault passes through. None means UNCLASSIFIED — the caller must
+    keep failing open to the host loop but count it distinctly, so a new
+    JAX failure mode cannot hide as routine fallback forever."""
+    if isinstance(exc, SolverFault):
+        return exc
+    text = f"{type(exc).__name__}: {exc}".lower()
+    for marks, cls in (
+        (_HBM_MARKS, SolverHbmExhaustedError),
+        (_DEVICE_LOST_MARKS, SolverDeviceLostError),
+        (_COMPILE_MARKS, SolverCompileError),
+        (_KERNEL_MARKS, SolverKernelError),
+    ):
+        if any(mark in text for mark in marks):
+            return cls(str(exc) or type(exc).__name__)
+    return None
+
+
+# -- metrics (registered at import so gen_docs sees the families) ---------------
+
+SOLVER_FAULTS = REGISTRY.counter(
+    "karpenter_solver_faults_total",
+    "Classified solver device faults by taxonomy kind (compile, hbm, kernel,"
+    " device-lost; 'unclassified' = a failure classify() could not map — a new"
+    " JAX failure mode that must not hide as routine host fallback).",
+    ("kind",),
+)
+DEGRADED_SOLVES = REGISTRY.counter(
+    "karpenter_solver_degraded_solves_total",
+    "Dense solves that took a degradation-ladder rung: 'flavor' (Pallas/mesh"
+    " retirement to plain jnp), 'chunked' (HBM-pressure split dispatch),"
+    " 'host' (the exact host loop took the batch).",
+    ("rung",),
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "karpenter_solver_breaker_transitions_total",
+    "Solver circuit-breaker state transitions, by the state entered.",
+    ("state",),
+)
+BREAKER_STATE = REGISTRY.gauge(
+    "karpenter_solver_breaker_state",
+    "Current solver circuit-breaker state: 0 = closed (device path admitted),"
+    " 1 = half-open (recovery probe in flight), 2 = open (host fallback).",
+)
+
+
+def faults_total() -> int:
+    """Sum of the classified-fault counter across kinds (score surface)."""
+    return int(sum(SOLVER_FAULTS.values().values()))
+
+
+def degraded_total() -> int:
+    """Sum of the degraded-solve counter across rungs (score surface)."""
+    return int(sum(DEGRADED_SOLVES.values().values()))
+
+
+# -- deterministic fault injection ----------------------------------------------
+
+
+@dataclass
+class FaultSpec:
+    """One planned trigger. `entry` names the dispatch boundary ('plain',
+    'sharded', 'pallas', 'chunk', 'warmfill', or '*'); `nth` fires on the
+    nth matching call (1-based) for `count` consecutive matching calls;
+    with `nth` None, `probability` draws a seeded coin per matching call —
+    still fully deterministic for a given (plan, seed, call sequence)."""
+
+    kind: str
+    entry: str = "*"
+    nth: Optional[int] = None
+    count: int = 1
+    probability: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _FAULT_BY_KIND:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {sorted(_FAULT_BY_KIND)}")
+        if self.nth is not None and self.nth < 1:
+            raise ValueError("nth is 1-based")
+        if not (0.0 <= self.probability <= 1.0):
+            raise ValueError("probability must be in [0, 1]")
+
+
+@guarded_by("_lock", "_calls", "_spec_calls", "_history")
+class FaultPlan:
+    """A seeded, deterministic schedule of device faults. Same plan + same
+    seed + same dispatch sequence -> identical fault sequence, byte for
+    byte — the property the determinism tests pin on both dispatch
+    flavors."""
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = WITNESS.lock("solver.faults")
+        self._calls = 0
+        self._spec_calls = [0] * len(self.specs)
+        self._history: List[dict] = []
+
+    @classmethod
+    def from_specs(cls, specs: Sequence[dict], seed: int = 0) -> "FaultPlan":
+        return cls([FaultSpec(**spec) for spec in specs], seed=seed)
+
+    def check(self, entry: str) -> None:
+        """Consult the plan at one dispatch-boundary call; raises the
+        planned typed fault when a trigger fires (first matching spec
+        wins)."""
+        fire: Optional[FaultSpec] = None
+        with self._lock:
+            self._calls += 1
+            call = self._calls
+            for i, spec in enumerate(self.specs):
+                if spec.entry != "*" and spec.entry != entry:
+                    continue
+                self._spec_calls[i] += 1
+                matched = self._spec_calls[i]
+                if spec.nth is not None:
+                    hit = spec.nth <= matched < spec.nth + spec.count
+                else:
+                    # one seeded draw per matching call per spec, consumed
+                    # whether or not it fires — the sequence is a pure
+                    # function of (seed, dispatch order)
+                    hit = self._rng.random() < spec.probability
+                if hit and fire is None:
+                    fire = spec
+            if fire is not None:
+                self._history.append({"call": call, "entry": entry, "kind": fire.kind})
+        if fire is not None:
+            raise _FAULT_BY_KIND[fire.kind](f"injected {fire.kind} fault at dispatch entry {entry!r}")
+
+    def history(self) -> List[dict]:
+        """The fired triggers, in dispatch order (determinism witness)."""
+        with self._lock:
+            return [dict(h) for h in self._history]
+
+    def fired(self) -> int:
+        with self._lock:
+            return len(self._history)
+
+
+class FaultInjector:
+    """Process-wide seam the dispatch boundaries consult. No plan installed
+    (production) = one attribute read per dispatch; `install()` arms a
+    FaultPlan, `clear()` disarms. The solver marks simulation-mode solves
+    per thread (`set_simulation`) so every boundary on that thread — dense
+    dispatch, the ops kernels, the warm-fill surface — bypasses the plan
+    without plumbing a flag through each call."""
+
+    def __init__(self):
+        self._plan: Optional[FaultPlan] = None
+        self._local = threading.local()
+
+    @property
+    def plan(self) -> Optional[FaultPlan]:
+        return self._plan
+
+    def install(self, plan: FaultPlan) -> None:
+        self._plan = plan
+        log.info("solver fault plan installed: %d spec(s), seed %d", len(plan.specs), plan.seed)
+
+    def clear(self) -> None:
+        self._plan = None
+
+    def fired(self) -> int:
+        plan = self._plan
+        return plan.fired() if plan is not None else 0
+
+    def set_simulation(self, simulation: bool) -> None:
+        """Mark THIS thread's in-flight solve as a simulation re-solve
+        (consolidation / SLO what-if): injected faults target the real
+        provisioner's dispatch sequence — a what-if consuming triggers
+        would make every plan nondeterministic."""
+        self._local.simulation = bool(simulation)
+
+    def check(self, entry: str, simulation: Optional[bool] = None) -> None:
+        plan = self._plan
+        if plan is None:
+            return
+        if simulation is None:
+            simulation = getattr(self._local, "simulation", False)
+        if simulation:
+            return
+        plan.check(entry)
+
+
+FAULTS = FaultInjector()
+
+
+# -- the circuit breaker --------------------------------------------------------
+
+STATE_CLOSED = "closed"
+STATE_HALF_OPEN = "half-open"
+STATE_OPEN = "open"
+_STATE_GAUGE = {STATE_CLOSED: 0.0, STATE_HALF_OPEN: 1.0, STATE_OPEN: 2.0}
+
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_BREAKER_BACKOFF = 30.0
+
+
+@guarded_by("_lock", "state", "consecutive", "opened_total", "_open_until", "last_fault_kind")
+class SolverCircuitBreaker:
+    """Consecutive-fault breaker over the solver's device path with
+    half-open recovery probes. Clock-seam timed (FakeClock drives the
+    backoff deterministically in tests); state transitions are counted
+    (`karpenter_solver_breaker_transitions_total{state}`) and journaled as
+    `solver` events when the journal is enabled."""
+
+    def __init__(self, threshold: int = DEFAULT_BREAKER_THRESHOLD, backoff: float = DEFAULT_BREAKER_BACKOFF):
+        self._lock = WITNESS.lock("solver.breaker")
+        self.threshold = threshold
+        self.backoff = backoff
+        self.clock: Clock = Clock()
+        self.state = STATE_CLOSED
+        self.consecutive = 0
+        self.opened_total = 0
+        self.last_fault_kind = ""
+        self._open_until = 0.0
+
+    def configure(self, threshold: Optional[int] = None, backoff: Optional[float] = None, clock: Optional[Clock] = None) -> None:
+        """(Re)tune without resetting state: a restarted Runtime re-wires
+        its clock and thresholds but inherits the process's breaker history
+        (the device is the same device across restarts). Adopts a witnessed
+        lock when the witness came up after import, so the breaker joins
+        the lock-order graph the chaos suites assert acyclic."""
+        if WITNESS.enabled and isinstance(self._lock, threading.Lock().__class__):
+            # constructed before the witness came up: swap in a witnessed
+            # lock (configure runs at Runtime assembly, before any solve
+            # can hold it — the flight-recorder enable() pattern)
+            self._lock = WITNESS.lock("solver.breaker")
+        with self._lock:
+            if threshold is not None:
+                self.threshold = max(1, int(threshold))
+            if backoff is not None:
+                self.backoff = float(backoff)
+        if clock is not None:
+            self.clock = clock
+
+    def reset(self) -> None:
+        """Back to CLOSED with zeroed counters (per-run harness reset)."""
+        with self._lock:
+            self.state = STATE_CLOSED
+            self.consecutive = 0
+            self.opened_total = 0
+            self.last_fault_kind = ""
+            self._open_until = 0.0
+        BREAKER_STATE.set(_STATE_GAUGE[STATE_CLOSED])
+
+    def _transition_locked(self, state: str) -> None:
+        self.state = state
+        BREAKER_TRANSITIONS.inc(state=state)
+        BREAKER_STATE.set(_STATE_GAUGE[state])
+        if JOURNAL.enabled:
+            JOURNAL.solver_event("breaker", f"breaker-{'opened' if state == STATE_OPEN else state}")
+        log.warning("solver circuit breaker -> %s (consecutive=%d threshold=%d)", state, self.consecutive, self.threshold)
+
+    def admit(self, simulation: bool = False) -> bool:
+        """May this solve attempt the device path? CLOSED admits everyone;
+        OPEN denies until the backoff expires, then the first REAL solve
+        becomes the half-open recovery probe (simulation solves share the
+        open/closed answer but never ride — or become — the probe)."""
+        with self._lock:
+            if self.state == STATE_CLOSED:
+                return True
+            if self.state == STATE_OPEN and self.clock.now() >= self._open_until:
+                if simulation:
+                    return False  # a what-if must not spend the recovery probe
+                self._transition_locked(STATE_HALF_OPEN)
+                return True
+            if self.state == STATE_HALF_OPEN:
+                return not simulation
+            return False
+
+    def record_fault(self, kind: str, simulation: bool = False) -> None:
+        """One classified device fault that ended a solve's device attempt.
+        Simulation solves never trip the breaker (cross-loop interference:
+        the scraper's what-if would open the real provisioner's path)."""
+        if simulation:
+            return
+        with self._lock:
+            self.last_fault_kind = kind
+            if self.state == STATE_HALF_OPEN:
+                # the probe failed: back to OPEN for another backoff
+                self._open_until = self.clock.now() + self.backoff
+                self.opened_total += 1
+                self._transition_locked(STATE_OPEN)
+                return
+            self.consecutive += 1
+            if self.state == STATE_CLOSED and self.consecutive >= self.threshold:
+                self._open_until = self.clock.now() + self.backoff
+                self.opened_total += 1
+                self._transition_locked(STATE_OPEN)
+
+    def record_success(self, simulation: bool = False) -> None:
+        """A solve's device attempt succeeded (any rung that still reached
+        the device — plain, retired-flavor, or chunked)."""
+        if simulation:
+            return
+        with self._lock:
+            self.consecutive = 0
+            if self.state == STATE_HALF_OPEN:
+                self._transition_locked(STATE_CLOSED)
+
+    def snapshot(self) -> dict:
+        """The /debug/solver breaker block."""
+        with self._lock:
+            now = self.clock.now()
+            return {
+                "state": self.state,
+                "threshold": self.threshold,
+                "backoff_seconds": self.backoff,
+                "consecutive_faults": self.consecutive,
+                "opened_total": self.opened_total,
+                "last_fault_kind": self.last_fault_kind,
+                "reopen_probe_in_seconds": (
+                    round(max(0.0, self._open_until - now), 3) if self.state == STATE_OPEN else 0.0
+                ),
+            }
+
+
+BREAKER = SolverCircuitBreaker()

@@ -54,6 +54,7 @@ import numpy as np
 from ..api import labels as lbl
 from ..ir.encode import DenseProblem, GroupKind, WarmViewEncoding, encode_warm_views
 from ..utils import resources as res
+from .faults import FAULTS, SOLVER_FAULTS, classify
 
 log = logging.getLogger("karpenter_tpu.solver")
 
@@ -371,6 +372,10 @@ def _device_counts(plan_: WarmFillPlan, solver) -> Optional[np.ndarray]:
         return None
     try:
         t0 = time.perf_counter()
+        # fault-domain injection seam (solver/faults.py): the warm-fill
+        # admission surface is a device dispatch boundary like the bucket
+        # solve; a planned fault here exercises the prune-on-host fallback
+        FAULTS.check("warmfill")
         sizes32 = plan_.sizes.astype(np.float32)
         head32 = plan_.enc.head0.astype(np.float32)
         if solver is not None and solver._pallas_enabled():
@@ -387,6 +392,11 @@ def _device_counts(plan_: WarmFillPlan, solver) -> Optional[np.ndarray]:
             solver.stats.fill_device_seconds += dt
         return counts
     except Exception as exc:  # pruning is an optimization; never break the fill
+        fault = classify(exc)
+        if fault is not None:
+            # a classified device fault on the admission surface: counted
+            # into the taxonomy even though the exact host scan absorbs it
+            SOLVER_FAULTS.inc(kind=fault.kind)
         log.warning("warm-fill device surface unavailable, pruning on host: %r", exc)
         return None
 

@@ -105,6 +105,18 @@ class Options:
     # recovery is noticed, above the batch window so a total crunch cannot
     # hot-loop the solver into the wall
     ice_backoff_seconds: float = 10.0
+    # solver fault domain (solver/faults.py): pre-solve HBM-pressure budget —
+    # when the flight recorder's HBM-peak gauge exceeds this many bytes the
+    # dense dispatch chunks pre-emptively instead of building the full
+    # [B, T] surface (0 = no budget; requires --enable-solver-telemetry for
+    # the gauge to be live)
+    solver_hbm_budget_bytes: int = 0
+    # the solver circuit breaker: this many CONSECUTIVE classified device
+    # faults short-circuit the device attempt entirely (the exact host loop
+    # owns every batch), and after the backoff the next real solve runs a
+    # half-open recovery probe that re-admits the fast path on success
+    solver_breaker_threshold: int = 3
+    solver_breaker_backoff: float = 30.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -124,6 +136,12 @@ class Options:
             errs.append("gc registration grace must be non-negative")
         if self.ice_backoff_seconds <= 0:
             errs.append("ice backoff must be positive")
+        if self.solver_hbm_budget_bytes < 0:
+            errs.append("solver hbm budget must be non-negative")
+        if self.solver_breaker_threshold < 1:
+            errs.append("solver breaker threshold must be >= 1")
+        if self.solver_breaker_backoff <= 0:
+            errs.append("solver breaker backoff must be positive")
         if self.trace_ring_size <= 0:
             errs.append("trace ring size must be positive")
         if self.flight_ring_size <= 0:
@@ -182,6 +200,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--interruption-queue", dest="interruption_queue", default=_env("INTERRUPTION_QUEUE", defaults.interruption_queue))
     parser.add_argument("--interruption-poll-interval", type=float, default=_env("INTERRUPTION_POLL_INTERVAL", defaults.interruption_poll_interval))
     parser.add_argument("--ice-backoff-seconds", type=float, default=_env("ICE_BACKOFF_SECONDS", defaults.ice_backoff_seconds))
+    parser.add_argument("--solver-hbm-budget", dest="solver_hbm_budget_bytes", type=int, default=_env("SOLVER_HBM_BUDGET", defaults.solver_hbm_budget_bytes))
+    parser.add_argument("--solver-breaker-threshold", type=int, default=_env("SOLVER_BREAKER_THRESHOLD", defaults.solver_breaker_threshold))
+    parser.add_argument("--solver-breaker-backoff", type=float, default=_env("SOLVER_BREAKER_BACKOFF", defaults.solver_breaker_backoff))
     parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     parser.add_argument("--gc-interval", type=float, default=_env("GC_INTERVAL", defaults.gc_interval))

@@ -33,6 +33,12 @@ def test_bench_smoke():
     # SOLVER_CONTRACTS.json and every attributed recompile was explained by
     # a declared-varying axis (analysis/contracts.py recompile_violations)
     assert summary.pop("contract_recompile_violations") == 0
+    # the solver fault-domain steady-state gate ran and held: healthy
+    # hardware produced zero classified faults, zero degradation-ladder
+    # rungs, and the circuit breaker never opened (solver/faults.py)
+    assert summary.pop("solver_faults_total") == 0
+    assert summary.pop("degraded_solves_total") == 0
+    assert summary.pop("breaker_state") == "closed"
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name

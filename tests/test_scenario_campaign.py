@@ -61,6 +61,10 @@ class TestSchemaValidator:
                         "unschedulable_pod_seconds": 0.4,
                         "recompiles_total": 0,
                         "solver_latency_p95_seconds": 0.01,
+                        "solver_faults_total": 0,
+                        "degraded_solves_total": 0,
+                        "solver_faults_injected": 0,
+                        "breaker_state": "closed",
                         "waterfall": {
                             "queue_wait": {"p50": 0.0, "p95": 0.01, "p99": 0.01, "count": 4},
                             "solve": {"p50": 0.02, "p95": 0.03, "p99": 0.03, "count": 4},
@@ -117,6 +121,17 @@ class TestSchemaValidator:
         assert scenario_doc_errors(doc) == []
         doc["runs"][0]["scores"]["solver_latency_p95_seconds"] = -0.1
         assert any("solver_latency_p95_seconds" in e for e in scenario_doc_errors(doc))
+
+    def test_solver_fault_scores_required_and_typed(self):
+        doc = self._valid_doc()
+        del doc["runs"][0]["scores"]["solver_faults_total"]
+        assert any("solver_faults_total" in e for e in scenario_doc_errors(doc))
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["degraded_solves_total"] = "many"
+        assert any("degraded_solves_total" in e for e in scenario_doc_errors(doc))
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["breaker_state"] = "melted"
+        assert any("breaker_state" in e for e in scenario_doc_errors(doc))
 
     def test_waterfall_scores_gated(self):
         # the waterfall block is required, keyed by the segment vocabulary,
@@ -189,6 +204,12 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     # compilations — while the latency summary still observed every real
     # provisioning solve
     assert scores["recompiles_total"] == 0
+    # solver fault domain: a healthy host-path run observes zero faults,
+    # zero degraded solves, injects nothing, and ends with a CLOSED breaker
+    assert scores["solver_faults_total"] == 0
+    assert scores["degraded_solves_total"] == 0
+    assert scores["solver_faults_injected"] == 0
+    assert scores["breaker_state"] == "closed"
     # every scenario run provisions, so the solve-latency summary must have
     # observed real solves: non-null on EVERY run, not merely well-typed
     assert scores["solver_latency_p95_seconds"] is not None
@@ -259,3 +280,21 @@ def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
     # pools for the whole run
     for run in by_name["spot_collapse"]["runs"]:
         assert run["scores"]["nodes_churned"].get("interruption", 0) >= 1
+    # device fault storm: every injected fault was classified (the taxonomy
+    # counter covers at least the injected count), degraded solves were
+    # recorded, and the breaker — whose opening the settled predicate
+    # already required for convergence — ended CLOSED (fast path re-admitted)
+    for run in by_name["device_fault_storm"]["runs"]:
+        scores = run["scores"]
+        assert scores["solver_faults_injected"] >= 3, scores
+        assert scores["solver_faults_total"] >= scores["solver_faults_injected"], scores
+        assert scores["degraded_solves_total"] >= 1, scores
+        assert scores["breaker_state"] == "closed", scores
+    # hbm pressure: injected RESOURCE_EXHAUSTED faults were absorbed by the
+    # chunked-solve rung without ever opening the breaker
+    for run in by_name["hbm_pressure"]["runs"]:
+        scores = run["scores"]
+        assert scores["solver_faults_injected"] >= 1, scores
+        assert scores["solver_faults_total"] >= scores["solver_faults_injected"], scores
+        assert scores["degraded_solves_total"] >= 1, scores
+        assert scores["breaker_state"] == "closed", scores
