@@ -165,6 +165,8 @@ def default_catalog() -> List[InstanceTypeInfo]:
     "_lock",
     "instances",
     "fleet_tokens",
+    "token_launches",
+    "_double_launches_evicted",
     "pending_reclaims",
     "launch_templates",
     "od_prices",
@@ -227,6 +229,19 @@ class CloudBackend:
         # token, EC2-style.
         self.fleet_tokens: Dict[str, FleetResult] = {}
         self._fleet_token_cap = 4096
+        # the double-launch witness (control-plane fault domain): how many
+        # times each client token EXECUTED a launch (replays excluded) — a
+        # count above 1 means idempotency failed or two leaders raced one
+        # logical launch past the token ledger; chaos scenarios score
+        # sum(n-1) and pin it at zero. Bounded on its OWN, longer horizon
+        # (4x the replay cap): a token evicted from fleet_tokens whose
+        # delayed retry then re-executes must still be seen twice here —
+        # evicting the two ledgers together would blind the witness to the
+        # exact replay-cap miss it exists to catch. Overflow folds n-1 into
+        # the running total before an entry leaves, so eviction can never
+        # launder a detected double launch.
+        self.token_launches: Dict[str, int] = {}
+        self._double_launches_evicted = 0
         # fault injection
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()  # (type, zone, capacity_type)
         # FINITE capacity per pool: remaining launchable units for pools
@@ -448,6 +463,17 @@ class CloudBackend:
                 while len(self.fleet_tokens) >= self._fleet_token_cap:
                     del self.fleet_tokens[next(iter(self.fleet_tokens))]
                 self.fleet_tokens[request.client_token] = result
+                # the double-launch witness: this call EXECUTED (it is not
+                # a replay — replays returned above); a second execution
+                # under the same token is the failure the ledger exists to
+                # catch, so it outlives the replay cap (own bound, overflow
+                # folded into the running total at eviction)
+                self.token_launches[request.client_token] = self.token_launches.get(request.client_token, 0) + 1
+                while len(self.token_launches) > self._fleet_token_cap * 4:
+                    evicted = next(iter(self.token_launches))
+                    executions = self.token_launches.pop(evicted)
+                    if executions > 1:
+                        self._double_launches_evicted += executions - 1
             if self._drop_response > 0:
                 # the launch HAPPENED (and its token is settled above); only
                 # the response is lost — a tokened retry replays it
@@ -476,6 +502,15 @@ class CloudBackend:
     def instance_exists(self, instance_id: str) -> bool:
         with self._lock:
             return instance_id in self.instances
+
+    def double_launches(self) -> int:
+        """The client-token ledger's verdict: launches that EXECUTED more
+        than once under one token (evicted offenders included). Idempotency
+        (and leader-flap safety — two leaders racing one logical launch)
+        means this must be zero; the chaos scenarios score it as
+        `double_launches`."""
+        with self._lock:
+            return self._double_launches_evicted + sum(n - 1 for n in self.token_launches.values() if n > 1)
 
     def list_instances(self) -> List[FleetInstance]:
         """Every live instance — the DescribeInstances sweep the GC

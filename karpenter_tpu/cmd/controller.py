@@ -133,6 +133,13 @@ def main(argv=None) -> int:
 
         extra_routes.update(journal.routes())
         debug_descriptions.update(journal.route_descriptions())
+    if options.coherence_interval > 0:
+        # informer-coherence witness read surface: registered caches,
+        # confirmed divergences vs the store, last check on the metrics port
+        from ..kube import coherence
+
+        extra_routes.update(coherence.routes())
+        debug_descriptions.update(coherence.route_descriptions())
     extra_routes["/debug"] = debug_index_route(debug_descriptions)
     obs = ObservabilityServer(
         healthy=runtime.healthy,

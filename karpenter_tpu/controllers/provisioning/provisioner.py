@@ -68,10 +68,17 @@ class ProvisionerController:
         wait_for_cluster_sync: bool = True,
         clock=None,
         ice_backoff_seconds: Optional[float] = None,
+        leader_check=None,
     ):
         from ...utils.clock import Clock
 
         self.kube = kube
+        # leadership gate (runtime.py _may_act): when set, the batch loop
+        # holds a completed batch until the gate opens instead of launching
+        # as a deposed leader — the flap-safety half of the client-token
+        # ledger's no-double-launch witness. None = always act (embedded and
+        # test callers with no election)
+        self._leader_check = leader_check
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.config = config or Config()
@@ -131,6 +138,13 @@ class ProvisionerController:
             self.batcher.wait(deadline=self._earliest_ice_retry())
             if self._stop.is_set():
                 return
+            # leader-flap gate: a deposed leader HOLDS the batch (the pods
+            # stay pending, a successor will pick them up or we will on
+            # re-election) rather than launching capacity it no longer owns
+            while self._leader_check is not None and not self._leader_check():
+                if self._stop.is_set():
+                    return
+                self.clock.sleep(0.05)
             try:
                 self.provision()
             except Exception:  # noqa: BLE001 - the loop is self-healing
@@ -534,7 +548,12 @@ class ProvisionerController:
         try:
             self.kube.create(node)
         except Conflict:
-            pass  # idempotent create (provisioner.go:317-328)
+            # idempotent create (provisioner.go:317-328) — absorbed, never
+            # silent: the kube layer counted the 409 into
+            # karpenter_kube_conflicts_total{kind="Node",verb="create"}, and
+            # the log names the node so a leader-flap double-register is
+            # attributable instead of vanishing into a bare `pass`
+            log.info("node %s already registered (create conflict absorbed)", node.name)
         sp.set(node=node.name, instance_type=node.metadata.labels.get(lbl.LABEL_INSTANCE_TYPE, ""))
         if TRACER.enabled:
             # the scheduler recorded placed-new against the placeholder

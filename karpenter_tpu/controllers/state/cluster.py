@@ -401,6 +401,20 @@ class Cluster:
 
     # -- consistency guard --------------------------------------------------------
 
+    def coherence_view(self) -> Dict[str, dict]:
+        """The coherence witness's comparison surface (kube/coherence.py):
+        node name -> resourceVersion and pod key -> node binding, snapshot
+        under one lock hold so the witness deep-compares a CONSISTENT view
+        against the authoritative store."""
+        with self._lock:
+            return {
+                "nodes": {
+                    name: int(state.node.metadata.resource_version or 0)
+                    for name, state in self._nodes.items()
+                },
+                "bindings": dict(self._bindings),
+            }
+
     def synchronized(self) -> bool:
         """True when every node/bound pod in the API is reflected here —
         the over-provisioning guard (cluster.go:490-510)."""

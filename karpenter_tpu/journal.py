@@ -66,6 +66,7 @@ DEFAULT_SPOOL_MAX_BYTES = 16 * 2**20  # total on-disk budget (live + rotated)
 KIND_POD = "pod"
 KIND_NODE = "node"
 KIND_SOLVER = "solver"
+KIND_KUBE = "kube"
 
 # the transition vocabularies; journal_schema.py validates files against them
 POD_EVENTS = ("created", "queued", "batch-admitted", "solved", "nominated", "bound", "failed", "deleted")
@@ -75,6 +76,11 @@ NODE_EVENTS = ("launch-requested", "launched", "registered", "ready", "initializ
 # kind twice, the breaker re-opens — so they bypass the first-occurrence
 # dedupe and never participate in the waterfall
 SOLVER_EVENTS = ("fault", "degraded", "breaker-opened", "breaker-half-open", "breaker-closed")
+# control-plane fault-domain events (kube/chaos.py + kube/leaderelection.py):
+# conflict storms, watch gaps, informer relists, and lease transitions —
+# also a stream (the same storm fires repeatedly), so replay traces capture
+# control-plane weather alongside pod/node/solver events
+KUBE_EVENTS = ("conflict-storm", "watch-gap", "relist", "lease-lost", "lease-acquired")
 
 # waterfall segments, in chain order: consecutive sub-intervals of
 # created->bound, so their sum IS the pending duration (conservation)
@@ -307,6 +313,8 @@ class Journal:
             vocab = NODE_EVENTS
         elif kind == KIND_SOLVER:
             vocab = SOLVER_EVENTS
+        elif kind == KIND_KUBE:
+            vocab = KUBE_EVENTS
         else:
             raise ValueError(f"unknown journal kind {kind!r}")
         if event not in vocab:
@@ -326,10 +334,11 @@ class Journal:
             raw_t = t
             t = max(t, self._last_t)
             self._last_t = t
-            if kind != KIND_SOLVER:
-                # solver fault-domain events are a stream (the same fault
-                # kind can legitimately repeat), so only pod/node milestones
-                # carry the first-occurrence dedupe + waterfall bookkeeping
+            if kind in (KIND_POD, KIND_NODE):
+                # solver/kube fault-domain events are a stream (the same
+                # fault kind can legitimately repeat), so only pod/node
+                # milestones carry the first-occurrence dedupe + waterfall
+                # bookkeeping
                 milestones = self._milestones.get((kind, entity))
                 if milestones is None:
                     milestones = {}
@@ -382,6 +391,14 @@ class Journal:
         state change. `entity` names the emitting component ('dense',
         'breaker'); unlike pod/node milestones these are never deduped."""
         return self.record(KIND_SOLVER, entity, event, t=t, attrs=attrs)
+
+    def kube_event(self, entity: str, event: str, t: Optional[float] = None, **attrs) -> Optional[JournalEvent]:
+        """One control-plane fault-domain transition (kube/chaos.py +
+        kube/leaderelection.py): an injected conflict storm, a watch gap,
+        an informer relist, or a lease transition. `entity` names the
+        emitting component (a verb boundary, a watch loop, an elector
+        identity); like solver events these are a stream, never deduped."""
+        return self.record(KIND_KUBE, entity, event, t=t, attrs=attrs)
 
     def note_observed_pending(self, pod: str, seconds: float) -> None:
         """Cross-feed from the SLO accountant: the independently-measured

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
 from typing import Callable, Dict, List, Optional
 from urllib.parse import urlparse
@@ -31,13 +32,20 @@ from urllib.parse import urlparse
 from ..api.objects import CSINode, Namespace, Node, PersistentVolume, PersistentVolumeClaim, Pod, StorageClass
 from ..api.provisioner import Provisioner
 from ..logsetup import get_logger
-from .cluster import ADDED, DELETED, MODIFIED, Conflict, NotFound, WatchEvent
+from .chaos import KUBE_CONFLICTS
+from .cluster import ADDED, DELETED, MODIFIED, Conflict, ConflictExhausted, NotFound, WatchEvent
 from .codec import API_REGISTRY, from_wire, rest_path, to_wire
 
 log = get_logger("kubeclient")
 
 DEFAULT_QPS = 200.0  # options.go:65
 DEFAULT_BURST = 300  # options.go:66
+
+# watch-reconnect backoff: exponential cap with FULL jitter (the apiclient
+# retry idiom) through the clock seam — a restarted apiserver must not be
+# thundering-herded by every informer reconnecting on the same tick
+WATCH_BACKOFF_BASE = 0.05
+WATCH_BACKOFF_CAP = 2.0
 
 
 class TokenBucket:
@@ -110,6 +118,10 @@ class HttpKubeClient:
         self._watch_cancels: List[tuple] = []  # (kind, handler, cancel Event)
         self._stop = threading.Event()
         self._local = threading.local()  # per-thread persistent connection
+        # seeded per client: the jitter must differ BETWEEN informers of one
+        # process (each watch loop draws from the shared stream) while tests
+        # stay reproducible enough to bound the sleep range
+        self._watch_rng = random.Random(0x5EED)
 
     # -- transport -----------------------------------------------------------
 
@@ -166,6 +178,7 @@ class HttpKubeClient:
             out = self._request("POST", rest_path(obj.kind, obj.metadata.namespace), wire)
         except ApiStatusError as err:
             if err.code == 409:
+                KUBE_CONFLICTS.inc(kind=obj.kind, verb="create")
                 raise Conflict(str(err)) from err
             raise
         stored = from_wire(out)
@@ -174,10 +187,12 @@ class HttpKubeClient:
         obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
         return obj
 
+    RETRY_ON_CONFLICT_ATTEMPTS = 4
+
     def update(self, obj) -> object:
         wire = to_wire(obj)
         path = rest_path(obj.kind, obj.metadata.namespace, obj.metadata.name)
-        for attempt in range(4):
+        for attempt in range(self.RETRY_ON_CONFLICT_ATTEMPTS):
             try:
                 out = self._request("PUT", path, wire)
                 obj.metadata.resource_version = int(out.get("metadata", {}).get("resourceVersion") or 0)
@@ -185,18 +200,25 @@ class HttpKubeClient:
             except ApiStatusError as err:
                 if err.code == 404:
                     raise NotFound(str(err)) from err
-                if err.code == 409 and attempt < 3:
-                    # RetryOnConflict: refresh the version, resend our state
-                    try:
-                        current = self._request("GET", path)
-                    except ApiStatusError as get_err:
-                        if get_err.code == 404:
-                            raise NotFound(str(get_err)) from get_err
-                        raise
-                    wire["metadata"]["resourceVersion"] = current.get("metadata", {}).get("resourceVersion", "0")
-                    continue
-                raise
-        raise Conflict(f"{obj.kind} {obj.metadata.name!r}: conflict retries exhausted")
+                if err.code != 409:
+                    raise
+                KUBE_CONFLICTS.inc(kind=obj.kind, verb="update")
+                if attempt == self.RETRY_ON_CONFLICT_ATTEMPTS - 1:
+                    # typed exhaustion, never a raw ApiStatusError: callers
+                    # dispatch on "every refresh round lost" explicitly
+                    raise ConflictExhausted(
+                        f"{obj.kind} {obj.metadata.name!r}: conflict retries exhausted"
+                        f" after {self.RETRY_ON_CONFLICT_ATTEMPTS} attempts"
+                    ) from err
+                # RetryOnConflict: refresh the version, resend our state
+                try:
+                    current = self._request("GET", path)
+                except ApiStatusError as get_err:
+                    if get_err.code == 404:
+                        raise NotFound(str(get_err)) from get_err
+                    raise
+                wire["metadata"]["resourceVersion"] = current.get("metadata", {}).get("resourceVersion", "0")
+        raise RuntimeError("unreachable")
 
     def update_no_retry(self, obj) -> object:
         """Conditional update: a stale resourceVersion surfaces as Conflict
@@ -208,6 +230,7 @@ class HttpKubeClient:
             if err.code == 404:
                 raise NotFound(str(err)) from err
             if err.code == 409:
+                KUBE_CONFLICTS.inc(kind=obj.kind, verb="update_no_retry")
                 raise Conflict(str(err)) from err
             raise
         obj.metadata.resource_version = int(out.get("metadata", {}).get("resourceVersion") or 0)
@@ -228,6 +251,11 @@ class HttpKubeClient:
         except ApiStatusError as err:
             if err.code == 404:
                 return  # idempotent, like KubeCluster.delete
+            if err.code == 409:
+                # a conflicted delete (injected storms included) must speak
+                # the same typed, counted surface the other verbs do
+                KUBE_CONFLICTS.inc(kind=obj.kind, verb="delete")
+                raise Conflict(str(err)) from err
             raise
         # surface the terminating timestamp on the caller's copy
         dt = out.get("metadata", {}).get("deletionTimestamp")
@@ -253,6 +281,13 @@ class HttpKubeClient:
             if err.code == 404:
                 return None
             raise
+
+    def version(self) -> int:
+        """The store's global resourceVersion, read off a LIST envelope —
+        the KubeCluster.version() parity surface the coherence witness's
+        moved-under-me guard compares before and after a deep compare."""
+        out = self._request("GET", rest_path("Node"))
+        return int(out.get("metadata", {}).get("resourceVersion") or 0)
 
     def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
         _, _, namespaced = API_REGISTRY[kind]
@@ -287,6 +322,7 @@ class HttpKubeClient:
         known: Dict[str, object] = {}  # uid -> last object delivered to the handler
         rv = 0
         first = True
+        attempt = 0  # consecutive reconnect failures (resets on a healthy stream)
         while not self._stop.is_set() and not (cancel is not None and cancel.is_set()):
             try:
                 if first or rv == 0:
@@ -307,14 +343,25 @@ class HttpKubeClient:
                         for uid, o in known.items():
                             if uid not in current:
                                 handler(WatchEvent(DELETED, o))
+                    if not first:
+                        from ..journal import JOURNAL
+
+                        if JOURNAL.enabled:
+                            JOURNAL.kube_event(f"watch-{kind.lower()}", "relist", transport="http")
                     known = current
                     first = False
                 rv = self._stream(kind, rv, handler, known, cancel)
+                attempt = 0  # the stream served (or closed cleanly): healthy
             except Exception as exc:  # noqa: BLE001 - reconnect like an informer
                 if self._stop.is_set() or (cancel is not None and cancel.is_set()):
                     return
-                log.debug("watch %s: reconnecting after %s", kind, exc)
-                self.clock.sleep(0.05)
+                # full-jitter backoff (the apiclient retry idiom): every
+                # informer of every replica reconnecting to a restarted
+                # apiserver on the same 50 ms tick IS the thundering herd
+                cap = min(WATCH_BACKOFF_CAP, WATCH_BACKOFF_BASE * (2**attempt))
+                attempt += 1
+                log.debug("watch %s: reconnecting after %s (attempt %d)", kind, exc, attempt)
+                self.clock.sleep(self._watch_rng.uniform(0.0, cap))
 
     def _stream(self, kind: str, rv: int, handler: Callable[[WatchEvent], None], known: Dict[str, object], cancel=None) -> int:
         conn = self._new_connection(timeout=300)

@@ -7,22 +7,39 @@ renews before leaseDuration expires; a candidate acquires when the lease is
 unheld or its renewTime is older than leaseDuration (the previous holder
 died). Optimistic concurrency (resourceVersion 409s from the apiserver)
 serializes the race — exactly the client-go leaderelection loop.
+
+Flap hardening (the control-plane fault domain): the run loop reports BOTH
+transitions — `on_started_leading` and `on_stopped_leading` — so a holder
+whose lease is stolen or whose renew fails steps its loops down before the
+successor's recovery acts; every lost transition is counted
+(`karpenter_leader_flaps_total`) and journaled (`lease-lost` /
+`lease-acquired` kube events), and the chaos seam (kube/chaos.py) can fail
+individual renew rounds (`lease-lost` fault) or steal the lease outright
+(`steal_lease`) to prove it.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Optional
 
 from ..api.objects import Lease, LeaseSpec, ObjectMeta
 from ..logsetup import get_logger
+from ..metrics import REGISTRY
+from .chaos import FAULT_CONFLICT, FAULT_LEASE_LOST, KUBE_CHAOS
 from .cluster import Conflict, NotFound
 
 log = get_logger("leaderelection")
 
 LEASE_NAME = "karpenter-leader-election"
 LEASE_NAMESPACE = "kube-system"
+
+LEADER_FLAPS = REGISTRY.counter(
+    "karpenter_leader_flaps_total",
+    "Leadership transitions LOST by an elector (failed renew, stolen lease, or"
+    " transport outage): each one pauses the old leader's singleton loops and"
+    " forces the next acquisition to run recovery before acting.",
+)
 
 
 class LeaseElector:
@@ -63,6 +80,11 @@ class LeaseElector:
         the round — retry next period."""
         import copy
 
+        # the chaos seam: an injected lease-lost/conflict fails THIS round's
+        # CAS the way a racing candidate would — the loop below must step
+        # down, never free-run on a lease it cannot prove it holds
+        if KUBE_CHAOS.check("lease-renew", "Lease") in (FAULT_LEASE_LOST, FAULT_CONFLICT):
+            return False
         now = self.clock.now()
         lease = self.kube.get("Lease", self.name, self.namespace)
         # deepcopy before mutating: an in-memory backend returns live shared
@@ -109,8 +131,22 @@ class LeaseElector:
 
     # -- background loop ------------------------------------------------------
 
-    def start(self, on_started_leading: Optional[Callable[[], None]] = None) -> "LeaseElector":
+    def start(
+        self,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> "LeaseElector":
+        def fire(callback, transition: str) -> None:
+            if callback is None:
+                return
+            try:
+                callback()
+            except Exception:  # noqa: BLE001 - a callback must not kill the loop
+                log.exception("leader election: %s %s callback failed", self.identity, transition)
+
         def run():
+            from ..journal import JOURNAL
+
             while not self._stop.is_set():
                 try:
                     held = self.try_acquire_or_renew()
@@ -122,14 +158,21 @@ class LeaseElector:
                     held = False
                 if held and not self._leading.is_set():
                     log.info("leader election: %s became leader", self.identity)
+                    if JOURNAL.enabled:
+                        JOURNAL.kube_event(self.identity, "lease-acquired", lease=self.name)
                     self._leading.set()
-                    if on_started_leading:
-                        on_started_leading()
+                    fire(on_started_leading, "started-leading")
                 elif not held and self._leading.is_set():
-                    # failed to renew: step down (client-go exits; a library
-                    # caller may instead pause work until re-acquired)
+                    # failed to renew (or the lease was stolen): step down —
+                    # the stopped callback runs BEFORE the next round, so the
+                    # old leader's loops pause before any successor's
+                    # recovery can act on the cluster
                     log.warning("leader election: %s lost the lease", self.identity)
+                    LEADER_FLAPS.inc()
+                    if JOURNAL.enabled:
+                        JOURNAL.kube_event(self.identity, "lease-lost", lease=self.name)
                     self._leading.clear()
+                    fire(on_stopped_leading, "stopped-leading")
                 self._stop.wait(self.renew_period)
 
         self._thread = threading.Thread(target=run, daemon=True, name=f"lease-elector-{self.identity}")
@@ -157,3 +200,39 @@ class LeaseElector:
                 except (Conflict, NotFound):
                     pass
         self._leading.clear()
+
+
+def steal_lease(kube, identity: str = "chaos-thief", name: str = LEASE_NAME, namespace: str = LEASE_NAMESPACE, clock=None) -> bool:
+    """Adversarially overwrite the lease holder mid-renew — the chaos seam's
+    lease-steal action. The steal itself obeys optimistic concurrency (a CAS
+    loop), because a thief that bypassed the protocol would prove nothing:
+    the point is that a LEGAL competing writer can take the lease, and the
+    displaced holder must step down on its next renew round. The thief never
+    renews, so the lease expires after `lease_duration` and a real candidate
+    re-acquires. Returns True when the steal landed."""
+    import copy
+
+    from ..utils.clock import Clock
+
+    clock = clock or getattr(kube, "clock", None) or Clock()
+    cas = getattr(kube, "update_no_retry", kube.update)
+    for _ in range(16):
+        lease = kube.get("Lease", name, namespace)
+        if lease is None:
+            return False
+        lease = copy.deepcopy(lease)
+        now = clock.now()
+        lease.spec.holder_identity = identity
+        lease.spec.acquire_time = now
+        lease.spec.renew_time = now
+        lease.spec.lease_transitions = (lease.spec.lease_transitions or 0) + 1
+        try:
+            cas(lease)
+        except Conflict:
+            continue  # the holder renewed under us: retry the steal
+        except NotFound:
+            return False
+        KUBE_CHAOS.record_action("lease-steal", thief=identity, lease=name)
+        log.warning("lease %s stolen by %s (transition %d)", name, identity, lease.spec.lease_transitions)
+        return True
+    return False

@@ -142,11 +142,30 @@ class Runtime:
             from .service.client import SolverClient
 
             remote_solver = SolverClient(self.options.solver_service_address, timeout=self.options.solver_service_timeout)
+        # leadership gate (leader-flap hardening): the singleton loops —
+        # provisioning included — consult this event before acting; it is
+        # set only while this runtime holds the lease AND its post-(re)gain
+        # recovery has finished, so a displaced leader's loops pause before
+        # any successor's recovery acts and a re-elected leader reconstructs
+        # before it provisions. The epoch counter (written only by the
+        # elector thread) fences a recovery that outlived its leadership:
+        # a gate must never open for a term that already ended
+        self._leader_active = threading.Event()
+        self._leader_epoch = 0
+        self._recovery_thread: Optional[threading.Thread] = None
+        # serializes the recovery thread's check-and-open against the lost
+        # callback's bump-and-close: without it the gate could open for a
+        # term that ended between the check and the set, with no later
+        # transition left to re-close it
+        from .analysis.witness import WITNESS as _WITNESS
+
+        self._gate_lock = _WITNESS.lock("runtime.leader-gate")
         self.provisioner = ProvisionerController(
             self.kube, self.cluster, self.cloud_provider, config=self.config,
             recorder=self.recorder, dense_solver=self.dense_solver,
             remote_solver=remote_solver, clock=self.kube.clock,
             ice_backoff_seconds=self.options.ice_backoff_seconds,
+            leader_check=self._may_act if self.options.leader_elect else None,
         )
         self.reconciler = ProvisioningReconciler(self.kube, self.provisioner)
         self.node_controller = NodeController(
@@ -232,12 +251,24 @@ class Runtime:
         import socket
         import uuid
 
+        from .kube.coherence import COHERENCE
         from .kube.leaderelection import LeaseElector
 
         # hostname + random suffix, the client-go identity recipe — unique
         # across processes (id(self) is a heap address and can collide)
         identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
-        self.elector = LeaseElector(self.kube, identity=identity, clock=self.kube.clock)
+        self.elector = LeaseElector(
+            self.kube, identity=identity, clock=self.kube.clock,
+            lease_duration=self.options.lease_duration,
+            renew_period=self.options.lease_renew_period,
+        )
+        # informer-coherence witness: this runtime's state cache is under
+        # deep-compare for its whole life (the periodic loop only runs when
+        # --coherence-interval > 0, but registration is what lets chaos
+        # harnesses run the teardown final_check); a stopped/crashed runtime
+        # deregisters in _detach_watchers
+        self._coherence_name = f"state.cluster/{identity}"
+        COHERENCE.register(self._coherence_name, self.cluster)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.solve_duration = REGISTRY.histogram(
@@ -273,28 +304,95 @@ class Runtime:
 
     # -- lifecycle -------------------------------------------------------------
 
+    def _may_act(self) -> bool:
+        """The leadership gate the singleton loops consult before every
+        pass. True while this runtime holds the lease and its post-gain
+        recovery has completed; always True without leader election."""
+        return self._leader_active.is_set()
+
+    def _recover(self) -> None:
+        """Restart/flap reconstruction, phases 2+3, leader-only (followers
+        hold no ledger and must not race the leader's sweep): rebuild the
+        disruption ledger / reap-or-adopt from durable markers, then run the
+        startup GC sweep so crash leftovers reconcile BEFORE the control
+        loops resume acting."""
+        if self.disruption is not None:
+            self._pass("disruption-recovery", self.disruption.recover)
+        self._pass("gc", self.gc.reconcile)
+
+    def _on_leadership_gained(self) -> None:
+        """Elector callback, every transition INTO leadership (first
+        election and every re-acquisition after a flap): reconstruction runs
+        before the gate opens — a re-elected leader is a successor in every
+        sense, its in-memory ledgers may have gone stale while someone (or
+        no one) else held the lease. Recovery runs on its OWN thread: a
+        slow ledger rebuild must not starve the elector's renew loop (a
+        lease expiring mid-recovery would elect a peer while we still think
+        we are reconstructing toward leadership). The epoch captured here
+        fences the gate: if leadership was lost while recovery ran, the
+        gate stays closed — the term it was recovering for is over."""
+        epoch = self._leader_epoch
+
+        def recover_then_open() -> None:
+            try:
+                self._recover()
+            except Exception:  # noqa: BLE001 - a failed recovery must not strand leadership
+                log.exception("post-election recovery failed; acting anyway (GC loop will reconcile)")
+            with self._gate_lock:
+                # atomic vs _on_leadership_lost: the lost callback always
+                # runs AFTER the elector cleared _leading, and it bumps the
+                # epoch + clears the gate under this same lock — so a set
+                # here either belongs to a live term or is re-closed by the
+                # lost callback queued right behind us, never left open
+                if self._leader_epoch == epoch and self.elector.is_leader():
+                    self._leader_active.set()
+                else:
+                    log.warning("leadership lost during recovery; gate stays closed for the ended term")
+
+        # tracked apart from _threads (those are run-lifetime loops; this is
+        # a short task that EXITS when recovery completes); stop() joins it
+        self._recovery_thread = threading.Thread(target=recover_then_open, name="leader-recovery", daemon=True)
+        self._recovery_thread.start()
+
+    def _on_leadership_lost(self) -> None:
+        """Elector callback, on the lost transition: close the gate FIRST —
+        the old leader's loops must pause before any successor's recovery
+        acts, and the next gain re-runs recovery before re-opening. The
+        epoch bump invalidates any recovery still in flight for the term
+        that just ended."""
+        with self._gate_lock:
+            self._leader_epoch += 1
+            self._leader_active.clear()
+        log.warning("leadership lost: singleton loops paused until re-elected")
+
     def start(self) -> None:
         if self.options.leader_elect:
             # Lease-based election (controllers.go:104-106): block until this
-            # runtime holds karpenter-leader-election, keep renewing after
-            self.elector.start()
+            # runtime holds karpenter-leader-election, keep renewing after.
+            # The callbacks drive the leadership gate: recovery runs inside
+            # the gained callback, so waiting on _leader_active below means
+            # "elected AND reconstructed"
+            self.elector.start(
+                on_started_leading=self._on_leadership_gained,
+                on_stopped_leading=self._on_leadership_lost,
+            )
             while not self.elector.wait_for_leadership(timeout=0.5):
                 if self._stop.is_set():
                     return
             log.info("leader election won by %s", self.elector.identity)
+            while not self._leader_active.wait(timeout=0.5):
+                if self._stop.is_set():
+                    return
         log.info(
             "runtime starting: provider=%s dense_solver=%s batch window idle=%.2fs max=%.2fs",
             self.cloud_provider.name(), self.dense_solver is not None,
             self.config.batch_idle_duration, self.config.batch_max_duration,
         )
-        # restart reconstruction, phases 2+3, leader-only (followers hold no
-        # ledger and must not race the leader's sweep): rebuild the
-        # disruption ledger / reap-or-adopt from durable markers, then run
-        # the startup GC sweep so crash leftovers reconcile BEFORE the
-        # control loops resume
-        if self.disruption is not None:
-            self._pass("disruption-recovery", self.disruption.recover)
-        self._pass("gc", self.gc.reconcile)
+        if not self.options.leader_elect:
+            # no election: this process is the only control plane — run the
+            # startup reconstruction inline and open the gate permanently
+            self._recover()
+            self._leader_active.set()
         self.provisioner.start()
         self._spawn(self._lifecycle_loop, "node-lifecycle")
         if self.options.gc_interval > 0:
@@ -307,22 +405,28 @@ class Runtime:
         else:
             self._spawn(self._consolidation_loop, "consolidation")
         self._spawn(self._metrics_loop, "metrics-scraper")
-        # leader-only by construction: start() blocks on leadership above,
-        # so followers never reach this spawn — the election gating of the
-        # reference's OD/spot price updaters (pricing.go:76-393)
+        # leader-gated per pass (not merely at spawn): a leader whose lease
+        # is stolen mid-run pauses these loops at their next tick and a
+        # re-election re-opens the gate only after recovery — the election
+        # gating of the reference's OD/spot price updaters (pricing.go:76-393)
         self._spawn(self._pricing_loop, "pricing-refresh")
         if self.interruption is not None:
             # same leader gating: only the leader acts on interruption
             # notices (two replicas polling would double-provision)
             self._spawn(self._interruption_loop, "interruption")
+        if self.options.coherence_interval > 0:
+            self._spawn(self._coherence_loop, "coherence-witness")
 
     def stop(self) -> None:
         self._stop.set()
+        self._leader_active.clear()
         self.provisioner.stop()
         if self.provisioner.remote_solver is not None:
             self.provisioner.remote_solver.close()
         for thread in self._threads:
             thread.join(timeout=5)
+        if self._recovery_thread is not None:
+            self._recovery_thread.join(timeout=5)
         self.elector.stop(release=True)
         self._detach_watchers()
 
@@ -340,11 +444,14 @@ class Runtime:
         the shared in-memory cluster would be a dead process still
         executing, not a crash."""
         self._stop.set()
+        self._leader_active.clear()
         self.provisioner.stop()
         if self.provisioner.remote_solver is not None:
             self.provisioner.remote_solver.close()
         for thread in self._threads:
             thread.join(timeout=5)
+        if self._recovery_thread is not None:
+            self._recovery_thread.join(timeout=5)
         self.elector.stop(release=False)
         self._detach_watchers()
 
@@ -354,6 +461,9 @@ class Runtime:
         thread, so handlers surviving their Runtime would keep mirroring —
         and charging every kube write for — a dead control plane, growing
         linearly with each crash/restart cycle."""
+        from .kube.coherence import COHERENCE
+
+        COHERENCE.deregister(self._coherence_name)
         self.cluster.detach()
         self.reconciler.detach()
         if self._config_unwatch is not None:
@@ -367,26 +477,33 @@ class Runtime:
 
     def _lifecycle_loop(self) -> None:
         while not self._stop.wait(timeout=1.0):
+            if not self._may_act():
+                continue  # not (or no longer) the leader: pause, don't act
             self._pass("node", self.node_controller.reconcile_all)
             self._pass("termination", self.termination.reconcile_all)
             self._pass("counter", self.counter.reconcile_all)
 
     def _consolidation_loop(self) -> None:
         while not self._stop.wait(timeout=ConsolidationController.POLL_INTERVAL):
-            if self.consolidation.should_run():
+            if self._may_act() and self.consolidation.should_run():
                 self._pass("consolidation", self.consolidation.process_cluster)
 
     def _disruption_loop(self) -> None:
         from .controllers.disruption import DisruptionController
 
         while not self._stop.wait(timeout=DisruptionController.POLL_INTERVAL):
+            if not self._may_act():
+                continue
             self._pass("disruption", self.disruption.reconcile)
 
     def _gc_loop(self) -> None:
         while not self._stop.wait(timeout=self.options.gc_interval):
+            if not self._may_act():
+                continue
             self._pass("gc", self.gc.reconcile)
 
     def _metrics_loop(self) -> None:
+        # never leader-gated: followers keep serving metrics and SLO gauges
         while not self._stop.wait(timeout=5.0):
             self._pass("pod-metrics", self.pod_metrics.scrape)
             self._pass("provisioner-metrics", self.provisioner_metrics.scrape)
@@ -394,8 +511,16 @@ class Runtime:
             if self.options.enable_slo:
                 self._pass("slo-metrics", self.slo_metrics.scrape)
 
+    def _coherence_loop(self) -> None:
+        from .kube.coherence import COHERENCE
+
+        while not self._stop.wait(timeout=self.options.coherence_interval):
+            self._pass("coherence", COHERENCE.check)
+
     def _pricing_loop(self) -> None:
         while not self._stop.wait(timeout=self.options.pricing_refresh_period):
+            if not self._may_act():
+                continue
             self._pass("pricing", self.refresh_pricing_once)
 
     def _interruption_loop(self) -> None:
@@ -405,6 +530,10 @@ class Runtime:
         # (No _pass wrapper here: the long poll would drown the histogram in
         # idle waits; the controller spans/times each handled notice itself.)
         while not self._stop.is_set():
+            if not self._may_act():
+                if self._stop.wait(timeout=0.1):
+                    return
+                continue
             received = self.interruption.poll_once(wait_seconds=self.options.interruption_poll_interval)
             pause = self.options.interruption_poll_interval if received < 0 else 0.05
             if received <= 0 and self._stop.wait(timeout=pause):

@@ -11,6 +11,7 @@ from .primitives import (
     Burst,
     DiurnalRamp,
     DriftRollout,
+    LeaseSteal,
     PoolCapacity,
     Primitive,
     ProcessCrash,
@@ -19,6 +20,7 @@ from .primitives import (
     ScenarioContext,
     SpotReclaimWave,
     TransportChaos,
+    WatchGap,
 )
 from .replay import ReplayTrace
 from .schema import scenario_doc_errors
@@ -32,6 +34,7 @@ __all__ = [
     "Burst",
     "DiurnalRamp",
     "DriftRollout",
+    "LeaseSteal",
     "PoolCapacity",
     "Primitive",
     "ProcessCrash",
@@ -41,6 +44,7 @@ __all__ = [
     "ScenarioContext",
     "SpotReclaimWave",
     "TransportChaos",
+    "WatchGap",
     "scenario_doc_errors",
     "WorkloadStandIn",
     "workload_pod",

@@ -30,6 +30,8 @@ import time
 from typing import Dict, List, Optional
 
 from .. import flight, journal, slo
+from ..kube import chaos as kube_chaos
+from ..kube.coherence import COHERENCE
 from ..solver import faults as solver_faults
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
@@ -46,12 +48,14 @@ from .primitives import (
     Burst,
     DiurnalRamp,
     DriftRollout,
+    LeaseSteal,
     PoolCapacity,
     ProcessCrash,
     Scenario,
     ScenarioContext,
     SpotReclaimWave,
     TransportChaos,
+    WatchGap,
 )
 from .schema import scenario_doc_errors
 from .standin import WorkloadStandIn, live_pods
@@ -250,6 +254,41 @@ def hbm_degraded_settled(ctx: ScenarioContext) -> bool:
     return chunked >= 1 and breaker.opened_total == 0 and breaker.state == solver_faults.STATE_CLOSED
 
 
+def leader_flap_settled(ctx: ScenarioContext) -> bool:
+    """The leader-flap-storm convergence bar: both steals actually landed
+    and were recovered from (each steal bumps lease_transitions once, each
+    rightful re-acquisition bumps again -> >= 4), the runtime's elector
+    holds the lease AND its gate is open again, no client token ever
+    EXECUTED two launches (the two-leader witness), and the drift rollout
+    the flaps interrupted still finished under its budget."""
+    elector = getattr(ctx.runtime, "elector", None)
+    if elector is None or not elector.is_leader():
+        return False
+    lease = ctx.kube.get("Lease", elector.name, elector.namespace)
+    if lease is None or lease.spec.holder_identity != elector.identity:
+        return False
+    if (lease.spec.lease_transitions or 0) < 4:
+        return False
+    if ctx.backend.double_launches():
+        return False
+    return drift_settled(ctx)
+
+
+def watch_gap_settled(ctx: ScenarioContext) -> bool:
+    """The watch-gap-storm convergence bar: the planned conflict storm
+    actually fired, and the chaos history shows both gap windows opened and
+    CLOSED with at least one forced compaction — so converging with zero
+    informer divergences proves the replay/relist repair ran, not a run
+    where the weather never arrived."""
+    plan = kube_chaos.KUBE_CHAOS.plan
+    if plan is None or plan.fired() < 1:
+        return False
+    history = plan.history()
+    gap_ends = sum(1 for h in history if h.get("action") == "watch-gap-end")
+    compactions = sum(1 for h in history if h.get("action") == "compact")
+    return gap_ends >= 2 and compactions >= 1
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -277,6 +316,8 @@ def _converged(ctx: ScenarioContext, scenario: Scenario) -> bool:
         return False
     if ctx.backend.notifications.depth() != 0:
         return False
+    if COHERENCE.compare_registered():
+        return False  # the informer caches have not caught the store yet
     return scenario.settled is None or scenario.settled(ctx)
 
 
@@ -318,6 +359,7 @@ class CampaignRunner:
             solver_faults.FAULTS.install(
                 solver_faults.FaultPlan.from_specs(scenario.fault_specs, seed=scenario.fault_seed)
             )
+        kube_conflicts_at_start = kube_chaos.conflicts_total()
         kube = KubeCluster()
         backend = CloudBackend(clock=kube.clock)
         backend.notifications.visibility_timeout = 1.0
@@ -344,7 +386,19 @@ class CampaignRunner:
                 kube=kube,
                 cloud_provider=provider,
                 options=Options(
-                    leader_elect=False,
+                    # the leader-flap scenarios elect for real (and the
+                    # LeaseSteal primitive deposes); everything else skips
+                    # election as before. Lease timing is scenario-scale so
+                    # a stolen lease expires — and the rightful leader
+                    # re-acquires + recovers — inside the run window
+                    leader_elect=scenario.leader_elect,
+                    lease_duration=1.5,
+                    lease_renew_period=0.25,
+                    # the informer-coherence witness runs live against every
+                    # scenario's state cache; the runner also requires a
+                    # clean compare for convergence and scores the teardown
+                    # final_check as `informer_divergences`
+                    coherence_interval=0.5,
                     # the device-chaos scenarios run the dense device path
                     # (min_batch=1: every provisioning batch dispatches, so
                     # the fault-injection seam sits under real traffic); all
@@ -398,6 +452,16 @@ class CampaignRunner:
         recompiles_at_start = flight.FLIGHT.compilations_total()
         start = time.monotonic()
         try:
+            # control-plane fault domain (kube/chaos.py): the seeded
+            # KubeFaultPlan arms INSIDE the try — the setup writes above
+            # (provisioner create, runtime assembly) run clean, and a fault
+            # that kills the run can never leak an armed plan into the next
+            # scenario (the finally always disarms). Both transports inject
+            # the identical fault sequence; every run scores its own delta
+            if scenario.kube_fault_specs:
+                kube_chaos.KUBE_CHAOS.install(
+                    kube_chaos.KubeFaultPlan.from_specs(scenario.kube_fault_specs, seed=scenario.kube_fault_seed)
+                )
             runtime.start()
             stand_in.start()
             reclaim_thread.start()
@@ -439,6 +503,11 @@ class CampaignRunner:
                 raise AssertionError(
                     f"[{scenario.name}/{transport}] waterfall conservation violated: {conservation[:5]}"
                 )
+            # the teardown coherence check, the zero-lock-cycles analog:
+            # after the run quiesces every informer cache must deep-match
+            # the store; divergences still standing after the settle window
+            # are scored (and pinned at zero by the chaos suites)
+            divergences = COHERENCE.final_check(timeout=5.0)
             pods = live_pods(kube)
             run = {
                 "transport": transport,
@@ -467,6 +536,10 @@ class CampaignRunner:
                     "degraded_solves_total": int(solver_faults.degraded_total() - degraded_at_start),
                     "solver_faults_injected": int(solver_faults.FAULTS.fired()),
                     "breaker_state": solver_faults.BREAKER.state,
+                    "kube_conflicts_total": int(kube_chaos.conflicts_total() - kube_conflicts_at_start),
+                    "kube_faults_injected": int(kube_chaos.KUBE_CHAOS.fired()),
+                    "informer_divergences": len(divergences),
+                    "double_launches": int(ctx.backend.double_launches()),
                 },
                 "samples": samples,
             }
@@ -496,6 +569,8 @@ class CampaignRunner:
             journal.JOURNAL.set_spool(None)  # close (and keep) the capture
             journal.JOURNAL.disable()
             solver_faults.FAULTS.clear()  # never leak a fault plan past its run
+            kube.chaos_watch_gap_end()  # a gap leaked past its run wedges nothing
+            kube_chaos.KUBE_CHAOS.clear()
 
     @staticmethod
     def _run_primitive(ctx: ScenarioContext, primitive) -> None:
@@ -745,6 +820,57 @@ def default_campaign() -> List[Scenario]:
                 "HBM RESOURCE_EXHAUSTED faults plus a pre-solve HBM budget drive the chunked-solve "
                 "rung: the pod batch splits and re-dispatches on a smaller device surface, nothing "
                 "is lost, and the breaker never opens — memory pressure degrades, it does not outage"
+            ),
+        ),
+        Scenario(
+            name="leader_flap_storm",
+            desired=12,
+            duration=11.0,
+            budget_nodes="40%",
+            instance_types=["general-4x8"],
+            leader_elect=True,
+            # two injected renew failures on top of the steals: a transport
+            # blip mid-run must flap (pause -> re-renew -> recover) without
+            # waiting out the lease, and the steals land in the same plan
+            # history as the seeded triggers (the determinism witness)
+            kube_fault_specs=[{"fault": "lease-lost", "verb": "lease-renew", "nth": 10, "count": 2}],
+            settled=leader_flap_settled,
+            primitives=[
+                Burst(offset=0.3, count=8),
+                DriftRollout(offset=2.0),
+                LeaseSteal(offset=3.2),  # mid-rollout: replacements in flight
+                LeaseSteal(offset=6.5),  # again, after the first recovery
+            ],
+            description=(
+                "the lease is stolen twice mid-drift-rollout: the deposed leader's loops pause "
+                "before the thief's (never-renewed) lease expires, the rightful leader re-acquires "
+                "and runs recovery BEFORE acting, the rollout finishes under its 40% budget, and "
+                "the client-token ledger proves no logical launch ever executed twice"
+            ),
+        ),
+        Scenario(
+            name="watch_gap_storm",
+            desired=0,
+            duration=10.0,
+            instance_types=["general-4x8"],
+            # a seeded conflict storm on node registration: the 2nd and 3rd
+            # node creates 409 — the provisioner absorbs them (counted, not
+            # swallowed), the instance briefly orphans, and the GC sweep
+            # reconciles it while the watch chaos below runs
+            kube_fault_specs=[{"fault": "conflict", "verb": "create", "obj_kind": "Node", "nth": 2, "count": 2}],
+            settled=watch_gap_settled,
+            primitives=[
+                Burst(offset=0.3, count=12),
+                WatchGap(offset=1.0, duration=0.8, compact=True),  # 410 Gone: relist diff
+                Burst(offset=1.2, count=6),  # lands INSIDE the compacted gap
+                WatchGap(offset=3.5, duration=0.6),  # plain drop: replay from the buffer
+                Burst(offset=4.6, count=6),
+            ],
+            description=(
+                "bursts under control-plane weather: watch streams killed mid-burst (reconnect-"
+                "from-RV replay), a forced journal compaction (410 Gone -> relist diff, deletes "
+                "included), and a seeded 409 storm on node registration — the informer-coherence "
+                "witness must find ZERO divergences at teardown and nothing may be lost or leaked"
             ),
         ),
         Scenario(

@@ -253,6 +253,56 @@ class TransportChaos(Primitive):
 
 
 @dataclass
+class WatchGap(Primitive):
+    """Kill the informers' watch delivery for `duration` seconds — the
+    control-plane fault domain's connection-drop shape. On the in-memory
+    transport the gap buffers dispatch (a killed stream's events wait in
+    the server journal); with `compact=True` a forced journal compaction
+    fires mid-gap, so closing the gap delivers a relist diff instead of a
+    replay — the 410-Gone path. The gap ALWAYS closes, even when the
+    scenario is stopped mid-gap (a gap leaking past its run would wedge
+    every later scenario on the shared store)."""
+
+    duration: float = 0.8
+    compact: bool = False
+
+    def run(self, ctx: ScenarioContext) -> None:
+        log.info("watch gap: %.1fs%s", self.duration, " + forced compaction" if self.compact else "")
+        ctx.kube.chaos_watch_gap_begin()
+        try:
+            if self.compact:
+                if not ctx.sleep(self.duration / 2):
+                    ctx.kube.chaos_compact()
+                    ctx.sleep(self.duration / 2)
+            else:
+                ctx.sleep(self.duration)
+        finally:
+            ctx.kube.chaos_watch_gap_end()
+
+
+@dataclass
+class LeaseSteal(Primitive):
+    """Steal the leader-election lease out from under the live control
+    plane: a legal competing CAS overwrites the holder, the deposed leader
+    must pause its singleton loops on its next renew round, and — since the
+    thief never renews — a real candidate re-acquires after the lease
+    duration and runs recovery before acting. The leader-flap storm fires
+    this twice mid-drift-rollout."""
+
+    thief: str = "chaos-thief"
+
+    def run(self, ctx: ScenarioContext) -> None:
+        from ..kube.leaderelection import steal_lease
+
+        elector = getattr(ctx.runtime, "elector", None)
+        if elector is None:
+            log.warning("lease steal: runtime has no elector")
+            return
+        stolen = steal_lease(ctx.kube, identity=self.thief, name=elector.name, namespace=elector.namespace)
+        log.info("lease steal by %s: %s", self.thief, "landed" if stolen else "no lease to steal")
+
+
+@dataclass
 class ProcessCrash(Primitive):
     """Kill -9 the control plane `times` times, `interval` seconds apart,
     starting at `offset` — timed by the composer to land mid-provision or
@@ -315,6 +365,15 @@ class Scenario:
     solver_breaker_threshold: int = 3
     solver_breaker_backoff: float = 1.5
     solver_hbm_budget_bytes: int = 0
+    # control-plane fault-domain seams (kube/chaos.py): kube_fault_specs is
+    # a list of KubeFaultSpec dicts installed as a seeded KubeFaultPlan for
+    # the whole run (conflict storms, stale reads, watch drops — injected
+    # deterministically on the kube verb boundaries); leader_elect runs the
+    # scenario's Runtime behind real Lease election (with the campaign's
+    # short lease timing) so LeaseSteal primitives have a leader to depose
+    kube_fault_specs: Optional[List[dict]] = None
+    kube_fault_seed: int = 0
+    leader_elect: bool = False
     description: str = ""
 
     def config(self) -> dict:
@@ -336,5 +395,8 @@ class Scenario:
             "solver_breaker_threshold": self.solver_breaker_threshold,
             "solver_breaker_backoff": self.solver_breaker_backoff,
             "solver_hbm_budget_bytes": self.solver_hbm_budget_bytes,
+            "kube_fault_specs": self.kube_fault_specs,
+            "kube_fault_seed": self.kube_fault_seed,
+            "leader_elect": self.leader_elect,
             "primitives": [p.config() for p in self.primitives],
         }

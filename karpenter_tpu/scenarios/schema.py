@@ -57,6 +57,17 @@ SCORE_KEYS = (
     "degraded_solves_total",
     "solver_faults_injected",
     "breaker_state",
+    # control-plane fault-domain scores (kube/chaos.py + kube/coherence.py):
+    # optimistic-concurrency conflicts clients observed during the run
+    # (injected storms and organic races), faults the run's KubeFaultPlan
+    # actually injected, informer-cache divergences still standing at the
+    # teardown coherence check (ZERO is the acceptance bar — the lock-cycle
+    # analog for cache coherence), and client-token launches that executed
+    # twice (the two-leader / replay-miss witness; also pinned at zero)
+    "kube_conflicts_total",
+    "kube_faults_injected",
+    "informer_divergences",
+    "double_launches",
 )
 
 BREAKER_STATES = ("closed", "half-open", "open")
@@ -100,6 +111,7 @@ def run_errors(run, where: str = "run") -> List[str]:
         for field in (
             "lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures",
             "recompiles_total", "solver_faults_total", "degraded_solves_total", "solver_faults_injected",
+            "kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches",
         ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):

@@ -59,6 +59,17 @@ class Options:
     journal_spool: str = ""
     journal_spool_max_bytes: int = 16 * 2**20
     leader_elect: bool = True
+    # lease-election timing (kube/leaderelection.py): how long a lost holder
+    # blocks successors, and how often a candidate tries to acquire/renew —
+    # the controller-runtime 15s/2s defaults; chaos harnesses shrink both so
+    # a stolen lease flaps inside the scenario window
+    lease_duration: float = 15.0
+    lease_renew_period: float = 2.0
+    # informer-coherence witness (kube/coherence.py): period of the
+    # deep-compare of every registered informer cache against the
+    # authoritative store. <= 0 (the default) disables the loop — the cache
+    # is still registered, so harnesses can run final_check() at teardown
+    coherence_interval: float = 0.0
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     dense_solver_enabled: bool = True
@@ -130,6 +141,10 @@ class Options:
             errs.append("batch durations must satisfy 0 < idle <= max")
         if self.pricing_refresh_period <= 0:
             errs.append("pricing refresh period must be positive")
+        if self.lease_duration <= 0 or self.lease_renew_period <= 0:
+            errs.append("lease duration and renew period must be positive")
+        if self.lease_renew_period >= self.lease_duration:
+            errs.append("lease renew period must be shorter than the lease duration")
         if self.interruption_poll_interval <= 0:
             errs.append("interruption poll interval must be positive")
         if self.gc_registration_grace < 0:
@@ -188,6 +203,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--journal-spool", default=_env("JOURNAL_SPOOL", defaults.journal_spool))
     parser.add_argument("--journal-spool-max-bytes", type=int, default=_env("JOURNAL_SPOOL_MAX_BYTES", defaults.journal_spool_max_bytes))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
+    parser.add_argument("--lease-duration", type=float, default=_env("LEASE_DURATION", defaults.lease_duration))
+    parser.add_argument("--lease-renew-period", type=float, default=_env("LEASE_RENEW_PERIOD", defaults.lease_renew_period))
+    parser.add_argument("--coherence-interval", type=float, default=_env("COHERENCE_INTERVAL", defaults.coherence_interval))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
     parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))
     parser.add_argument("--disable-dense-solver", dest="dense_solver_enabled", action="store_false", default=_env("DENSE_SOLVER_ENABLED", defaults.dense_solver_enabled))

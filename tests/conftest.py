@@ -31,6 +31,25 @@ def pytest_configure(config):
 
 
 @pytest.fixture
+def coherence_witness():
+    """Shared chaos-suite fixture (the informer analog of the lock witness,
+    wired the same way — each storm/campaign module opts in with a one-line
+    autouse wrapper): at teardown, every informer cache still registered
+    with the coherence witness must deep-match its authoritative store
+    (final_check drains in-flight watch delivery first), and no CONFIRMED
+    divergence may have been recorded during the test — so every chaos
+    scenario doubles as an informer-coherence hunt."""
+    from karpenter_tpu.kube.coherence import COHERENCE, divergences_total
+
+    before = divergences_total()
+    yield COHERENCE
+    standing = COHERENCE.final_check(timeout=3.0)
+    assert standing == [], f"informer caches diverged from the store at teardown: {standing}"
+    recorded = divergences_total() - before
+    assert recorded == 0, f"{recorded} confirmed informer divergence(s) recorded during the test"
+
+
+@pytest.fixture
 def lock_order_witness():
     """Shared chaos-suite fixture (each storm/campaign module opts in with a
     one-line autouse wrapper): enable the lock-order witness so every lock

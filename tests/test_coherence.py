@@ -1,0 +1,195 @@
+"""Informer-coherence witness (kube/coherence.py): deep-compare of the state
+cache against the authoritative store, the confirm discipline that separates
+real divergence from in-flight watch delivery, and the /debug/coherence
+surface.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.state.cluster import Cluster
+from karpenter_tpu.kube import coherence as co
+from karpenter_tpu.kube.cluster import KubeCluster
+
+
+@pytest.fixture(autouse=True)
+def _isolated_witness(monkeypatch):
+    """Each test runs against a fresh witness instance (the process-wide
+    COHERENCE may carry registrations from other suites' Runtimes)."""
+    witness = co.CoherenceWitness()
+    monkeypatch.setattr(co, "COHERENCE", witness)
+    yield witness
+
+
+def _node(name, cpu=8.0):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(capacity={"cpu": cpu}, allocatable={"cpu": cpu}),
+    )
+
+
+def _bound_pod(name, node):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace="default"))
+    pod.spec.node_name = node
+    return pod
+
+
+def _cluster():
+    kube = KubeCluster()
+    return kube, Cluster(kube, FakeCloudProvider(instance_types(2)))
+
+
+class TestCompare:
+    def test_clean_cache_matches_store(self):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        kube.create(_bound_pod("p-1", "n-1"))
+        assert co.compare("c", cluster) == []
+
+    def test_ghost_missing_and_stale_nodes_reported(self):
+        kube, cluster = _cluster()
+        node = kube.create(_node("n-1"))
+        # ghost: poison the cache with a node the store never had
+        with cluster._lock:
+            cluster._update_node(_node("phantom"))
+        # stale: give the cache its OWN copy (the in-memory transport shares
+        # references, so the store and cache cannot otherwise disagree),
+        # then move the store's version without dispatching a watch event
+        import copy
+
+        with cluster._lock:
+            cluster._nodes["n-1"].node = copy.deepcopy(node)
+        kube._objects["Node"][("", "n-1")].metadata.resource_version += 7  # bypass dispatch
+        found = {(d["what"], d["entity"]) for d in co.compare("c", cluster)}
+        assert ("ghost", "phantom") in found
+        assert ("stale", "n-1") in found
+
+    def test_missing_binding_reported(self):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        kube.create(_bound_pod("p-1", "n-1"))
+        with cluster._lock:
+            cluster._bindings.pop("default/p-1")
+        found = {(d["kind"], d["what"], d["entity"]) for d in co.compare("c", cluster)}
+        assert ("Pod", "missing", "default/p-1") in found
+
+
+class TestWitness:
+    def test_check_counts_confirmed_divergence(self, _isolated_witness):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        with cluster._lock:
+            cluster._update_node(_node("phantom"))
+        _isolated_witness.register("c", cluster)
+        before = co.divergences_total()
+        confirmed = _isolated_witness.check(confirm_delay=0.01)
+        assert any(d["entity"] == "phantom" for d in confirmed)
+        assert co.divergences_total() > before
+
+    def test_check_skips_when_store_moves(self, _isolated_witness):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        with cluster._lock:
+            cluster._update_node(_node("phantom"))
+        _isolated_witness.register("c", cluster)
+
+        moving = cluster.clock
+
+        class MovingClock(type(moving)):
+            def __init__(self, kube):
+                self.kube = kube
+
+            def now(self):
+                return 0.0
+
+            def sleep(self, seconds):
+                # the store moves during the confirm window: the round must
+                # be skipped, not counted
+                self.kube.create(_node(f"mover-{self.kube.version()}"))
+
+        cluster.clock = MovingClock(kube)
+        before = co.divergences_total()
+        assert _isolated_witness.check(confirm_delay=0.01) == []
+        assert co.divergences_total() == before
+        assert co.CHECKS.value(result="skipped") >= 1
+
+    def test_open_watch_gap_skips_the_round(self, _isolated_witness):
+        """A cache lagging a GAPPED store is injected, expected incoherence:
+        the witness must skip (not count) while the gap is open, and find
+        the repaired cache clean once the relist closes it."""
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        _isolated_witness.register("c", cluster)
+        kube.chaos_watch_gap_begin()
+        kube.create(_node("n-2"))  # invisible to the cache: a real lag
+        before = co.divergences_total()
+        assert _isolated_witness.check(confirm_delay=0.01) == []
+        assert co.divergences_total() == before
+        assert co.CHECKS.value(result="skipped") >= 1
+        kube.chaos_compact()
+        kube.chaos_watch_gap_end()
+        assert _isolated_witness.final_check(timeout=1.0) == []
+
+    def test_final_check_waits_for_catchup(self, _isolated_witness):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        _isolated_witness.register("c", cluster)
+        assert _isolated_witness.final_check(timeout=0.5) == []
+
+    def test_final_check_records_standing_divergence(self, _isolated_witness):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        with cluster._lock:
+            cluster._update_node(_node("phantom"))
+        _isolated_witness.register("c", cluster)
+        before = co.divergences_total()
+        standing = _isolated_witness.final_check(timeout=0.3, poll=0.05)
+        assert any(d["entity"] == "phantom" for d in standing)
+        assert co.divergences_total() > before
+
+    def test_deregister_removes_cache(self, _isolated_witness):
+        kube, cluster = _cluster()
+        with cluster._lock:
+            cluster._update_node(_node("phantom"))
+        _isolated_witness.register("c", cluster)
+        _isolated_witness.deregister("c")
+        assert _isolated_witness.check(confirm_delay=0.01) == []
+
+    def test_snapshot_and_route(self, _isolated_witness):
+        kube, cluster = _cluster()
+        kube.create(_node("n-1"))
+        _isolated_witness.register("c", cluster)
+        _isolated_witness.check(confirm_delay=0.01)
+        snap = _isolated_witness.snapshot()
+        assert snap["caches"] == ["c"]
+        assert "divergences_total" in snap and "checks" in snap
+        status, content_type, body = co.routes()["/debug/coherence"]({})
+        assert status == 200 and "json" in content_type
+        json.loads(body)
+
+
+class TestRuntimeIntegration:
+    def test_runtime_registers_and_deregisters(self):
+        from karpenter_tpu.kube.coherence import COHERENCE
+        from karpenter_tpu.runtime import LeaderElector, Runtime
+        from karpenter_tpu.utils.options import Options
+
+        kube = KubeCluster()
+        rt = Runtime(
+            kube=kube,
+            cloud_provider=FakeCloudProvider(instance_types(2)),
+            options=Options(leader_elect=False, dense_solver_enabled=False),
+        )
+        try:
+            assert rt._coherence_name in COHERENCE.registered()
+            assert COHERENCE.final_check(timeout=1.0) == []
+        finally:
+            rt.stop()
+            LeaderElector._leader = None
+        assert rt._coherence_name not in COHERENCE.registered()

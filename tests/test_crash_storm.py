@@ -41,6 +41,12 @@ def _lock_order_witness(lock_order_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _coherence_witness(coherence_witness):
+    """Informer-coherence hunt: zero confirmed divergences at teardown (tests/conftest.py)."""
+    yield
+
+
 def _crash_storm():
     (scenario,) = [s for s in default_campaign() if s.name == "crash_storm"]
     return scenario

@@ -43,6 +43,12 @@ def _lock_order_witness(lock_order_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _coherence_witness(coherence_witness):
+    """Informer-coherence hunt: zero confirmed divergences at teardown (tests/conftest.py)."""
+    yield
+
+
 POD_CPU = 0.8
 # the drifted nodes run pods too big for any one-cpu node's slack, so their
 # re-simulation MUST open fresh capacity — the launch-before-drain chain is

@@ -42,6 +42,12 @@ def _lock_order_witness(lock_order_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _coherence_witness(coherence_witness):
+    """Informer-coherence hunt: zero confirmed divergences at teardown (tests/conftest.py)."""
+    yield
+
+
 POD_CPU = 0.5
 DESIRED_PODS = 24
 STORM_MESSAGES = 50

@@ -81,6 +81,28 @@ class TestRecording:
         with pytest.raises(ValueError, match="unknown node transition"):
             j.node_event("n-1", "queued")
 
+    def test_kube_events_are_a_stream_and_schema_valid(self, journal):
+        """The control-plane vocabulary (kind="kube"): conflict storms, watch
+        gaps, relists, and lease transitions journal like solver events — a
+        repeating stream, never deduped — and the emitted lines validate
+        against journal_schema so replay traces carry control-plane
+        weather."""
+        from karpenter_tpu.journal_schema import event_errors
+
+        j, clock = journal
+        with pytest.raises(ValueError, match="unknown kube transition"):
+            j.kube_event("update/Node", "created")  # a pod event, not kube
+        first = j.kube_event("update/Node", "conflict-storm", verb="update")
+        clock.step(0.5)
+        second = j.kube_event("update/Node", "conflict-storm", verb="update")
+        assert first is not None and second is not None, "the storm repeats: no dedupe"
+        for event in ("watch-gap", "relist"):
+            assert j.kube_event("kube-store", event) is not None
+        for event in ("lease-lost", "lease-acquired"):
+            assert j.kube_event("elector-1", event, lease="karpenter-leader-election") is not None
+        for record in j.events(limit=10):
+            assert event_errors(record.copy()) == [], record
+
     def test_first_occurrence_wins_per_entity(self, journal):
         """Watch redeliveries and ICE retry rounds must not skew the
         waterfall: the FIRST instance of each (entity, event) sticks."""

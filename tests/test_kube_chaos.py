@@ -1,0 +1,504 @@
+"""Control-plane fault domain (kube/chaos.py): the injection seam on BOTH
+kube transports, its determinism witness, and the lease steal/flap actions.
+
+Mirrors tests/test_solver_faults.py for the third leg of the fault-domain
+trilogy: seeded plans inject exactly the fault class they claim to test, the
+same seed + plan + verb sequence produce the identical history byte for
+byte, watch gaps heal through replay or relist, and a stolen lease deposes
+the holder before a successor acts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api.objects import Lease, LeaseSpec, Node, NodeSpec, NodeStatus, ObjectMeta, Pod
+from karpenter_tpu.kube import chaos as kc
+from karpenter_tpu.kube.cluster import Conflict, KubeCluster
+from karpenter_tpu.kube.leaderelection import LeaseElector, steal_lease
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clear_plan():
+    yield
+    kc.KUBE_CHAOS.clear()
+
+
+def _node(name="n-1", labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=labels or {}),
+        spec=NodeSpec(),
+        status=NodeStatus(capacity={"cpu": 8.0}, allocatable={"cpu": 8.0}),
+    )
+
+
+def _pod(name, node=""):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace="default"))
+    pod.spec.node_name = node
+    return pod
+
+
+class TestPlanDeterminism:
+    SPECS = [
+        {"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 2, "count": 2},
+        {"fault": "conflict", "verb": "create", "probability": 0.3},
+        {"fault": "stale-read", "verb": "get", "obj_kind": "Pod", "probability": 0.5},
+    ]
+    SEQUENCE = [
+        ("create", "Node"), ("update", "Node"), ("get", "Pod"), ("update", "Node"),
+        ("create", "Pod"), ("get", "Pod"), ("update", "Node"), ("get", "Node"),
+        ("create", "Node"), ("update", "Node"), ("get", "Pod"), ("delete", "Pod"),
+    ]
+
+    def _drive(self, seed):
+        plan = kc.KubeFaultPlan.from_specs(self.SPECS, seed=seed)
+        fired = [plan.check(verb, kind) for verb, kind in self.SEQUENCE]
+        return fired, plan.history()
+
+    def test_same_seed_same_history(self):
+        fired_a, history_a = self._drive(seed=7)
+        fired_b, history_b = self._drive(seed=7)
+        assert fired_a == fired_b
+        assert history_a == history_b
+        assert any(f is not None for f in fired_a), "the fixture sequence must fire something"
+
+    def test_different_seed_different_draws(self):
+        _, history_a = self._drive(seed=7)
+        _, history_b = self._drive(seed=8)
+        # the nth-based spec fires identically; the probability draws differ
+        assert history_a != history_b
+
+    def test_nth_spec_fires_exact_window(self):
+        plan = kc.KubeFaultPlan.from_specs(
+            [{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 2, "count": 2}]
+        )
+        fired = [plan.check("update", "Node") for _ in range(5)]
+        assert fired == [None, "conflict", "conflict", None, None]
+
+    def test_verb_and_kind_scoping(self):
+        plan = kc.KubeFaultPlan.from_specs(
+            [{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 1}]
+        )
+        assert plan.check("update", "Pod") is None  # kind mismatch
+        assert plan.check("create", "Node") is None  # verb mismatch
+        assert plan.check("update", "Node") == "conflict"
+
+    def test_illegal_fault_verb_pairs_rejected(self):
+        with pytest.raises(ValueError):
+            kc.KubeFaultSpec(fault="compact", verb="update")
+        with pytest.raises(ValueError):
+            kc.KubeFaultSpec(fault="stale-read", verb="create")
+        with pytest.raises(ValueError):
+            kc.KubeFaultSpec(fault="no-such-fault")
+
+    def test_actions_recorded_into_history(self):
+        plan = kc.KubeFaultPlan.from_specs([])
+        kc.KUBE_CHAOS.install(plan)
+        kube = KubeCluster()
+        kube.chaos_watch_gap_begin()
+        kube.chaos_compact()
+        kube.chaos_watch_gap_end()
+        actions = [h["action"] for h in plan.history() if "action" in h]
+        assert actions == ["watch-gap-begin", "compact", "watch-gap-end"]
+
+    def test_unset_injector_is_noop(self):
+        kube = KubeCluster()
+        node = _node()
+        kube.create(node)
+        node.metadata.labels["x"] = "1"
+        kube.update(node)
+        assert kube.get("Node", "n-1", namespace="") is node
+        assert kc.KUBE_CHAOS.fired() == 0
+
+
+class TestInMemoryInjection:
+    def test_conflict_storm_on_create_counted_and_raised(self):
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "conflict", "verb": "create", "obj_kind": "Node", "nth": 1}])
+        )
+        before = kc.conflicts_total()
+        kube = KubeCluster()
+        with pytest.raises(Conflict):
+            kube.create(_node())
+        assert kc.conflicts_total() == before + 1
+        assert kc.KUBE_CHAOS.fired() == 1
+        kube.create(_node())  # the storm was one call wide
+
+    def test_stale_read_loses_the_cas(self):
+        kube = KubeCluster()
+        node = kube.create(_node())
+        node.metadata.labels["warm"] = "1"
+        kube.update(node)  # rv > 1, so the stale copy's rv stays conditional (0 means unconditional)
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "stale-read", "verb": "get", "obj_kind": "Node", "nth": 1}])
+        )
+        stale = kube.get("Node", "n-1", namespace="")
+        live = kube.get("Node", "n-1", namespace="")
+        assert stale is not live, "a stale read must be a copy, never the live object"
+        assert stale.metadata.resource_version < live.metadata.resource_version
+        with pytest.raises(Conflict):
+            kube.update_no_retry(stale)
+        kube.update_no_retry(live)  # the honest read still wins
+
+    def test_watch_gap_buffers_then_replays(self):
+        kube = KubeCluster()
+        seen = []
+        kube.watch("Node", lambda e: seen.append((e.type, e.obj.name)))
+        kube.chaos_watch_gap_begin()
+        kube.create(_node("gap-1"))
+        kube.create(_node("gap-2"))
+        assert seen == [], "an open gap must suppress delivery"
+        kube.chaos_watch_gap_end()
+        assert seen == [("ADDED", "gap-1"), ("ADDED", "gap-2")], "the close must replay in order"
+
+    def test_compacted_gap_relists_with_deletes(self):
+        kube = KubeCluster()
+        survivor = _node("survivor")
+        victim = _node("victim")
+        kube.create(survivor)
+        kube.create(victim)
+        seen = []
+        kube.watch("Node", lambda e: seen.append((e.type, e.obj.name)), replay=False)
+        kube.chaos_watch_gap_begin()
+        kube.create(_node("newborn"))
+        kube.delete(victim, grace=False)
+        kube.chaos_compact()  # the buffered events are gone for good
+        kube.chaos_watch_gap_end()
+        # the relist diff: every live object as MODIFIED, the vanished one
+        # as DELETED — a handler cache repairs without ghosts
+        assert ("DELETED", "victim") in seen
+        live = {name for etype, name in seen if etype == "MODIFIED"}
+        assert live == {"survivor", "newborn"}
+
+    def test_write_during_gap_replay_is_delivered_after_not_overtaken(self):
+        """Delivery order is the informer contract: a write landing while
+        the gap-close replay is still draining must be delivered AFTER the
+        stale replay, never overtaken by it — the gap stays open (buffering)
+        until the replay fully drains."""
+        kube = KubeCluster()
+        node = kube.create(_node("racer"))
+        seen = []
+
+        def handler(event):
+            seen.append((event.type, event.obj.name, int(event.obj.metadata.resource_version)))
+            if len(seen) == 1:
+                # a concurrent writer mid-replay: must buffer, not dispatch
+                # live underneath the remaining replay
+                fresh = kube.get("Node", "racer", namespace="")
+                fresh.metadata.labels["late"] = "1"
+                kube.update(fresh)
+
+        kube.watch("Node", handler, replay=False)
+        kube.chaos_watch_gap_begin()
+        node.metadata.labels["gapped"] = "1"
+        kube.update(node)
+        kube.chaos_watch_gap_end()
+        versions = [rv for _, _, rv in seen]
+        assert versions == sorted(versions), f"stale replay overtook a live write: {seen}"
+        assert len(seen) == 2 and seen[-1][2] == kube.version()
+
+    def test_state_cache_heals_through_compacted_gap(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.controllers.state.cluster import Cluster
+
+        kube = KubeCluster()
+        cluster = Cluster(kube, FakeCloudProvider(instance_types(2)))
+        doomed = _node("doomed")
+        kube.create(doomed)
+        kube.chaos_watch_gap_begin()
+        kube.create(_node("fresh"))
+        kube.delete(doomed, grace=False)
+        kube.chaos_compact()
+        kube.chaos_watch_gap_end()
+        from karpenter_tpu.kube.coherence import compare
+
+        assert compare("state.cluster", cluster) == [], "the relist diff must fully repair the cache"
+
+
+class TestHttpInjection:
+    @pytest.fixture()
+    def server(self):
+        from karpenter_tpu.kube.apiserver import APIServer
+
+        srv = APIServer().start()
+        yield srv
+        srv.stop()
+
+    def test_conflict_storm_absorbed_by_retry_on_conflict(self, server):
+        from karpenter_tpu.kube.client import HttpKubeClient
+
+        client = HttpKubeClient(server.url)
+        client.create(_node("storm"))
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 1, "count": 2}])
+        )
+        before = kc.conflicts_total()
+        node = client.get_node("storm")
+        node.metadata.labels["survived"] = "true"
+        client.update(node)  # two injected 409s, then the refresh lands
+        assert kc.conflicts_total() - before == 2
+        assert client.get_node("storm").metadata.labels["survived"] == "true"
+        client.stop()
+
+    def test_injected_conflicts_identical_across_transports(self, server):
+        """The dual-transport determinism pin: the same plan driven by the
+        same verb sequence fires the same history on the in-memory store
+        and through the HTTP apiserver."""
+        from karpenter_tpu.kube.client import HttpKubeClient
+
+        specs = [{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 2, "count": 1}]
+
+        def drive_inmemory():
+            kube = KubeCluster()
+            plan = kc.KubeFaultPlan.from_specs(specs, seed=3)
+            kc.KUBE_CHAOS.install(plan)
+            node = _node("det")
+            kube.create(node)
+            outcomes = []
+            for i in range(3):
+                node.metadata.labels["round"] = str(i)
+                try:
+                    kube.update(node)
+                    outcomes.append("ok")
+                except Conflict:
+                    outcomes.append("conflict")
+            kc.KUBE_CHAOS.clear()
+            return outcomes, plan.history()
+
+        def drive_http():
+            client = HttpKubeClient(server.url)
+            plan = kc.KubeFaultPlan.from_specs(specs, seed=3)
+            kc.KUBE_CHAOS.install(plan)
+            node = client.create(_node("det"))
+            outcomes = []
+            for i in range(3):
+                node.metadata.labels["round"] = str(i)
+                try:
+                    client.update_no_retry(node)
+                    outcomes.append("ok")
+                except Conflict:
+                    outcomes.append("conflict")
+                    node = client.get_node("det")
+            kc.KUBE_CHAOS.clear()
+            client.stop()
+            return outcomes, plan.history()
+
+        mem_outcomes, mem_history = drive_inmemory()
+        http_outcomes, http_history = drive_http()
+        assert mem_outcomes == http_outcomes == ["ok", "conflict", "ok"]
+        assert mem_history == http_history
+
+    def test_watch_kill_reconnects_from_rv_losing_nothing(self, server):
+        from karpenter_tpu.kube.client import HttpKubeClient
+
+        client = HttpKubeClient(server.url)
+        seen = []
+        lock = threading.Lock()
+
+        def handler(event):
+            with lock:
+                seen.append((event.type, event.obj.name))
+
+        client.watch("Node", handler)
+        client.create(_node("before-kill"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.02)
+        server.state.chaos_kill_watches()
+        client.create(_node("after-kill"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with lock:
+                if ("ADDED", "after-kill") in seen:
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert ("ADDED", "before-kill") in seen
+            assert ("ADDED", "after-kill") in seen, "reconnect-from-RV must deliver the post-kill event"
+        client.stop()
+
+    def test_forced_compaction_410_relists(self, server):
+        from karpenter_tpu.kube.client import HttpKubeClient
+
+        client = HttpKubeClient(server.url)
+        client.create(_node("pre-compact"))
+        seen = []
+        lock = threading.Lock()
+
+        def handler(event):
+            with lock:
+                seen.append((event.type, event.obj.name))
+
+        client.watch("Node", handler)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.02)
+        # blackout + churn + compact: the informer spins on the jittered
+        # reconnect backoff (503s) while writes land and the journal
+        # compacts; when the blackout lifts, its resourceVersion predates
+        # the journal, the stream answers 410, and the informer must relist
+        server.state.chaos_watch_gap_begin()
+        writer = HttpKubeClient(server.url)
+        for i in range(4):
+            writer.create(_node(f"churn-{i}"))
+        server.state.chaos_compact()
+        server.state.chaos_watch_gap_end()
+        writer.create(_node("post-compact"))
+        expect = {"pre-compact", "churn-0", "churn-1", "churn-2", "churn-3", "post-compact"}
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            with lock:
+                if {name for _, name in seen} >= expect:
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert {name for _, name in seen} >= expect, seen
+        writer.stop()
+        client.stop()
+
+    def test_stale_read_decrements_served_version(self, server):
+        from karpenter_tpu.kube.client import HttpKubeClient
+
+        client = HttpKubeClient(server.url)
+        client.create(_node("stale"))
+        live = client.get_node("stale")
+        live.metadata.labels["warm"] = "1"
+        client.update(live)  # rv > 1: the stale copy stays conditional (rv 0 would mean unconditional)
+        live = client.get_node("stale")
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "stale-read", "verb": "get", "obj_kind": "Node", "nth": 1}])
+        )
+        stale = client.get_node("stale")
+        assert stale.metadata.resource_version == live.metadata.resource_version - 1
+        with pytest.raises(Conflict):
+            client.update_no_retry(stale)
+        client.stop()
+
+
+class TestLeaseChaos:
+    def _kube_with_elector(self, identity="holder", clock=None):
+        kube = KubeCluster(clock=clock)
+        elector = LeaseElector(kube, identity=identity, lease_duration=1.5, renew_period=0.05, clock=clock)
+        return kube, elector
+
+    def test_injected_lease_lost_steps_down(self):
+        kube, elector = self._kube_with_elector()
+        lost = threading.Event()
+        elector.start(on_stopped_leading=lost.set)
+        assert elector.wait_for_leadership(timeout=5)
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "lease-lost", "verb": "lease-renew", "nth": 3, "count": 2}])
+        )
+        assert lost.wait(timeout=5), "an injected renew failure must step the holder down"
+        # the fault window is two rounds wide: the holder re-renews after
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not elector.is_leader():
+            time.sleep(0.02)
+        assert elector.is_leader(), "the holder must re-acquire once the fault window passes"
+        elector.stop()
+
+    def test_steal_deposes_holder_then_rightful_reacquire(self):
+        kube, elector = self._kube_with_elector()
+        transitions = {"lost": 0, "gained": 0}
+        lost = threading.Event()
+
+        def on_lost():
+            transitions["lost"] += 1
+            lost.set()
+
+        def on_gained():
+            transitions["gained"] += 1
+
+        elector.start(on_started_leading=on_gained, on_stopped_leading=on_lost)
+        assert elector.wait_for_leadership(timeout=5)
+        assert steal_lease(kube, identity="thief")
+        assert lost.wait(timeout=5), "the deposed holder must step down on its next renew round"
+        # the thief never renews: after lease_duration the rightful holder
+        # re-acquires (transition bump) and the gained callback re-fires
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not elector.is_leader():
+            time.sleep(0.05)
+        assert elector.is_leader()
+        assert transitions["gained"] >= 2 and transitions["lost"] >= 1
+        lease = kube.get("Lease", elector.name, elector.namespace)
+        assert lease.spec.holder_identity == "holder"
+        assert lease.spec.lease_transitions >= 2  # the steal + the re-acquisition
+        elector.stop()
+
+    def test_two_electors_never_colead_through_a_steal(self):
+        """The overlap pin: at no observable instant do both candidates
+        report leadership, even while the lease is stolen out from under
+        the holder and the second candidate races to take over."""
+        kube = KubeCluster()
+        a = LeaseElector(kube, identity="a", lease_duration=0.6, renew_period=0.03)
+        b = LeaseElector(kube, identity="b", lease_duration=0.6, renew_period=0.03)
+        overlap = []
+        stop = threading.Event()
+
+        def monitor():
+            while not stop.is_set():
+                if a.is_leader() and b.is_leader():
+                    overlap.append(time.monotonic())
+                time.sleep(0.002)
+
+        thread = threading.Thread(target=monitor, daemon=True)
+        thread.start()
+        a.start()
+        b.start()
+        assert a.wait_for_leadership(timeout=5) or b.wait_for_leadership(timeout=5)
+        for _ in range(3):
+            steal_lease(kube, identity="thief")
+            time.sleep(0.8)  # thief expiry + somebody re-acquires
+        stop.set()
+        thread.join(timeout=2)
+        a.stop()
+        b.stop()
+        assert overlap == [], f"double leadership observed at {overlap}"
+
+    def test_double_launch_witness_outlives_replay_cap_eviction(self):
+        """The exact blind spot the ledger exists to close: a token evicted
+        from the replay cap whose delayed retry then RE-EXECUTES must still
+        be seen twice (the execution ledger lives on a longer horizon), and
+        a double count that eventually leaves the execution ledger folds
+        into the running total — eviction never launders a double launch."""
+        from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend, FleetInstanceSpec, FleetRequest
+
+        backend = CloudBackend()
+        lt = backend.ensure_launch_template("lt-chaos", "img-1", ["sg-1"], "")
+        spec = FleetInstanceSpec(
+            instance_type=backend.catalog[0].name, zone="zone-a", capacity_type="on-demand",
+            launch_template_id=lt.template_id, subnet_id="subnet-zone-a",
+        )
+
+        def launch(token):
+            return backend.create_fleet(FleetRequest(specs=[spec], capacity_type="on-demand", client_token=token))
+
+        launch("tok-lost")
+        with backend._lock:
+            backend._fleet_token_cap = 1
+        launch("tok-filler")  # evicts tok-lost from the REPLAY cap only
+        with backend._lock:
+            assert "tok-lost" not in backend.fleet_tokens
+            assert backend.token_launches.get("tok-lost") == 1, "the execution ledger must outlive the replay cap"
+        launch("tok-lost")  # the delayed retry: replay misses, a second launch EXECUTES
+        assert backend.double_launches() == 1, "the replay-cap miss is exactly what the witness must catch"
+        # and once the offender leaves the execution ledger, the overflow
+        # survives in the running total
+        with backend._lock:
+            evicted = backend.token_launches.pop("tok-lost")
+            backend._double_launches_evicted += evicted - 1
+        assert backend.double_launches() == 1
+
+    def test_release_on_stop_hands_over_immediately(self):
+        kube, elector = self._kube_with_elector()
+        elector.start()
+        assert elector.wait_for_leadership(timeout=5)
+        elector.stop(release=True)
+        successor = LeaseElector(kube, identity="successor", lease_duration=1.5, renew_period=0.05)
+        successor.start()
+        assert successor.wait_for_leadership(timeout=5), "a released lease must be acquirable at once"
+        successor.stop()

@@ -1,0 +1,235 @@
+"""Direct unit tests for the kube client's recovery paths (the satellite of
+the control-plane fault domain): reconnect-from-last-RV, relist-on-410,
+bounded RetryOnConflict with typed exhaustion, the full-jitter reconnect
+backoff, and the LeaseElector renewal-failure -> is_leader() false
+transition that previously had no dedicated failure-path tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.kube import chaos as kc
+from karpenter_tpu.kube.apiserver import APIServer
+from karpenter_tpu.kube.client import WATCH_BACKOFF_CAP, HttpKubeClient
+from karpenter_tpu.kube.cluster import Conflict, ConflictExhausted, KubeCluster
+from karpenter_tpu.kube.leaderelection import LeaseElector
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clear_plan():
+    yield
+    kc.KUBE_CHAOS.clear()
+
+
+def _node(name="n-1"):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodeSpec(),
+        status=NodeStatus(capacity={"cpu": 8.0}, allocatable={"cpu": 8.0}),
+    )
+
+
+class TestReconnectFromLastRV:
+    def test_stream_close_resumes_without_replaying_or_losing(self, server):
+        """A server-side stream close must reconnect from the LAST seen
+        resourceVersion: events before the close are not re-delivered,
+        events after it are not lost."""
+        client = HttpKubeClient(server.url)
+        events = []
+        lock = threading.Lock()
+        client.watch("Node", lambda e: (lock.acquire(), events.append((e.type, e.obj.name)), lock.release()))
+        client.create(_node("a"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not events:
+            time.sleep(0.02)
+        server.state.chaos_kill_watches()  # connection drop, journal intact
+        client.create(_node("b"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with lock:
+                if ("ADDED", "b") in events:
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert events.count(("ADDED", "a")) == 1, "reconnect-from-RV must not replay delivered events"
+            assert events.count(("ADDED", "b")) == 1, events
+
+
+class TestRelistOn410:
+    def test_compacted_journal_forces_full_relist(self, server):
+        """A reconnect whose resourceVersion predates the compacted journal
+        gets 410 Gone and must relist — including synthesizing DELETED for
+        objects that vanished inside the gap."""
+        client = HttpKubeClient(server.url)
+        doomed = client.create(_node("doomed"))
+        events = []
+        lock = threading.Lock()
+        client.watch("Node", lambda e: (lock.acquire(), events.append((e.type, e.obj.name)), lock.release()))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not events:
+            time.sleep(0.02)
+        server.state.chaos_watch_gap_begin()  # blackout: no reconnect lands
+        writer = HttpKubeClient(server.url)
+        writer.delete(doomed, grace=False)
+        writer.create(_node("fresh"))
+        server.state.chaos_compact()  # the gap's events leave the journal
+        server.state.chaos_watch_gap_end()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            with lock:
+                if ("DELETED", "doomed") in events and ("ADDED", "fresh") in events:
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert ("DELETED", "doomed") in events, "the relist diff must surface the missed delete"
+            assert ("ADDED", "fresh") in events, events
+        writer.stop()
+        client.stop()
+
+
+class TestRetryOnConflict:
+    def test_exhaustion_is_typed_and_counted(self, server):
+        client = HttpKubeClient(server.url)
+        client.create(_node("rmw"))
+        storm_width = HttpKubeClient.RETRY_ON_CONFLICT_ATTEMPTS
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs(
+                [{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 1, "count": storm_width}]
+            )
+        )
+        before = kc.conflicts_total()
+        node = client.get_node("rmw")
+        node.metadata.labels["x"] = "1"
+        with pytest.raises(ConflictExhausted):
+            client.update(node)
+        # every attempt's 409 was counted; the typed error is still a
+        # Conflict, so existing handlers keep working
+        assert kc.conflicts_total() - before == storm_width
+        assert issubclass(ConflictExhausted, Conflict)
+        client.stop()
+
+    def test_delete_conflict_typed_and_counted_on_http(self, server):
+        """An injected 409 at the delete verb must surface as the typed,
+        counted Conflict on the HTTP transport — same surface as every
+        other verb, never a raw transport error into a controller pass."""
+        client = HttpKubeClient(server.url)
+        node = client.create(_node("del"))
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs([{"fault": "conflict", "verb": "delete", "obj_kind": "Node", "nth": 1}])
+        )
+        before = kc.conflicts_total()
+        with pytest.raises(Conflict):
+            client.delete(node, grace=False)
+        assert kc.conflicts_total() == before + 1
+        kc.KUBE_CHAOS.clear()
+        client.delete(node, grace=False)  # the storm was one call wide
+        assert client.get_node("del") is None
+        client.stop()
+
+    def test_one_conflict_short_of_exhaustion_lands(self, server):
+        client = HttpKubeClient(server.url)
+        client.create(_node("rmw2"))
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs(
+                [{"fault": "conflict", "verb": "update", "obj_kind": "Node", "nth": 1,
+                  "count": HttpKubeClient.RETRY_ON_CONFLICT_ATTEMPTS - 1}]
+            )
+        )
+        node = client.get_node("rmw2")
+        node.metadata.labels["x"] = "1"
+        client.update(node)
+        assert client.get_node("rmw2").metadata.labels["x"] == "1"
+        client.stop()
+
+
+class TestWatchReconnectJitter:
+    def test_backoff_sleeps_are_jittered_and_bounded(self, server):
+        """During a watch blackout the reconnect sleeps must be full-jitter
+        draws (spread out, not a fixed tick) and never exceed the cap —
+        every informer hammering a restarted apiserver on the same 50 ms
+        beat is the thundering herd the backoff exists to prevent."""
+        sleeps = []
+
+        class RecordingClock:
+            def now(self):
+                return time.monotonic()
+
+            def sleep(self, seconds):
+                sleeps.append(seconds)
+                time.sleep(min(seconds, 0.02))  # compress the wait, keep the record
+
+        client = HttpKubeClient(server.url, clock=RecordingClock())
+        server.state.chaos_watch_gap_begin()
+        client.watch("Node", lambda e: None)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(sleeps) < 8:
+            time.sleep(0.02)
+        server.state.chaos_watch_gap_end()
+        client.stop()
+        assert len(sleeps) >= 8, "the blackout must have forced repeated reconnects"
+        assert all(0.0 <= s <= WATCH_BACKOFF_CAP for s in sleeps)
+        assert len(set(round(s, 6) for s in sleeps)) > 1, f"jitter must vary the sleeps: {sleeps[:8]}"
+
+
+class TestElectorRenewalFailure:
+    def test_renewal_failure_transitions_is_leader_false(self):
+        """The previously-untested failure path: a holder whose renew round
+        fails (transport outage shape — the kube verbs raise) must report
+        is_leader() False within a renew period, never free-run."""
+        kube = KubeCluster()
+        elector = LeaseElector(kube, identity="holder", lease_duration=2.0, renew_period=0.05)
+        elector.start()
+        assert elector.wait_for_leadership(timeout=5)
+
+        real_get = kube.get
+        outage = threading.Event()
+
+        def failing_get(kind, name, namespace="default"):
+            if outage.is_set() and kind == "Lease":
+                raise ConnectionError("apiserver unreachable")
+            return real_get(kind, name, namespace)
+
+        kube.get = failing_get
+        outage.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and elector.is_leader():
+            time.sleep(0.01)
+        assert not elector.is_leader(), "an unprovable lease must step the holder down"
+        # the outage ends: the holder re-renews (its lease never expired)
+        outage.clear()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not elector.is_leader():
+            time.sleep(0.01)
+        assert elector.is_leader()
+        elector.stop()
+
+    def test_cas_loss_transitions_is_leader_false(self):
+        """A lost CAS (another writer moved the lease's resourceVersion)
+        must also step the holder down — the optimistic-concurrency half of
+        the same failure path."""
+        kube = KubeCluster()
+        elector = LeaseElector(kube, identity="holder", lease_duration=5.0, renew_period=0.05)
+        elector.start()
+        assert elector.wait_for_leadership(timeout=5)
+        kc.KUBE_CHAOS.install(
+            kc.KubeFaultPlan.from_specs(
+                [{"fault": "conflict", "verb": "lease-renew", "nth": 5, "count": 3}]
+            )
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and elector.is_leader():
+            time.sleep(0.01)
+        assert not elector.is_leader()
+        elector.stop()

@@ -1,0 +1,130 @@
+"""Leader-flap safety at the Runtime level: a stolen lease pauses the old
+leader's singleton loops before the new leader's recovery acts, the
+provisioner holds its batch while deposed, re-election runs recovery before
+the gate re-opens, and the client-token ledger proves no logical launch ever
+executes twice across the flap.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend
+from karpenter_tpu.cloudprovider.simulated.provider import SimulatedCloudProvider
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.kube.leaderelection import steal_lease
+from karpenter_tpu.runtime import Runtime
+from karpenter_tpu.utils.options import Options
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_order_witness):
+    """Deadlock hunt: witness every lock, zero cycles at teardown (tests/conftest.py)."""
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _coherence_witness(coherence_witness):
+    """Informer-coherence hunt: zero confirmed divergences at teardown (tests/conftest.py)."""
+    yield
+
+
+@pytest.fixture()
+def stack():
+    kube = KubeCluster()
+    backend = CloudBackend(clock=kube.clock)
+    provider = SimulatedCloudProvider(backend=backend, kube=kube, clock=kube.clock)
+    runtime = Runtime(
+        kube=kube,
+        cloud_provider=provider,
+        options=Options(
+            leader_elect=True,
+            lease_duration=1.0,
+            lease_renew_period=0.05,
+            batch_max_duration=0.2,
+            batch_idle_duration=0.05,
+            dense_solver_enabled=False,
+            gc_interval=0.5,
+            gc_registration_grace=2.0,
+            coherence_interval=0.3,
+        ),
+    )
+    yield kube, backend, runtime
+    runtime.stop()
+
+
+def _wait(predicate, timeout=8.0, period=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(period)
+    return False
+
+
+class TestLeaderFlap:
+    def test_steal_pauses_gate_then_recovery_reopens(self, stack):
+        kube, backend, runtime = stack
+        runtime.start()
+        assert runtime._may_act()
+        assert steal_lease(kube, identity="thief")
+        # the deposed leader's gate must close within a renew period — its
+        # loops pause BEFORE the thief's lease could even expire, so no
+        # successor recovery can race a still-acting old leader
+        assert _wait(lambda: not runtime._may_act()), "the gate must close on the lost transition"
+        assert not runtime.elector.is_leader()
+        # the thief never renews: the rightful leader re-acquires after the
+        # lease duration and the gate re-opens only after recovery ran
+        assert _wait(lambda: runtime._may_act(), timeout=10.0), "re-election must re-open the gate"
+        assert runtime.elector.is_leader()
+        lease = kube.get("Lease", runtime.elector.name, runtime.elector.namespace)
+        assert lease.spec.holder_identity == runtime.elector.identity
+        assert lease.spec.lease_transitions >= 2  # the steal + the re-acquisition
+
+    def test_deposed_provisioner_holds_batch_until_reelected(self, stack):
+        from tests.helpers import make_pod, make_provisioner
+
+        kube, backend, runtime = stack
+        kube.create(make_provisioner("default"))
+        runtime.start()
+        assert steal_lease(kube, identity="thief")
+        assert _wait(lambda: not runtime._may_act())
+        instances_at_depose = len(backend.instances)
+        # pods arriving while deposed must NOT be launched for by the old
+        # leader — the batch is held until the gate re-opens
+        for i in range(3):
+            kube.create(make_pod(f"flap-pod-{i}", requests={"cpu": 0.5}))
+        time.sleep(0.5)
+        assert len(backend.instances) == instances_at_depose, "a deposed leader must not launch"
+        # re-election: the held batch goes through and capacity launches
+        # (binding is the kube-scheduler's job — no stand-in runs here)
+        assert _wait(lambda: runtime._may_act(), timeout=10.0)
+        assert _wait(lambda: len(backend.instances) > instances_at_depose, timeout=15.0), (
+            "the held batch must launch once re-elected"
+        )
+        # the client-token ledger: the flap (pause + re-election + retry)
+        # never executed one logical launch twice
+        assert backend.double_launches() == 0
+
+    def test_flap_counts_and_journals(self, stack):
+        from karpenter_tpu.journal import JOURNAL
+        from karpenter_tpu.kube.leaderelection import LEADER_FLAPS
+
+        kube, backend, runtime = stack
+        JOURNAL.enable()
+        JOURNAL.reset()
+        try:
+            runtime.start()
+            before = LEADER_FLAPS.value()
+            assert steal_lease(kube, identity="thief")
+            assert _wait(lambda: LEADER_FLAPS.value() == before + 1)
+            assert _wait(lambda: runtime.elector.is_leader(), timeout=10.0)
+            events = [(e["event"], e["entity"]) for e in JOURNAL.events(limit=50) if e["kind"] == "kube"]
+            assert ("lease-lost", runtime.elector.identity) in events
+            # re-acquisition journals a second lease-acquired for the same identity
+            assert [e for e in events if e[0] == "lease-acquired"], events
+        finally:
+            JOURNAL.disable()
+            JOURNAL.reset()

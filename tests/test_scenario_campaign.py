@@ -32,6 +32,12 @@ def _lock_order_witness(lock_order_witness):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _coherence_witness(coherence_witness):
+    """Informer-coherence hunt: zero confirmed divergences at teardown (tests/conftest.py)."""
+    yield
+
+
 class TestSchemaValidator:
     def _valid_doc(self):
         from karpenter_tpu.provenance import provenance_block
@@ -65,6 +71,10 @@ class TestSchemaValidator:
                         "degraded_solves_total": 0,
                         "solver_faults_injected": 0,
                         "breaker_state": "closed",
+                        "kube_conflicts_total": 0,
+                        "kube_faults_injected": 0,
+                        "informer_divergences": 0,
+                        "double_launches": 0,
                         "waterfall": {
                             "queue_wait": {"p50": 0.0, "p95": 0.01, "p99": 0.01, "count": 4},
                             "solve": {"p50": 0.02, "p95": 0.03, "p99": 0.03, "count": 4},
@@ -132,6 +142,16 @@ class TestSchemaValidator:
         doc = self._valid_doc()
         doc["runs"][0]["scores"]["breaker_state"] = "melted"
         assert any("breaker_state" in e for e in scenario_doc_errors(doc))
+
+    def test_kube_fault_scores_required_and_typed(self):
+        # the control-plane fault-domain keys are schema-gated on ALL runs
+        for key in ("kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches"):
+            doc = self._valid_doc()
+            del doc["runs"][0]["scores"][key]
+            assert any(key in e for e in scenario_doc_errors(doc)), key
+            doc = self._valid_doc()
+            doc["runs"][0]["scores"][key] = "lots"
+            assert any(key in e for e in scenario_doc_errors(doc)), key
 
     def test_waterfall_scores_gated(self):
         # the waterfall block is required, keyed by the segment vocabulary,
@@ -210,6 +230,16 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     assert scores["degraded_solves_total"] == 0
     assert scores["solver_faults_injected"] == 0
     assert scores["breaker_state"] == "closed"
+    # control-plane fault domain: a healthy run injects nothing, the
+    # informer caches deep-match the store at teardown (the coherence
+    # witness's zero-divergence bar), and the client-token ledger shows no
+    # launch ever executed twice. Organic create-conflicts are legal (the
+    # provisioner's idempotent node registration) but must be counted, so
+    # the key is asserted present + typed rather than zero
+    assert scores["kube_faults_injected"] == 0
+    assert scores["informer_divergences"] == 0
+    assert scores["double_launches"] == 0
+    assert isinstance(scores["kube_conflicts_total"], int) and scores["kube_conflicts_total"] >= 0
     # every scenario run provisions, so the solve-latency summary must have
     # observed real solves: non-null on EVERY run, not merely well-typed
     assert scores["solver_latency_p95_seconds"] is not None
@@ -298,3 +328,25 @@ def test_full_campaign_scores_all_scenarios_on_both_transports(tmp_path):
         assert scores["solver_faults_total"] >= scores["solver_faults_injected"], scores
         assert scores["degraded_solves_total"] >= 1, scores
         assert scores["breaker_state"] == "closed", scores
+    # every run of every scenario: the informer caches deep-matched the
+    # store at teardown and no client token ever executed two launches —
+    # the control-plane fault domain's standing invariants
+    for doc in docs:
+        for run in doc["runs"]:
+            where = f"{doc['scenario']}/{run['transport']}"
+            assert run["scores"]["informer_divergences"] == 0, where
+            assert run["scores"]["double_launches"] == 0, where
+    # leader flap storm: two steals landed and were recovered from
+    # (convergence already required transitions >= 4, leadership regained,
+    # and the drift rollout finished); the injected renew failures fired
+    for run in by_name["leader_flap_storm"]["runs"]:
+        scores = run["scores"]
+        assert scores["kube_faults_injected"] >= 1, scores
+        assert scores["restarts"] == 0, scores  # flaps, not crashes
+    # watch gap storm: the seeded 409 storm fired and was observed (counted,
+    # not swallowed) — convergence already required both gaps closed with a
+    # forced compaction and zero divergences
+    for run in by_name["watch_gap_storm"]["runs"]:
+        scores = run["scores"]
+        assert scores["kube_faults_injected"] >= 1, scores
+        assert scores["kube_conflicts_total"] >= scores["kube_faults_injected"], scores
