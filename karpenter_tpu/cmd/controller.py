@@ -140,6 +140,13 @@ def main(argv=None) -> int:
 
         extra_routes.update(coherence.routes())
         debug_descriptions.update(coherence.route_descriptions())
+    if options.invariants_interval > 0:
+        # invariant-monitor read surface: thread census, watch/ring/heap
+        # leak witnesses, confirmed violations on the metrics port
+        from .. import invariants
+
+        extra_routes.update(invariants.routes())
+        debug_descriptions.update(invariants.route_descriptions())
     extra_routes["/debug"] = debug_index_route(debug_descriptions)
     obs = ObservabilityServer(
         healthy=runtime.healthy,

@@ -125,6 +125,12 @@ class ProvisionerController:
         self._thread = threading.Thread(target=self._run, name="provisioner", daemon=True)
         self._thread.start()
 
+    @property
+    def thread(self) -> Optional[threading.Thread]:
+        """The batch loop's thread (None before start) — the Runtime
+        registers it with the invariants thread census."""
+        return self._thread
+
     def stop(self) -> None:
         self._stop.set()
         self.batcher.trigger_immediate()

@@ -67,6 +67,7 @@ KIND_POD = "pod"
 KIND_NODE = "node"
 KIND_SOLVER = "solver"
 KIND_KUBE = "kube"
+KIND_CHAOS = "chaos"
 
 # the transition vocabularies; journal_schema.py validates files against them
 POD_EVENTS = ("created", "queued", "batch-admitted", "solved", "nominated", "bound", "failed", "deleted")
@@ -81,6 +82,11 @@ SOLVER_EVENTS = ("fault", "degraded", "breaker-opened", "breaker-half-open", "br
 # also a stream (the same storm fires repeatedly), so replay traces capture
 # control-plane weather alongside pod/node/solver events
 KUBE_EVENTS = ("conflict-storm", "watch-gap", "relist", "lease-lost", "lease-acquired")
+# chaos-orchestrator events (scenarios/chaos_orchestrator.py + invariants.py):
+# the schedule arming, every delivered cross-domain event, and every
+# confirmed invariant violation — a stream like solver/kube, never deduped,
+# so a replayed journal carries the chaos weather next to the load it hit
+CHAOS_EVENTS = ("schedule-armed", "injected", "invariant-violation")
 
 # waterfall segments, in chain order: consecutive sub-intervals of
 # created->bound, so their sum IS the pending duration (conservation)
@@ -315,6 +321,8 @@ class Journal:
             vocab = SOLVER_EVENTS
         elif kind == KIND_KUBE:
             vocab = KUBE_EVENTS
+        elif kind == KIND_CHAOS:
+            vocab = CHAOS_EVENTS
         else:
             raise ValueError(f"unknown journal kind {kind!r}")
         if event not in vocab:
@@ -399,6 +407,13 @@ class Journal:
         emitting component (a verb boundary, a watch loop, an elector
         identity); like solver events these are a stream, never deduped."""
         return self.record(KIND_KUBE, entity, event, t=t, attrs=attrs)
+
+    def chaos_event(self, entity: str, event: str, t: Optional[float] = None, **attrs) -> Optional[JournalEvent]:
+        """One chaos-orchestrator transition (scenarios/chaos_orchestrator.py
+        + invariants.py): the schedule arming, a delivered cross-domain
+        event, or a confirmed invariant violation. `entity` names the action
+        or the violated invariant; a stream, never deduped."""
+        return self.record(KIND_CHAOS, entity, event, t=t, attrs=attrs)
 
     def note_observed_pending(self, pod: str, seconds: float) -> None:
         """Cross-feed from the SLO accountant: the independently-measured
@@ -570,6 +585,8 @@ class Journal:
             completed = len(self._completed) if self._completed is not None else 0
             seq = self._seq
             spooling = self._spool_path if self._spool is not None else None
+            spool_bytes = self._spool_bytes if self._spool is not None else None
+            spool_max = self._spool_max_bytes
         return {
             "enabled": self.enabled,
             "events_stored": stored,
@@ -577,6 +594,11 @@ class Journal:
             "entities_tracked": entities,
             "waterfalls_completed": completed,
             "spool": spooling,
+            # declared-budget surface for the invariant monitor: occupancy
+            # vs bound for the ring, the milestone map, the completed ring,
+            # and the on-disk spool (spool_bytes None when not spooling)
+            "spool_bytes": spool_bytes,
+            "spool_max_bytes": spool_max,
         }
 
     def waterfall_index(self) -> dict:

@@ -12,9 +12,9 @@ Each line is one JSON object with the JournalEvent shape (journal.py):
 
 Required: seq (int, strictly increasing), t (finite number, non-decreasing —
 every timestamp flows through one clock seam, so a step backwards means a
-corrupted or spliced file), kind (pod|node|solver|kube), entity (non-empty
-string), event (in the kind's transition vocabulary). `attrs` is an
-optional object.
+corrupted or spliced file), kind (pod|node|solver|kube|chaos), entity
+(non-empty string), event (in the kind's transition vocabulary). `attrs` is
+an optional object.
 """
 
 from __future__ import annotations
@@ -23,9 +23,26 @@ import json
 import math
 from typing import Iterable, List, Tuple
 
-from .journal import KIND_KUBE, KIND_NODE, KIND_POD, KIND_SOLVER, KUBE_EVENTS, NODE_EVENTS, POD_EVENTS, SOLVER_EVENTS
+from .journal import (
+    CHAOS_EVENTS,
+    KIND_CHAOS,
+    KIND_KUBE,
+    KIND_NODE,
+    KIND_POD,
+    KIND_SOLVER,
+    KUBE_EVENTS,
+    NODE_EVENTS,
+    POD_EVENTS,
+    SOLVER_EVENTS,
+)
 
-_VOCAB = {KIND_POD: POD_EVENTS, KIND_NODE: NODE_EVENTS, KIND_SOLVER: SOLVER_EVENTS, KIND_KUBE: KUBE_EVENTS}
+_VOCAB = {
+    KIND_POD: POD_EVENTS,
+    KIND_NODE: NODE_EVENTS,
+    KIND_SOLVER: SOLVER_EVENTS,
+    KIND_KUBE: KUBE_EVENTS,
+    KIND_CHAOS: CHAOS_EVENTS,
+}
 
 
 class JournalSchemaError(ValueError):

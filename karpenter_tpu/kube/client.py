@@ -309,6 +309,11 @@ class HttpKubeClient:
         self._watch_cancels.append((kind, handler, cancel))
         thread.start()
 
+    def watcher_count(self) -> int:
+        """Live (un-cancelled) watch registrations — the KubeCluster parity
+        seam for the invariant monitor's leaked-watch witness."""
+        return len(self._watch_cancels)
+
     def unwatch(self, kind: str, handler: Callable[[WatchEvent], None]) -> None:
         """Cancel the watch registered for (kind, handler): the informer
         loop exits at its next reconnect/poll boundary. The KubeCluster

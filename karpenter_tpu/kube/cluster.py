@@ -215,6 +215,14 @@ class KubeCluster:
         for obj in existing:
             handler(WatchEvent(ADDED, obj))
 
+    def watcher_count(self) -> int:
+        """Live watch subscriptions across kinds — the invariant monitor's
+        leaked-watch witness baselines this at arm time: crash/restart
+        cycles are net-zero by contract (every successor attaches exactly
+        what its predecessor detached), so growth is a leak."""
+        with self._lock:
+            return sum(len(handlers) for handlers in self._watchers.values())
+
     def unwatch(self, kind: str, handler: Callable[[WatchEvent], None]) -> None:
         """Deregister a watch handler. Dispatch is synchronous on the
         mutating thread, so a handler that outlives its owner (a stopped or

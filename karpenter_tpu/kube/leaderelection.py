@@ -182,6 +182,12 @@ class LeaseElector:
     def is_leader(self) -> bool:
         return self._leading.is_set()
 
+    @property
+    def thread(self) -> Optional[threading.Thread]:
+        """The election loop's thread (None before start) — the Runtime
+        registers it with the invariants thread census."""
+        return self._thread
+
     def wait_for_leadership(self, timeout: float = 30.0) -> bool:
         return self._leading.wait(timeout)
 

@@ -269,6 +269,22 @@ class Runtime:
         # deregisters in _detach_watchers
         self._coherence_name = f"state.cluster/{identity}"
         COHERENCE.register(self._coherence_name, self.cluster)
+        # thread census (invariants.py): every thread this runtime spawns —
+        # control loops, the provisioner batcher thread, the elector, the
+        # leader-recovery task — registers under this owner; stop()/crash()
+        # join-with-timeout then release(), and anything still alive at
+        # release is a straggler the invariant monitor counts until it dies
+        self._census_owner = f"runtime/{identity}"
+        # the invariant monitor loop (--invariants-interval): arm against
+        # this runtime's backend and sample on the interval. The generation
+        # token scopes the teardown: a stopped runtime disarms only the
+        # window IT armed, never a successor's (two runtimes in one process,
+        # or a crash/restart cycle, must not tear down each other's window)
+        self._invariants_generation = None
+        if self.options.invariants_interval > 0:
+            from .invariants import MONITOR
+
+            self._invariants_generation = MONITOR.arm(self.kube, clock=self.kube.clock)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.solve_duration = REGISTRY.histogram(
@@ -350,8 +366,12 @@ class Runtime:
                     log.warning("leadership lost during recovery; gate stays closed for the ended term")
 
         # tracked apart from _threads (those are run-lifetime loops; this is
-        # a short task that EXITS when recovery completes); stop() joins it
+        # a short task that EXITS when recovery completes); stop() joins it,
+        # and the census watches it like every other runtime-owned thread
+        from .invariants import CENSUS
+
         self._recovery_thread = threading.Thread(target=recover_then_open, name="leader-recovery", daemon=True)
+        CENSUS.register(self._census_owner, self._recovery_thread)
         self._recovery_thread.start()
 
     def _on_leadership_lost(self) -> None:
@@ -366,6 +386,8 @@ class Runtime:
         log.warning("leadership lost: singleton loops paused until re-elected")
 
     def start(self) -> None:
+        from .invariants import CENSUS
+
         if self.options.leader_elect:
             # Lease-based election (controllers.go:104-106): block until this
             # runtime holds karpenter-leader-election, keep renewing after.
@@ -376,6 +398,7 @@ class Runtime:
                 on_started_leading=self._on_leadership_gained,
                 on_stopped_leading=self._on_leadership_lost,
             )
+            CENSUS.register(self._census_owner, self.elector.thread)
             while not self.elector.wait_for_leadership(timeout=0.5):
                 if self._stop.is_set():
                     return
@@ -394,6 +417,7 @@ class Runtime:
             self._recover()
             self._leader_active.set()
         self.provisioner.start()
+        CENSUS.register(self._census_owner, self.provisioner.thread)
         self._spawn(self._lifecycle_loop, "node-lifecycle")
         if self.options.gc_interval > 0:
             self._spawn(self._gc_loop, "gc")
@@ -416,8 +440,18 @@ class Runtime:
             self._spawn(self._interruption_loop, "interruption")
         if self.options.coherence_interval > 0:
             self._spawn(self._coherence_loop, "coherence-witness")
+        if self.options.invariants_interval > 0:
+            self._spawn(self._invariants_loop, "invariant-monitor")
 
-    def stop(self) -> None:
+    def _shutdown(self, release_lease: bool) -> None:
+        """The shared teardown: halt + join every runtime-owned thread
+        (loops, provisioner, recovery, elector), then release the census —
+        any thread still alive after its join timeout is logged as a
+        straggler and stays under the invariant monitor's watch until it
+        dies. Leaving a straggler un-joined used to be invisible; the
+        census makes the class impossible to miss."""
+        from .invariants import CENSUS, MONITOR
+
         self._stop.set()
         self._leader_active.clear()
         self.provisioner.stop()
@@ -427,8 +461,17 @@ class Runtime:
             thread.join(timeout=5)
         if self._recovery_thread is not None:
             self._recovery_thread.join(timeout=5)
-        self.elector.stop(release=True)
+        self.elector.stop(release=release_lease)
         self._detach_watchers()
+        stragglers = CENSUS.release(self._census_owner)
+        if stragglers:
+            log.warning("runtime shutdown left straggler thread(s) alive: %s", stragglers)
+        if self._invariants_generation is not None:
+            MONITOR.disarm(self._invariants_generation)
+            self._invariants_generation = None
+
+    def stop(self) -> None:
+        self._shutdown(release_lease=True)
 
     def crash(self) -> None:
         """Simulated process death: every loop halts with NO graceful
@@ -443,17 +486,7 @@ class Runtime:
         in-memory subscriptions) dies with it — leaving them registered on
         the shared in-memory cluster would be a dead process still
         executing, not a crash."""
-        self._stop.set()
-        self._leader_active.clear()
-        self.provisioner.stop()
-        if self.provisioner.remote_solver is not None:
-            self.provisioner.remote_solver.close()
-        for thread in self._threads:
-            thread.join(timeout=5)
-        if self._recovery_thread is not None:
-            self._recovery_thread.join(timeout=5)
-        self.elector.stop(release=False)
-        self._detach_watchers()
+        self._shutdown(release_lease=False)
 
     def _detach_watchers(self) -> None:
         """Deregister every watch handler this Runtime's components attached
@@ -471,7 +504,10 @@ class Runtime:
             self._config_unwatch = None
 
     def _spawn(self, target, name: str) -> None:
+        from .invariants import CENSUS
+
         thread = threading.Thread(target=target, name=name, daemon=True)
+        CENSUS.register(self._census_owner, thread)
         thread.start()
         self._threads.append(thread)
 
@@ -516,6 +552,14 @@ class Runtime:
 
         while not self._stop.wait(timeout=self.options.coherence_interval):
             self._pass("coherence", COHERENCE.check)
+
+    def _invariants_loop(self) -> None:
+        # never leader-gated: a follower leaks threads/watches exactly like
+        # a leader, and the monitor is read-only over process state
+        from .invariants import MONITOR
+
+        while not self._stop.wait(timeout=self.options.invariants_interval):
+            self._pass("invariants", MONITOR.sample)
 
     def _pricing_loop(self) -> None:
         while not self._stop.wait(timeout=self.options.pricing_refresh_period):

@@ -29,10 +29,11 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import flight, journal, slo
+from .. import flight, invariants, journal, slo
 from ..kube import chaos as kube_chaos
 from ..kube.coherence import COHERENCE
 from ..solver import faults as solver_faults
+from ..utils.seeds import split_seed
 from ..api import labels as lbl
 from ..api.objects import NodeSelectorRequirement, ObjectMeta, OP_IN
 from ..api.provisioner import Budget, Consolidation, Disruption, Provisioner, ProvisionerSpec
@@ -44,6 +45,7 @@ from ..logsetup import get_logger
 from ..provenance import provenance_block
 from ..runtime import Runtime
 from ..utils.options import Options
+from .chaos_orchestrator import ChaosSchedule, Soak, diurnal_trace
 from .primitives import (
     Burst,
     DiurnalRamp,
@@ -289,6 +291,19 @@ def watch_gap_settled(ctx: ScenarioContext) -> bool:
     return gap_ends >= 2 and compactions >= 1
 
 
+def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule) -> bool:
+    """The soak convergence bar: the chaos schedule fully delivered (a run
+    the weather never reached proves nothing), the solver breaker re-closed
+    (a fault storm that permanently abandoned the device path is not
+    'settled'), and the invariant monitor confirmed ZERO violations — the
+    leak witnesses are the whole point of the tier."""
+    if schedule.injected_total() < len(schedule.events):
+        return False
+    if solver_faults.BREAKER.state != solver_faults.STATE_CLOSED:
+        return False
+    return not invariants.MONITOR.violations()
+
+
 def _lost_pods(ctx: ScenarioContext) -> int:
     """Pods the cluster failed: unbound, or bound to a node whose backing
     instance is gone / whose node object vanished."""
@@ -355,9 +370,14 @@ class CampaignRunner:
         solver_faults.BREAKER.reset()
         faults_at_start = solver_faults.faults_total()
         degraded_at_start = solver_faults.degraded_total()
+        # ONE master seed per scenario (utils/seeds.py): every seeded
+        # consumer below — the solver plan, the kube plan, the stand-in's
+        # jitter — derives from scenario.seed, and the derivation lands in
+        # provenance, so the whole run replays from one number
+        derived_seeds = scenario.derived_seeds()
         if scenario.fault_specs:
             solver_faults.FAULTS.install(
-                solver_faults.FaultPlan.from_specs(scenario.fault_specs, seed=scenario.fault_seed)
+                solver_faults.FaultPlan.from_specs(scenario.fault_specs, seed=derived_seeds["fault_seed"])
             )
         kube_conflicts_at_start = kube_chaos.conflicts_total()
         kube = KubeCluster()
@@ -442,7 +462,7 @@ class CampaignRunner:
             kube, backend, runtime, service=service, pod_cpu=scenario.pod_cpu, runtime_factory=runtime_factory
         )
         ctx.solver_chunked_at_start = solver_faults.DEGRADED_SOLVES.value(rung=solver_faults.RUNG_CHUNKED)
-        stand_in = WorkloadStandIn(ctx)
+        stand_in = WorkloadStandIn(ctx, jitter_seed=derived_seeds["standin_jitter_seed"])
         reclaim_thread = threading.Thread(
             target=self._reclaimer, args=(ctx,), name="cloud-reclaimer", daemon=True
         )
@@ -460,9 +480,19 @@ class CampaignRunner:
             # the identical fault sequence; every run scores its own delta
             if scenario.kube_fault_specs:
                 kube_chaos.KUBE_CHAOS.install(
-                    kube_chaos.KubeFaultPlan.from_specs(scenario.kube_fault_specs, seed=scenario.kube_fault_seed)
+                    kube_chaos.KubeFaultPlan.from_specs(scenario.kube_fault_specs, seed=derived_seeds["kube_fault_seed"])
                 )
             runtime.start()
+            # the invariant monitor (invariants.py) arms AFTER the runtime
+            # attached its watchers: the armed state is the healthy baseline
+            # (crash/restart cycles are net-zero detach/attach by contract),
+            # and every later sample — one per runner tick, ~one compressed
+            # minute at soak compression — hunts growth above it. Memory is
+            # traced only on the soak tier: tracemalloc taxes every
+            # allocation, and the short storms have nothing to slow-leak
+            invariants.MONITOR.arm(
+                kube, backend=backend, clock=kube.clock, trace_memory=isinstance(scenario, Soak)
+            )
             stand_in.start()
             reclaim_thread.start()
             ctx.desired = scenario.desired
@@ -508,10 +538,20 @@ class CampaignRunner:
             # the store; divergences still standing after the settle window
             # are scored (and pinned at zero by the chaos suites)
             divergences = COHERENCE.final_check(timeout=5.0)
+            # the invariant monitor's final round + report: the slow-leak
+            # witnesses (thread stragglers, watch growth, ring budgets, heap
+            # slope) become scored artifact keys next to lost/leaked/budget
+            invariants.MONITOR.sample()
+            invariant_report = invariants.MONITOR.report()
+            schedules = [p for p in scenario.primitives if isinstance(p, ChaosSchedule)]
+            solver_injected = int(solver_faults.FAULTS.fired())
+            kube_injected = int(kube_chaos.KUBE_CHAOS.fired())
+            duration_wall = time.monotonic() - start
+            compressed = scenario.compressed_span if isinstance(scenario, Soak) and scenario.compressed_span > 0 else duration_wall
             pods = live_pods(kube)
             run = {
                 "transport": transport,
-                "duration_seconds": round(time.monotonic() - start, 3),
+                "duration_seconds": round(duration_wall, 3),
                 "converged": converged,
                 "scores": {
                     "pending_latency_seconds": snapshot["pod_pending_latency_seconds"],
@@ -537,9 +577,18 @@ class CampaignRunner:
                     "solver_faults_injected": int(solver_faults.FAULTS.fired()),
                     "breaker_state": solver_faults.BREAKER.state,
                     "kube_conflicts_total": int(kube_chaos.conflicts_total() - kube_conflicts_at_start),
-                    "kube_faults_injected": int(kube_chaos.KUBE_CHAOS.fired()),
+                    "kube_faults_injected": kube_injected,
                     "informer_divergences": len(divergences),
                     "double_launches": int(ctx.backend.double_launches()),
+                    "leaked_threads": int(invariant_report["leaked_threads"]),
+                    "leaked_watches": int(invariant_report["leaked_watches"]),
+                    "rss_growth_slope": invariant_report["rss_growth_slope"],
+                    "invariant_violations": len(invariant_report["violations"]),
+                    "chaos_injected_total": int(
+                        sum(s.injected_total() for s in schedules) + solver_injected + kube_injected
+                    ),
+                    "chaos_history_digest": schedules[0].history_digest() if schedules else None,
+                    "compressed_seconds": round(compressed, 3),
                 },
                 "samples": samples,
             }
@@ -571,6 +620,7 @@ class CampaignRunner:
             solver_faults.FAULTS.clear()  # never leak a fault plan past its run
             kube.chaos_watch_gap_end()  # a gap leaked past its run wedges nothing
             kube_chaos.KUBE_CHAOS.clear()
+            invariants.MONITOR.disarm()  # ends the window; tracemalloc off
 
     @staticmethod
     def _run_primitive(ctx: ScenarioContext, primitive) -> None:
@@ -605,6 +655,11 @@ class CampaignRunner:
         owned = sum(1 for n in nodes if n.metadata.labels.get(lbl.PROVISIONER_NAME_LABEL) == provisioner.name)
         limit = allowed_disruptions(provisioner, owned, ctx.kube.clock.now())
         violated = limit is not None and max(in_flight, scanned) > limit
+        # the invariant monitor rides the sample cadence: ~one round per
+        # 0.4s of wall time, which at soak compression is about one round
+        # per compressed minute — the "sample every N compressed minutes"
+        # contract without a second timer
+        invariants.MONITOR.sample()
         samples.append(
             {
                 "t": round(time.monotonic() - start, 3),
@@ -883,7 +938,100 @@ def default_campaign() -> List[Scenario]:
             ],
             description="burst under a degraded cloud API: injected latency + 429 throttling",
         ),
+        chaos_soak_scenario(),
     ]
+
+
+def chaos_soak_scenario(seed: int = 11) -> Soak:
+    """The standing soak: 75 minutes of diurnal arrivals compressed 150x
+    into a ~30s run, under a low-rate cross-domain ChaosSchedule drawn from
+    the scenario's ONE master seed — pool exhaustions with paired restores,
+    reclaim waves, API latency, watch gaps/compactions, the odd kill -9,
+    plus seeded solver and kube verb triggers. The invariant monitor
+    samples every ~compressed-minute; convergence requires the schedule
+    fully delivered, the breaker re-closed, and ZERO invariant violations.
+    Every future perf PR must survive this for compressed hours, not
+    seconds."""
+    import functools
+
+    schedule = ChaosSchedule(
+        offset=1.0,
+        seed=split_seed(seed, "chaos.schedule"),
+        events_count=16,
+        horizon=24.0,
+        instance_type="general-4x8",
+        solver_faults=2,
+        kube_faults=3,
+    )
+    trace = diurnal_trace(seed, span_seconds=4500.0, arrivals=60, compress=150.0, offset=0.5)
+    return Soak(
+        name="chaos_soak",
+        desired=0,  # the replayed trace owns the load
+        duration=34.0,
+        seed=seed,
+        compress=150.0,
+        compressed_span=4500.0,
+        instance_types=["general-4x8"],
+        dense_solver=True,  # the solver seam must sit under real dispatch
+        fault_specs=schedule.solver_specs(),
+        kube_fault_specs=schedule.kube_specs(),
+        settled=functools.partial(soak_settled, schedule=schedule),
+        primitives=[trace, schedule],
+        description=(
+            "the soak tier: 75 compressed minutes of diurnal load replayed 150x under a "
+            "seeded cross-domain chaos schedule spanning all three fault seams, with the "
+            "invariant monitor sampling leak witnesses every compressed minute — converge "
+            "with zero lost pods, zero leaked threads/watches, zero invariant violations"
+        ),
+    )
+
+
+def mini_soak_scenario(seed: int = 5, extra_events: Optional[List[dict]] = None) -> Soak:
+    """The tier-1 soak shape: 60 compressed seconds (20x over a ~3s replay)
+    under a 3-event cross-domain schedule — one pool exhaustion (cloud),
+    one watch gap (kube), the paired restore — plus one seeded solver
+    trigger and one seeded kube trigger from the same master seed.
+    `extra_events` appends imported events (the seeded negative control
+    injects its watch-leak through it)."""
+    import functools
+
+    events = [
+        {"index": 0, "offset": 0.6, "domain": "cloud", "action": "pool-exhaust",
+         "params": {"instance_type": "general-4x8", "zone": "zone-c", "capacity_type": "spot", "capacity": 0}},
+        {"index": 1, "offset": 1.2, "domain": "kube", "action": "watch-gap",
+         "params": {"duration": 0.4, "compact": True}},
+        {"index": 2, "offset": 1.8, "domain": "cloud", "action": "pool-restore",
+         "params": {"instance_type": "general-4x8", "zone": "zone-c", "capacity_type": "spot"}},
+    ]
+    for i, extra in enumerate(extra_events or []):
+        events.append(dict(extra, index=len(events)))
+    schedule = ChaosSchedule(
+        offset=0.3,
+        seed=split_seed(seed, "chaos.schedule"),
+        solver_faults=1,
+        kube_faults=1,
+        imported=events,
+    )
+    trace = diurnal_trace(seed, span_seconds=60.0, arrivals=10, compress=20.0, offset=0.3)
+    return Soak(
+        name="mini_soak",
+        desired=0,
+        duration=4.5,
+        seed=seed,
+        compress=20.0,
+        compressed_span=60.0,
+        instance_types=["general-4x8"],
+        dense_solver=True,
+        fault_specs=schedule.solver_specs(),
+        kube_fault_specs=schedule.kube_specs(),
+        settled=functools.partial(soak_settled, schedule=schedule),
+        primitives=[trace, schedule],
+        description=(
+            "tier-1 mini-soak: 60 compressed seconds of diurnal replay under a 3-event "
+            "cross-domain schedule with seeded solver + kube triggers; zero leaked "
+            "threads/watches and zero invariant violations on both transports"
+        ),
+    )
 
 
 def smoke_campaign() -> List[Scenario]:

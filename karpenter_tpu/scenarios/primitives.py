@@ -361,7 +361,14 @@ class Scenario:
     # --solver-hbm-budget runtime flags on the scenario's timescale.
     dense_solver: bool = False
     fault_specs: Optional[List[dict]] = None
-    fault_seed: int = 0
+    # seed fan-out (utils/seeds.py): `seed` is the ONE master knob — the
+    # solver fault seed, the kube fault seed, the stand-in's jitter, and a
+    # chaos schedule's streams all derive from it splitmix-style, so two
+    # runs of any scenario are reproducible from one number. The per-seam
+    # overrides (None = derive) exist for unit tests that pin one seam; a
+    # scenario that sets them independently re-opens the drift this closes.
+    seed: int = 0
+    fault_seed: Optional[int] = None
     solver_breaker_threshold: int = 3
     solver_breaker_backoff: float = 1.5
     solver_hbm_budget_bytes: int = 0
@@ -372,15 +379,33 @@ class Scenario:
     # scenario's Runtime behind real Lease election (with the campaign's
     # short lease timing) so LeaseSteal primitives have a leader to depose
     kube_fault_specs: Optional[List[dict]] = None
-    kube_fault_seed: int = 0
+    kube_fault_seed: Optional[int] = None
     leader_elect: bool = False
     description: str = ""
+
+    def derived_seeds(self) -> dict:
+        """Every consumer seed, fanned out from the master (or pinned by an
+        explicit override) — recorded in provenance so the artifact itself
+        says how to reproduce the run."""
+        from ..utils.seeds import split_seed
+
+        return {
+            "fault_seed": self.fault_seed if self.fault_seed is not None else split_seed(self.seed, "solver.faults"),
+            "kube_fault_seed": (
+                self.kube_fault_seed if self.kube_fault_seed is not None else split_seed(self.seed, "kube.chaos")
+            ),
+            "standin_jitter_seed": split_seed(self.seed, "standin.jitter"),
+            "chaos_schedule_seed": split_seed(self.seed, "chaos.schedule"),
+        }
 
     def config(self) -> dict:
         """The provenance config-hash payload: everything that shapes the
         run, so two SCENARIO artifacts are comparable iff hashes match."""
         return {
             "name": self.name,
+            "kind": "standard",
+            "seed": self.seed,
+            "derived_seeds": self.derived_seeds(),
             "desired": self.desired,
             "duration": self.duration,
             "pod_cpu": self.pod_cpu,

@@ -68,6 +68,27 @@ SCORE_KEYS = (
     "kube_faults_injected",
     "informer_divergences",
     "double_launches",
+    # invariant-monitor scores (invariants.py): the slow-leak witnesses the
+    # soak tier exists for, schema-gated on EVERY run — threads alive after
+    # their Runtime released them, watch subscriptions above the armed
+    # baseline, the least-squares traced-heap slope (null unless the run
+    # traced memory, i.e. the soak tier), and distinct confirmed invariant
+    # violations (threads/watches/ring-budget/lock-cycle/coherence/
+    # double-launch, each (invariant, entity) counted once)
+    "leaked_threads",
+    "leaked_watches",
+    "rss_growth_slope",
+    "invariant_violations",
+    # chaos-orchestrator scores (scenarios/chaos_orchestrator.py): total
+    # cross-domain fault events delivered this run (imperative schedule
+    # events + seeded solver/kube triggers that fired), the schedule's
+    # history digest (null when the scenario ran no schedule — equal
+    # digests across transports pin the cross-transport determinism
+    # witness), and the compressed wall-time the run represents (the
+    # recorded span a soak replays; the real duration otherwise)
+    "chaos_injected_total",
+    "chaos_history_digest",
+    "compressed_seconds",
 )
 
 BREAKER_STATES = ("closed", "half-open", "open")
@@ -112,6 +133,7 @@ def run_errors(run, where: str = "run") -> List[str]:
             "lost_pods", "leaked_instances", "budget_violations", "restarts", "launch_failures",
             "recompiles_total", "solver_faults_total", "degraded_solves_total", "solver_faults_injected",
             "kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches",
+            "leaked_threads", "leaked_watches", "invariant_violations", "chaos_injected_total",
         ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
@@ -125,6 +147,19 @@ def run_errors(run, where: str = "run") -> List[str]:
         p95 = scores.get("solver_latency_p95_seconds")
         if p95 is not None and (not isinstance(p95, (int, float)) or isinstance(p95, bool) or p95 < 0):
             errs.append(f"{where}.scores.solver_latency_p95_seconds must be null or a non-negative number")
+        slope = scores.get("rss_growth_slope")
+        if slope is not None and (not isinstance(slope, (int, float)) or isinstance(slope, bool)):
+            # negative is legal (a heap that SHRANK over the window); only
+            # a non-number is a malformation
+            errs.append(f"{where}.scores.rss_growth_slope must be null or a number")
+        digest = scores.get("chaos_history_digest")
+        if digest is not None and (not isinstance(digest, str) or not digest):
+            errs.append(f"{where}.scores.chaos_history_digest must be null or a non-empty string")
+        compressed = scores.get("compressed_seconds")
+        if compressed is not None and (
+            not isinstance(compressed, (int, float)) or isinstance(compressed, bool) or compressed < 0
+        ):
+            errs.append(f"{where}.scores.compressed_seconds must be a non-negative number")
         errs.extend(_quantile_errors(scores.get("pending_latency_seconds", {}), f"{where}.scores.pending_latency_seconds"))
         waterfall = scores.get("waterfall")
         if isinstance(waterfall, dict):

@@ -18,7 +18,9 @@ cluster around it.
 from __future__ import annotations
 
 import itertools
+import random
 import threading
+from typing import Optional
 
 from ..api.objects import Container, NodeCondition, ObjectMeta, OwnerReference, Pod, PodCondition, PodSpec, PodStatus, ResourceRequirements
 from ..logsetup import get_logger
@@ -59,14 +61,24 @@ def live_pods(kube):
 
 
 class WorkloadStandIn(threading.Thread):
-    def __init__(self, ctx: ScenarioContext, tick_interval: float = 0.1, app: str = "scenario"):
+    def __init__(self, ctx: ScenarioContext, tick_interval: float = 0.1, app: str = "scenario", jitter_seed: Optional[int] = None):
         super().__init__(daemon=True, name="workload-standin")
         self.ctx = ctx
         self.tick_interval = tick_interval
         self.app = app
+        # seeded tick jitter (the kubelet/scheduler never tick on a metronome):
+        # +-30% per tick from the scenario's fanned-out master seed, so the
+        # stand-in's interleaving is part of the one-number reproducibility
+        # story instead of an unseeded source of run-to-run drift
+        self._jitter = random.Random(jitter_seed) if jitter_seed is not None else None
+
+    def _tick_timeout(self) -> float:
+        if self._jitter is None:
+            return self.tick_interval
+        return self.tick_interval * self._jitter.uniform(0.7, 1.3)
 
     def run(self) -> None:
-        while not self.ctx.stop.wait(timeout=self.tick_interval):
+        while not self.ctx.stop.wait(timeout=self._tick_timeout()):
             try:
                 self.tick()
             except Exception:  # noqa: BLE001 - the stand-in must survive races with the runtime

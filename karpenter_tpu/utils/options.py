@@ -70,6 +70,12 @@ class Options:
     # authoritative store. <= 0 (the default) disables the loop — the cache
     # is still registered, so harnesses can run final_check() at teardown
     coherence_interval: float = 0.0
+    # invariant monitor (invariants.py): period of the leak-witness sample
+    # loop (thread census stragglers, watch-subscription growth, bounded
+    # ring/spool budgets, folded lock/coherence/double-launch witnesses),
+    # served at /debug/invariants. <= 0 (the default) disables the loop and
+    # leaves the process-wide monitor disarmed for harnesses to drive
+    invariants_interval: float = 0.0
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     dense_solver_enabled: bool = True
@@ -206,6 +212,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--lease-duration", type=float, default=_env("LEASE_DURATION", defaults.lease_duration))
     parser.add_argument("--lease-renew-period", type=float, default=_env("LEASE_RENEW_PERIOD", defaults.lease_renew_period))
     parser.add_argument("--coherence-interval", type=float, default=_env("COHERENCE_INTERVAL", defaults.coherence_interval))
+    parser.add_argument("--invariants-interval", type=float, default=_env("INVARIANTS_INTERVAL", defaults.invariants_interval))
     parser.add_argument("--batch-max-duration", type=float, default=_env("BATCH_MAX_DURATION", defaults.batch_max_duration))
     parser.add_argument("--batch-idle-duration", type=float, default=_env("BATCH_IDLE_DURATION", defaults.batch_idle_duration))
     parser.add_argument("--disable-dense-solver", dest="dense_solver_enabled", action="store_false", default=_env("DENSE_SOLVER_ENABLED", defaults.dense_solver_enabled))

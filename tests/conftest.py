@@ -28,6 +28,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: storm/soak tiers excluded from the tier-1 budget (-m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow_soak: the long-horizon soak acceptance tier (compressed-hours chaos runs; "
+        "always also marked slow so tier-1's -m 'not slow' skips it)",
+    )
 
 
 @pytest.fixture

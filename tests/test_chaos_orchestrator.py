@@ -1,0 +1,257 @@
+"""Chaos orchestrator: seeded cross-domain schedules, seed fan-out, and the
+ddmin shrinker.
+
+Tier-1: schedule determinism (same seed -> byte-identical history, the
+PR 13/14 plan witness generalized across domains), the splitmix seed
+fan-out that makes one Scenario.seed the only reproducibility knob, the
+spec exports composing onto the existing solver/kube injectors, the
+synthetic diurnal trace, and delta debugging over recorded schedules.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karpenter_tpu.scenarios import (
+    ChaosEvent,
+    ChaosSchedule,
+    Soak,
+    chaos_soak_scenario,
+    ddmin,
+    diurnal_trace,
+    mini_soak_scenario,
+    shrink_doc,
+    shrink_doc_errors,
+)
+from karpenter_tpu.scenarios.primitives import Scenario
+from karpenter_tpu.utils.seeds import split_seed
+
+
+class TestSeedFanout:
+    def test_split_seed_is_stable_and_label_distinct(self):
+        # pure function: same (master, label) -> same seed, across calls
+        assert split_seed(7, "solver.faults") == split_seed(7, "solver.faults")
+        # labels fan out to independent streams of one master
+        labels = ("solver.faults", "kube.chaos", "standin.jitter", "chaos.schedule")
+        values = {split_seed(7, label) for label in labels}
+        assert len(values) == len(labels)
+        # adjacent masters decorrelate (the splitmix property the sweep needs)
+        assert split_seed(7, "solver.faults") != split_seed(8, "solver.faults")
+        # every derived seed is a positive 63-bit int any RNG accepts
+        assert all(0 < v < 2**63 for v in values)
+
+    def test_scenario_derives_every_consumer_seed_from_one_master(self):
+        a = Scenario(name="x", desired=0, duration=1.0, seed=21)
+        b = Scenario(name="x", desired=0, duration=1.0, seed=21)
+        assert a.derived_seeds() == b.derived_seeds()
+        assert a.derived_seeds() != Scenario(name="x", desired=0, duration=1.0, seed=22).derived_seeds()
+        # the derivation lands in provenance: the artifact says how to replay
+        config = a.config()
+        assert config["seed"] == 21
+        assert config["derived_seeds"] == a.derived_seeds()
+
+    def test_explicit_override_still_wins_for_unit_harnesses(self):
+        scenario = Scenario(name="x", desired=0, duration=1.0, seed=21, fault_seed=99)
+        derived = scenario.derived_seeds()
+        assert derived["fault_seed"] == 99
+        assert derived["kube_fault_seed"] == split_seed(21, "kube.chaos")
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_byte_identical_history(self):
+        a = ChaosSchedule(seed=42, events_count=10)
+        b = ChaosSchedule(seed=42, events_count=10)
+        assert json.dumps(a.history(), sort_keys=True) == json.dumps(b.history(), sort_keys=True)
+        assert a.history_digest() == b.history_digest()
+
+    def test_different_seed_different_schedule(self):
+        assert ChaosSchedule(seed=1, events_count=10).history_digest() != ChaosSchedule(
+            seed=2, events_count=10
+        ).history_digest()
+
+    def test_events_sorted_and_pool_exhaust_always_paired_with_restore(self):
+        schedule = ChaosSchedule(seed=3, events_count=20, horizon=10.0)
+        offsets = [e.offset for e in schedule.events]
+        assert offsets == sorted(offsets)
+        exhausts = [e for e in schedule.events if e.action == "pool-exhaust"]
+        restores = [e for e in schedule.events if e.action == "pool-restore"]
+        assert len(restores) == len(exhausts), "a drawn wall must never outlive the schedule"
+        for exhaust in exhausts:
+            paired = [
+                r for r in restores
+                if r.params["zone"] == exhaust.params["zone"]
+                and r.params["capacity_type"] == exhaust.params["capacity_type"]
+                and r.offset > exhaust.offset
+            ]
+            assert paired, f"exhaust at {exhaust.offset} has no later restore for its pool"
+
+    def test_spec_exports_compose_onto_the_existing_injectors(self):
+        from karpenter_tpu.kube.chaos import KubeFaultPlan
+        from karpenter_tpu.solver.faults import FaultPlan
+
+        schedule = ChaosSchedule(seed=5, solver_faults=2, kube_faults=3)
+        solver_plan = FaultPlan.from_specs(schedule.solver_specs(), seed=1)
+        kube_plan = KubeFaultPlan.from_specs(schedule.kube_specs(), seed=1)
+        # one spec per dispatch flavor per draw (the PR 13 lesson)
+        assert len(solver_plan.specs) == 2 * 3
+        assert {s.entry for s in solver_plan.specs} == {"plain", "sharded", "pallas"}
+        assert len(kube_plan.specs) == 3
+        # exports are copies: mutating a caller's list cannot skew the draw
+        schedule.solver_specs()[0]["kind"] = "mutated"
+        assert schedule.solver_specs()[0]["kind"] != "mutated"
+
+    def test_imported_events_round_trip_and_skip_the_draw(self):
+        events = [
+            {"index": 0, "offset": 0.1, "domain": "kube", "action": "watch-leak", "params": {}},
+            {"index": 1, "offset": 0.2, "domain": "cloud", "action": "pool-restore",
+             "params": {"instance_type": "t", "zone": "z", "capacity_type": "spot"}},
+        ]
+        schedule = ChaosSchedule(seed=9, imported=events)
+        assert [e.to_dict() for e in schedule.events] == events
+        assert ChaosEvent.from_dict(events[0]).to_dict() == events[0]
+        # the seeded spec streams still derive from the seed (composition)
+        assert schedule.solver_specs() == ChaosSchedule(seed=9).solver_specs()
+
+    def test_failed_delivery_is_never_counted_as_injected(self):
+        """An event whose delivery raises lands in failed(), not in the
+        executed/injected accounting — a soak whose weather never reached
+        the system must fail its fully-delivered convergence bar instead of
+        laundering the miss into chaos_injected_total."""
+        from karpenter_tpu.cloudprovider.simulated.backend import CloudBackend
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from karpenter_tpu.scenarios.primitives import ScenarioContext
+
+        kube = KubeCluster()
+        ctx = ScenarioContext(kube, CloudBackend(clock=kube.clock), runtime=None)  # no runtime_factory
+        events = [
+            {"index": 0, "offset": 0.0, "domain": "cloud", "action": "crash", "params": {}},
+            {"index": 1, "offset": 0.0, "domain": "cloud", "action": "pool-restore",
+             "params": {"instance_type": "t", "zone": "z", "capacity_type": "spot"}},
+        ]
+        schedule = ChaosSchedule(seed=1, imported=events)
+        schedule.run(ctx)
+        assert schedule.injected_total() == 1  # the restore delivered
+        assert [e["action"] for e in schedule.executed()] == ["pool-restore"]
+        assert [e["action"] for e in schedule.failed()] == ["crash"]
+        assert schedule.injected_total() < len(schedule.events)
+
+    def test_config_summarizes_by_digest(self):
+        schedule = ChaosSchedule(seed=4, events_count=30)
+        config = schedule.config()
+        assert config["history_digest"] == schedule.history_digest()
+        assert "events" not in config, "a 30-event schedule must not inline itself into the config hash"
+
+
+class TestDiurnalTrace:
+    def test_deterministic_and_diurnal_shaped(self):
+        a = diurnal_trace(7, span_seconds=3600.0, arrivals=50, compress=120.0)
+        b = diurnal_trace(7, span_seconds=3600.0, arrivals=50, compress=120.0)
+        assert a.schedule() == b.schedule()
+        assert a.source_digest == b.source_digest
+        assert diurnal_trace(8, 3600.0, 50, 120.0).source_digest != a.source_digest
+        # 50 arrivals whose compressed span stays under span/compress
+        assert len(a.schedule()) == 50
+        assert a.total_seconds() <= 3600.0 / 120.0 + 1e-6
+        # diurnal shape: midday (the middle half of the recorded day) is
+        # busier than the night edges
+        recorded = []
+        t = 0.0
+        for delay, _name in a.schedule():
+            t += delay * 120.0
+            recorded.append(t)
+        midday = sum(1 for t in recorded if 900.0 <= t <= 2700.0)
+        assert midday > 25, f"half-cosine density should put most arrivals midday, got {midday}/50"
+
+    def test_soak_config_declares_the_compressed_span(self):
+        soak = chaos_soak_scenario()
+        config = soak.config()
+        assert config["kind"] == "soak"
+        assert config["compress"] == 150.0
+        assert config["compressed_span"] == 4500.0  # 75 compressed minutes
+        assert isinstance(soak, Soak)
+        # the committed soak spans all three fault seams before it runs
+        schedule = soak.primitives[1]
+        assert isinstance(schedule, ChaosSchedule)
+        assert len(schedule.events) + len(soak.fault_specs) + len(soak.kube_fault_specs) >= 20
+        assert soak.fault_specs and soak.kube_fault_specs
+        # the schedule's seed is the scenario master's fan-out, recorded in
+        # provenance — one number replays the whole run
+        assert schedule.seed == soak.derived_seeds()["chaos_schedule_seed"]
+
+    def test_mini_soak_is_cross_domain(self):
+        mini = mini_soak_scenario()
+        schedule = mini.primitives[1]
+        domains = {e.domain for e in schedule.events}
+        assert domains == {"cloud", "kube"}
+        assert mini.fault_specs, "the solver seam rides the seeded spec export"
+
+
+class TestDdmin:
+    def _events(self, n=8, leak_at=(4,)):
+        return [
+            {"index": i, "offset": round(0.1 * i, 3), "domain": "kube",
+             "action": "watch-leak" if i in leak_at else "watch-gap", "params": {}}
+            for i in range(n)
+        ]
+
+    def test_shrinks_to_single_culprit(self):
+        trail = []
+
+        def failing(subset):
+            trail.append([e["index"] for e in subset])
+            return any(e["action"] == "watch-leak" for e in subset)
+
+        minimal, tests = ddmin(self._events(), failing)
+        assert [e["index"] for e in minimal] == [4]
+        assert tests == len(trail)
+
+    def test_two_culprit_failure_keeps_both(self):
+        # the invariant needs BOTH events: ddmin must not over-shrink
+        def failing(subset):
+            actions = [e["index"] for e in subset if e["action"] == "watch-leak"]
+            return len(actions) >= 2
+
+        minimal, _tests = ddmin(self._events(n=10, leak_at=(2, 7)), failing)
+        assert sorted(e["index"] for e in minimal) == [2, 7]
+
+    def test_deterministic_replay_sequence(self):
+        def make_failing(log):
+            def failing(subset):
+                log.append(tuple(e["index"] for e in subset))
+                return any(e["action"] == "watch-leak" for e in subset)
+
+            return failing
+
+        log_a, log_b = [], []
+        minimal_a, _ = ddmin(self._events(), make_failing(log_a))
+        minimal_b, _ = ddmin(self._events(), make_failing(log_b))
+        assert minimal_a == minimal_b
+        assert log_a == log_b, "the shrink replays the identical subset sequence every time"
+
+    def test_passing_input_is_refused(self):
+        with pytest.raises(ValueError):
+            ddmin(self._events(leak_at=()), lambda subset: any(e["action"] == "watch-leak" for e in subset))
+
+
+class TestShrinkDoc:
+    def test_valid_doc_passes_and_malformations_are_named(self):
+        original = [{"index": i, "offset": 0.1 * i, "domain": "kube", "action": "watch-gap", "params": {}} for i in range(3)]
+        doc = shrink_doc("unit", "watches.leak", seed=5, original=original, minimal=original[:1], replays=4)
+        assert shrink_doc_errors(doc) == []
+        broken = dict(doc)
+        del broken["minimal_events"]
+        assert any("minimal_events" in e for e in shrink_doc_errors(broken))
+        broken = dict(doc, replays=0)
+        assert any("replays" in e for e in shrink_doc_errors(broken))
+        broken = dict(doc, minimal_events=doc["original_events"] + doc["original_events"])
+        assert any("exceed" in e for e in shrink_doc_errors(broken))
+        bad_domain = dict(doc, minimal_events=[dict(original[0], domain="weather")])
+        assert any("domain" in e for e in shrink_doc_errors(bad_domain))
+        # a typo'd action would replay as a swallowed no-op — a reproducer
+        # that silently stopped reproducing; the validator refuses it
+        typo = dict(doc, minimal_events=[dict(original[0], action="watch-gapp")])
+        assert any("watch-gapp" in e for e in shrink_doc_errors(typo))
+        mismatch = dict(doc, minimal_events=[dict(original[0], domain="cloud")])  # watch-gap is kube
+        assert any("does not match action" in e for e in shrink_doc_errors(mismatch))

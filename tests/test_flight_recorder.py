@@ -368,11 +368,12 @@ class TestDebugIndexRoute:
         """Every debug module's route_descriptions() must key exactly its
         routes() — cmd/controller.py builds the /debug index from these
         pairs, so a drifted key would list a dead path or hide a live one."""
-        from karpenter_tpu import journal, slo, tracing
+        from karpenter_tpu import invariants, journal, slo, tracing
         from karpenter_tpu.analysis import witness
+        from karpenter_tpu.kube import coherence
         from karpenter_tpu.profiling import LiveProfiler
 
-        for mod in (tracing, slo, witness, flight, journal):
+        for mod in (tracing, slo, witness, flight, journal, coherence, invariants):
             assert set(mod.route_descriptions()) == set(mod.routes()), mod.__name__
         profiler = LiveProfiler()
         assert set(profiler.route_descriptions()) == set(profiler.routes())
@@ -406,6 +407,7 @@ def test_live_process_serves_debug_and_solver_json():
             "--enable-solver-telemetry",
             "--enable-tracing",
             "--enable-journal",
+            "--invariants-interval", "0.5",
             "--health-probe-port", str(health_port),
             "--metrics-port", str(metrics_port),
         ],
@@ -429,7 +431,10 @@ def test_live_process_serves_debug_and_solver_json():
         index = json.loads(body)
         paths = {e["path"] for e in index["endpoints"]}
         # every wired feature is discoverable, each with a description
-        assert {"/debug/solver", "/debug/traces", "/debug/decisions", "/debug/journal", "/debug/waterfall"} <= paths
+        assert {
+            "/debug/solver", "/debug/traces", "/debug/decisions", "/debug/journal",
+            "/debug/waterfall", "/debug/invariants",
+        } <= paths
         assert all(e["description"] for e in index["endpoints"])
         status, body = _get(metrics_port, "/debug/solver")
         assert status == 200
@@ -447,6 +452,17 @@ def test_live_process_serves_debug_and_solver_json():
         assert waterfall["enabled"] is True
         assert waterfall["pods_completed"] == 0
         assert waterfall["conservation"]["violations"] == 0
+        # the invariant monitor, armed by the entry point behind
+        # --invariants-interval: a freshly-booted idle controller leaks
+        # nothing and confirms no violations
+        status, body = _get(metrics_port, "/debug/invariants")
+        assert status == 200
+        report = json.loads(body)
+        assert report["armed"] is True
+        assert report["leaked_threads"] == 0
+        assert report["leaked_watches"] == 0
+        assert report["violations"] == []
+        assert report["census"]["owners"], "the runtime's threads are under census"
         status, body = _get(metrics_port, "/debug/waterfall?pod=ghost")
         assert status == 404
         assert json.loads(body)["status"] == 404

@@ -1,0 +1,255 @@
+"""Invariant monitor: the thread census, the leak witnesses, and the
+/debug/invariants read surface.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import invariants
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _monitor_teardown():
+    yield
+    invariants.MONITOR.disarm()
+    invariants.CENSUS.reset()
+
+
+class TestThreadCensus:
+    def _worker(self, stop):
+        stop.wait(timeout=10)
+
+    def test_clean_release_reports_no_stragglers(self):
+        stop = threading.Event()
+        thread = threading.Thread(target=self._worker, args=(stop,), name="census-clean", daemon=True)
+        invariants.CENSUS.register("owner-a", thread)
+        thread.start()
+        stop.set()
+        thread.join(timeout=5)
+        assert invariants.CENSUS.release("owner-a") == []
+        assert invariants.CENSUS.leaked() == []
+
+    def test_straggler_is_reported_until_it_dies(self):
+        stop = threading.Event()
+        thread = threading.Thread(target=self._worker, args=(stop,), name="census-straggler", daemon=True)
+        invariants.CENSUS.register("owner-b", thread)
+        thread.start()
+        # released while still alive: the exact leak class the census exists for
+        assert invariants.CENSUS.release("owner-b") == ["census-straggler"]
+        leaked = invariants.CENSUS.leaked()
+        assert leaked == [{"owner": "owner-b", "thread": "census-straggler"}]
+        stop.set()
+        thread.join(timeout=5)
+        assert invariants.CENSUS.leaked() == [], "a straggler that finally exits ages out"
+
+    def test_runtime_stop_releases_every_spawned_thread(self):
+        """The integration pin: a started-then-stopped Runtime leaves the
+        census empty — loops, provisioner batcher, elector included."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+        from karpenter_tpu.runtime import Runtime
+        from karpenter_tpu.utils.options import Options
+
+        runtime = Runtime(
+            kube=KubeCluster(),
+            cloud_provider=FakeCloudProvider(instance_types(2)),
+            options=Options(leader_elect=False, dense_solver_enabled=False, gc_interval=0.5),
+        )
+        runtime.start()
+        census = invariants.CENSUS.snapshot()
+        owner = runtime._census_owner
+        assert owner in census["owners"]
+        assert "provisioner" in census["owners"][owner]
+        runtime.stop()
+        census = invariants.CENSUS.snapshot()
+        assert owner not in census["owners"], "stop() must release the census"
+        assert invariants.CENSUS.leaked() == [], f"runtime threads leaked: {invariants.CENSUS.leaked()}"
+
+
+class TestInvariantMonitor:
+    def test_undrained_watch_is_caught_once(self):
+        kube = KubeCluster(clock=FakeClock())
+        invariants.MONITOR.arm(kube, clock=kube.clock)
+        assert invariants.MONITOR.sample()["watches_leaked"] == 0
+        kube.watch("Pod", lambda event: None, replay=False)  # the leak
+        row = invariants.MONITOR.sample()
+        assert row["watches_leaked"] == 1
+        report = invariants.MONITOR.report()
+        assert report["leaked_watches"] == 1
+        leaks = [v for v in report["violations"] if v["invariant"] == "watches.leak"]
+        assert len(leaks) == 1
+        # a persisting leak is ONE violation, not one per sample
+        invariants.MONITOR.sample()
+        invariants.MONITOR.sample()
+        assert len(invariants.MONITOR.violations()) == len(report["violations"])
+
+    def test_detached_watch_is_not_a_leak(self):
+        kube = KubeCluster(clock=FakeClock())
+        handler = lambda event: None  # noqa: E731
+        invariants.MONITOR.arm(kube, clock=kube.clock)
+        kube.watch("Pod", handler, replay=False)
+        kube.unwatch("Pod", handler)
+        assert invariants.MONITOR.sample()["watches_leaked"] == 0
+        assert invariants.MONITOR.violations() == []
+
+    def test_straggler_thread_is_a_violation(self):
+        kube = KubeCluster(clock=FakeClock())
+        invariants.MONITOR.arm(kube, clock=kube.clock)
+        stop = threading.Event()
+        thread = threading.Thread(target=lambda: stop.wait(timeout=10), name="monitor-straggler", daemon=True)
+        invariants.CENSUS.register("owner-m", thread)
+        thread.start()
+        invariants.CENSUS.release("owner-m")
+        row = invariants.MONITOR.sample()
+        assert row["threads_leaked"] == 1
+        assert any(v["invariant"] == "threads.leak" for v in invariants.MONITOR.violations())
+        stop.set()
+        thread.join(timeout=5)
+
+    def test_ring_budget_overrun_is_a_violation(self, monkeypatch):
+        from karpenter_tpu import journal
+
+        kube = KubeCluster(clock=FakeClock())
+        journal.JOURNAL.enable(capacity=64, clock=kube.clock)
+        journal.JOURNAL.reset()
+        try:
+            for i in range(4):
+                journal.JOURNAL.pod_event(f"p{i}", "created")
+            invariants.MONITOR.arm(kube, clock=kube.clock)
+            assert invariants.MONITOR.sample()["violations"] == 0
+            # a budget that silently stopped being enforced: declared bound
+            # drops below the live occupancy -> the witness must fire
+            monkeypatch.setattr(journal.JOURNAL, "capacity", 1)
+            invariants.MONITOR.sample()
+            assert any(v["invariant"] == "journal.ring" for v in invariants.MONITOR.violations())
+        finally:
+            journal.JOURNAL.disable()
+            journal.JOURNAL.reset()
+
+    def test_memory_slope_needs_three_samples_and_is_a_number(self):
+        kube = KubeCluster(clock=FakeClock())
+        invariants.MONITOR.arm(kube, clock=kube.clock, trace_memory=True)
+        invariants.MONITOR.sample()
+        assert invariants.MONITOR.report()["rss_growth_slope"] is None, "a 1-point trend is noise"
+        kube.clock.step(30.0)
+        invariants.MONITOR.sample()
+        kube.clock.step(30.0)
+        invariants.MONITOR.sample()
+        slope = invariants.MONITOR.report()["rss_growth_slope"]
+        assert isinstance(slope, float)
+        # disarm stops the tracemalloc session the monitor itself started
+        import tracemalloc
+
+        invariants.MONITOR.disarm()
+        assert not tracemalloc.is_tracing()
+
+    def test_externally_started_tracemalloc_does_not_leak_a_slope(self):
+        """The live profiler's heap endpoint starts tracemalloc process-wide
+        and leaves it on; a window that never asked for memory tracing must
+        not score a slope nobody requested."""
+        import tracemalloc
+
+        started_here = not tracemalloc.is_tracing()
+        if started_here:
+            tracemalloc.start()
+        try:
+            kube = KubeCluster(clock=FakeClock())
+            invariants.MONITOR.arm(kube, clock=kube.clock)  # trace_memory=False
+            for _ in range(4):
+                kube.clock.step(10.0)
+                invariants.MONITOR.sample()
+            assert invariants.MONITOR.report()["rss_growth_slope"] is None
+            # and disarm must not stop a session the monitor never started
+            invariants.MONITOR.disarm()
+            assert tracemalloc.is_tracing()
+        finally:
+            if started_here and tracemalloc.is_tracing():
+                tracemalloc.stop()
+
+    def test_double_launch_witness_folds_in(self):
+        class FakeBackend:
+            def double_launches(self):
+                return 2
+
+        kube = KubeCluster(clock=FakeClock())
+        invariants.MONITOR.arm(kube, backend=FakeBackend(), clock=kube.clock)
+        invariants.MONITOR.sample()
+        assert any(v["invariant"] == "cloud.double-launch" for v in invariants.MONITOR.violations())
+
+    def test_disarmed_monitor_samples_nothing(self):
+        assert invariants.MONITOR.sample() is None
+        report = invariants.MONITOR.report()
+        assert report["armed"] is False
+
+    def test_stale_owner_cannot_disarm_a_successors_window(self):
+        """Two armed windows in one process (a restart cycle, a standby
+        runtime): the first owner's teardown must not tear down the second
+        owner's live window."""
+        kube_a, kube_b = KubeCluster(clock=FakeClock()), KubeCluster(clock=FakeClock())
+        gen_a = invariants.MONITOR.arm(kube_a, clock=kube_a.clock)
+        gen_b = invariants.MONITOR.arm(kube_b, clock=kube_b.clock)
+        assert gen_b > gen_a
+        invariants.MONITOR.disarm(gen_a)  # the stale owner: a no-op
+        assert invariants.MONITOR.armed() is True
+        assert invariants.MONITOR.sample() is not None
+        invariants.MONITOR.disarm(gen_b)  # the live owner ends its window
+        assert invariants.MONITOR.armed() is False
+
+    def test_census_prunes_dead_threads_per_owner(self):
+        """A flapping leader registers a fresh short-lived thread per
+        regain; the census must not hoard the dead Thread objects until
+        shutdown (it would be the slow leak it exists to catch)."""
+        for i in range(30):
+            thread = threading.Thread(target=lambda: None, name=f"flap-{i}", daemon=True)
+            invariants.CENSUS.register("owner-flap", thread)
+            thread.start()
+            thread.join(timeout=5)
+        live = threading.Event()
+        keeper = threading.Thread(target=lambda: live.wait(timeout=10), name="flap-live", daemon=True)
+        invariants.CENSUS.register("owner-flap", keeper)
+        keeper.start()
+        with invariants.CENSUS._lock:
+            retained = len(invariants.CENSUS._owners["owner-flap"])
+        assert retained <= 2, f"census retained {retained} thread objects for one owner"
+        live.set()
+        keeper.join(timeout=5)
+        assert invariants.CENSUS.release("owner-flap") == []
+
+
+class TestInvariantsRoute:
+    def test_route_descriptions_match_routes(self):
+        assert set(invariants.route_descriptions()) == set(invariants.routes())
+
+    def test_served_over_the_metrics_listener(self):
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.observability import ObservabilityServer, debug_index_route
+
+        kube = KubeCluster(clock=FakeClock())
+        invariants.MONITOR.arm(kube, clock=kube.clock)
+        kube.watch("Pod", lambda event: None, replay=False)  # a live leak to serve
+        routes = dict(invariants.routes())
+        routes["/debug"] = debug_index_route(invariants.route_descriptions())
+        server = ObservabilityServer(
+            healthy=lambda: True, ready=lambda: True, health_port=None, metrics_port=0,
+            host="127.0.0.1", registry=Registry(), extra_routes=routes,
+        )
+        server.start()
+        (port,) = server.ports
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/invariants", timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+            assert payload["armed"] is True
+            assert payload["leaked_watches"] == 1  # the route samples a fresh round
+            assert payload["violations"][0]["invariant"] == "watches.leak"
+            assert "census" in payload
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/debug", timeout=5) as resp:
+                index = json.loads(resp.read().decode())
+            assert [e["path"] for e in index["endpoints"]] == ["/debug/invariants"]
+        finally:
+            server.stop()

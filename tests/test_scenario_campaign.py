@@ -75,6 +75,13 @@ class TestSchemaValidator:
                         "kube_faults_injected": 0,
                         "informer_divergences": 0,
                         "double_launches": 0,
+                        "leaked_threads": 0,
+                        "leaked_watches": 0,
+                        "rss_growth_slope": None,
+                        "invariant_violations": 0,
+                        "chaos_injected_total": 0,
+                        "chaos_history_digest": None,
+                        "compressed_seconds": 1.0,
                         "waterfall": {
                             "queue_wait": {"p50": 0.0, "p95": 0.01, "p99": 0.01, "count": 4},
                             "solve": {"p50": 0.02, "p95": 0.03, "p99": 0.03, "count": 4},
@@ -152,6 +159,29 @@ class TestSchemaValidator:
             doc = self._valid_doc()
             doc["runs"][0]["scores"][key] = "lots"
             assert any(key in e for e in scenario_doc_errors(doc)), key
+
+    def test_invariant_and_chaos_scores_required_and_typed(self):
+        # the leak-witness + orchestrator keys are schema-gated on ALL runs
+        for key in ("leaked_threads", "leaked_watches", "invariant_violations", "chaos_injected_total"):
+            doc = self._valid_doc()
+            del doc["runs"][0]["scores"][key]
+            assert any(key in e for e in scenario_doc_errors(doc)), key
+            doc = self._valid_doc()
+            doc["runs"][0]["scores"][key] = "lots"
+            assert any(key in e for e in scenario_doc_errors(doc)), key
+        # the heap slope is nullable and may be NEGATIVE (a shrinking heap)
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["rss_growth_slope"] = -12.5
+        assert scenario_doc_errors(doc) == []
+        doc["runs"][0]["scores"]["rss_growth_slope"] = "steep"
+        assert any("rss_growth_slope" in e for e in scenario_doc_errors(doc))
+        # the schedule digest is nullable but never empty
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["chaos_history_digest"] = ""
+        assert any("chaos_history_digest" in e for e in scenario_doc_errors(doc))
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["compressed_seconds"] = -1.0
+        assert any("compressed_seconds" in e for e in scenario_doc_errors(doc))
 
     def test_waterfall_scores_gated(self):
         # the waterfall block is required, keyed by the segment vocabulary,
@@ -240,6 +270,19 @@ def test_smoke_campaign_emits_valid_scored_artifact(tmp_path, transport):
     assert scores["informer_divergences"] == 0
     assert scores["double_launches"] == 0
     assert isinstance(scores["kube_conflicts_total"], int) and scores["kube_conflicts_total"] >= 0
+    # invariant monitor: a healthy smoke run leaks nothing — the thread
+    # census released every runtime thread, the watch count matched the
+    # armed baseline, and no witness (rings, locks, coherence, tokens)
+    # confirmed a violation; memory is untraced outside the soak tier
+    assert scores["leaked_threads"] == 0
+    assert scores["leaked_watches"] == 0
+    assert scores["invariant_violations"] == 0
+    assert scores["rss_growth_slope"] is None
+    # no chaos schedule ran: injected counts only plan-driven faults (zero
+    # here), the digest is null, and compressed time is just wall time
+    assert scores["chaos_injected_total"] == 0
+    assert scores["chaos_history_digest"] is None
+    assert scores["compressed_seconds"] > 0
     # every scenario run provisions, so the solve-latency summary must have
     # observed real solves: non-null on EVERY run, not merely well-typed
     assert scores["solver_latency_p95_seconds"] is not None
