@@ -80,6 +80,7 @@ GRID_BASE: Dict[str, int] = {
     "types_padded": 128,
     "sizes_padded": 16,
     "views_padded": 128,
+    "dirty_padded": 8,  # rebase delta axis, pow2 ladder (ops/rebase.py)
 }
 GRID_ALT: Dict[str, int] = {
     **GRID_BASE,
@@ -98,6 +99,7 @@ GRID_ALT: Dict[str, int] = {
     "types_padded": 256,
     "sizes_padded": 24,
     "views_padded": 256,
+    "dirty_padded": 16,
 }
 
 
@@ -252,6 +254,22 @@ def default_entries() -> Tuple[EntrySpec, ...]:
                 ArgSpec("head_t", ("resources", "views_padded"), f32),
             ),
             static_args=(("interpret", True),),
+            varying=("pods",),
+        ),
+        EntrySpec(
+            name="rebase_view_state",
+            module="karpenter_tpu/ops/rebase.py",
+            resolve=_resolve_plain(ops + "rebase", "rebase_view_state"),
+            args=(
+                ArgSpec("buf", ("views_padded", "resources"), f32),
+                ArgSpec("perm", ("views_padded",), i32),
+                ArgSpec("rows", ("dirty_padded", "resources"), f32),
+                ArgSpec("idx", ("dirty_padded",), i32),
+            ),
+            # the delta axes (views_padded lane pad, dirty_padded pow2
+            # ladder) are padded-stable by construction; like
+            # warm_fill_counts, their rare regrowth re-traces on shapes the
+            # signature can only express through the batch axis
             varying=("pods",),
         ),
         EntrySpec(

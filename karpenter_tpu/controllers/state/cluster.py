@@ -21,6 +21,7 @@ from ...analysis import WITNESS, guarded_by, requires_lock
 from ...api import labels as lbl
 from ...api.objects import Node, Pod
 from ...cloudprovider.types import CloudProvider
+from ...ir import delta as ir_delta
 from ...kube.cluster import ADDED, DELETED, MODIFIED, KubeCluster, WatchEvent
 from ...scheduling.hostports import HostPortUsage
 from ...scheduling.volumelimits import VolumeCount, VolumeLimits, limits_from_csi_node
@@ -120,6 +121,12 @@ class Cluster:
         self._last_node_deletion = 0.0
         self._last_node_creation = 0.0
         self._node_deletion_seq = 0  # guards the lock-free node prefetch
+        # per-node delta feed for the incremental solve engine
+        # (solver/incremental.py): every mutation that can change a node's
+        # schedulable surface records the node name here. The journal has
+        # its own LEAF lock (ir/delta.py) — recording under self._lock is
+        # the intended pattern, never the other order
+        self.delta_journal = ir_delta.DeltaJournal()
         kube.watch("Node", self._on_node_event)
         kube.watch("Pod", self._on_pod_event)
 
@@ -140,6 +147,7 @@ class Cluster:
                 self._nodes.pop(node.name, None)
                 self._last_node_deletion = self.clock.now()
                 self._node_deletion_seq += 1
+                self.delta_journal.record(node.name, ir_delta.NODE_REMOVED)
                 self._bump_epoch()
                 return
             self._update_node(node)
@@ -158,6 +166,9 @@ class Cluster:
         if existing is None:
             self._last_node_creation = self.clock.now()
         self._nodes[node.name] = state
+        # a refresh dirties the row the same as a launch: labels/allocatable
+        # may have changed under it (NODE_ADDED covers first-seen AND reseen)
+        self.delta_journal.record(node.name, ir_delta.NODE_ADDED)
         self._bump_epoch()
 
     @requires_lock
@@ -245,6 +256,7 @@ class Cluster:
         self._pods[key] = pod
         if podutils.has_required_pod_anti_affinity(pod):
             self._anti_affinity_pods[key] = pod
+        self.delta_journal.record(new_node, ir_delta.POD_BOUND)
         state = self._nodes.get(new_node)
         if state is None:
             # bound to a node we haven't seen: use the node fetched before the
@@ -295,6 +307,7 @@ class Cluster:
                     state.daemonset_limits = res.subtract(state.daemonset_limits, limits or {})
             state.host_port_usage.delete_pod(stored.uid)
             state.volume_usage.delete_pod(stored.uid)
+        self.delta_journal.record(node_name, ir_delta.POD_REMOVED)
         self._bump_epoch()
 
     # -- read interface --------------------------------------------------------
@@ -386,6 +399,10 @@ class Cluster:
         handlers registered replay=False) so a successor process starts
         from the API's truth, not a partial mirror. Idempotent: nodes/pods
         already mirrored are refreshed in place. Returns objects ingested."""
+        # a re-list may fold in mutations the watch never delivered (that is
+        # its whole point); no incremental reader can enumerate that delta,
+        # so invalidate every outstanding checkpoint up front
+        self.delta_journal.mark_gap()
         count = 0
         for node in self.kube.list_nodes():
             with self._lock:

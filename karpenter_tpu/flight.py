@@ -312,6 +312,15 @@ class FlightRecorder:
         except Exception as exc:  # noqa: BLE001 - per-fn attribution is best-effort
             log.warning("solver jit entries unavailable; compiles will count as 'other': %r", exc)
         try:
+            from .ops import rebase
+
+            # the incremental engine's donated delta kernel: its padded
+            # stable shapes are exactly what the zero-steady-state-recompile
+            # gate pins, so it MUST be attributable by name
+            self.register_jit_entry("rebase_view_state", rebase.rebase_view_state)
+        except Exception as exc:  # noqa: BLE001 - per-fn attribution is best-effort
+            log.warning("rebase jit entry unavailable: %r", exc)
+        try:
             from .ops import pallas_kernels
 
             self.register_jit_entry("bucket_type_cost_pallas", pallas_kernels._bucket_type_cost_padded)

@@ -167,6 +167,14 @@ class Summary(Histogram):
             return math.nan
         return samples[min(len(samples) - 1, int(q * len(samples)))]
 
+    def observations(self, **labels) -> List[float]:
+        """The retained raw observations, in arrival order (bounded by
+        MAX_SAMPLES with oldest-half eviction) — the windowed-quantile
+        surface campaign flatness scores read."""
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            return list(self._samples.get(key, ()))
+
     def collect(self):
         with self._lock:
             keys = list(self._totals)

@@ -1,0 +1,102 @@
+"""Rebase kernel: in-place delta application for the resident view surface.
+
+The incremental engine (solver/incremental.py) keeps the warm-view headroom
+matrix `head0` device-resident across provision passes.  Between passes the
+cluster shifts under it — nodes appear and vanish (rows come and go) and
+pods bind/unbind (surviving rows change values).  This kernel rebases the
+prior pass's buffer into the current pass's layout in ONE fused dispatch:
+
+    out[v] = rows[j]            if v is dirty (idx[j] == v)
+    out[v] = buf[perm[v]]       if v survived (perm[v] is its old row)
+    out[v] = -1.0               if v is new-but-clean padding (perm[v] < 0)
+
+`buf` is DONATED (donate_argnums=0): the prior pass's device buffer is
+consumed and its storage reused for the output, so steady-state residency
+costs one buffer, not two — the same `donate_argnums` lifecycle the sharded
+solve step uses for its carry (SNIPPETS [2], PR 11).  The contracts suite
+byte-audits that donation (out and buf agree in size/dtype by contract).
+
+Shapes are PADDED STABLE so steady state never recompiles: the view axis
+pads to the lane multiple (128, only regrowing when the cluster outgrows
+the pad), and the dirty axis pads on a pow2 ladder from 8 — a tick that
+dirties 3 rows and one that dirties 7 share the Dp=8 entry.  Padding is
+encoded in-band: padded idx slots point past the buffer (`mode="drop"`
+makes the scatter a no-op) and padded perm slots are -1 (gather yields the
+-1.0 dead-row sentinel, matching encode_warm_views' unusable-view rows).
+
+f32 only — this is the same surface ops/warmfill.py consumes, and its
+upper-bound slack discipline (counts pruned on device, placements re-derived
+exactly on host) already absorbs f32 rounding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANE = 128
+_DIRTY_BASE = 8
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pad_views(n: int) -> int:
+    """View-axis pad: lane multiple, minimum one lane."""
+    return _ceil_to(max(n, 1), _LANE)
+
+
+def pad_dirty(n: int) -> int:
+    """Dirty-axis pad: pow2 ladder from 8, so per-tick delta sizes collapse
+    onto a handful of compiled shapes."""
+    p = _DIRTY_BASE
+    while p < n:
+        p *= 2
+    return p
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rebase_view_state(buf: jax.Array, perm: jax.Array, rows: jax.Array, idx: jax.Array) -> jax.Array:
+    """Fused gather-by-perm + scatter-dirty on a donated buffer.
+
+    buf:  [Vp, R] f32  prior resident surface (DONATED)
+    perm: [Vp]    i32  old row index per new row, -1 = no prior row
+    rows: [Dp, R] f32  recomputed values for the dirty rows
+    idx:  [Dp]    i32  destination row per dirty entry, >= Vp = padding
+    returns [Vp, R] f32 in buf's storage."""
+    gathered = jnp.where((perm >= 0)[:, None], buf[jnp.clip(perm, 0, None)], jnp.float32(-1.0))
+    return gathered.at[idx].set(rows, mode="drop")
+
+
+def rebase_view_state_np(buf: np.ndarray, perm: np.ndarray, rows: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Exact host reference for the differential/parity tests."""
+    out = np.where((perm >= 0)[:, None], buf[np.clip(perm, 0, None)], np.float32(-1.0))
+    keep = idx < out.shape[0]
+    out[idx[keep]] = rows[keep]
+    return out.astype(np.float32)
+
+
+def pack_rebase(
+    perm: np.ndarray,
+    rows: np.ndarray,
+    idx: np.ndarray,
+    vp: int,
+) -> tuple:
+    """Host-side padding: logical perm/rows/idx → ladder-padded device
+    operands. perm pads with -1 (dead rows), idx pads with `vp` (dropped by
+    the scatter), rows pads with -1.0 (never lands)."""
+    r = rows.shape[1] if rows.ndim == 2 else 0
+    d = idx.shape[0]
+    dp = pad_dirty(d)
+    perm_p = np.full(vp, -1, np.int32)
+    perm_p[: perm.shape[0]] = perm
+    idx_p = np.full(dp, vp, np.int32)
+    idx_p[:d] = idx
+    rows_p = np.full((dp, r), -1.0, np.float32)
+    if d:
+        rows_p[:d] = rows
+    return perm_p, rows_p, idx_p

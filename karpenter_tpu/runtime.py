@@ -134,8 +134,17 @@ class Runtime:
                 from .solver.dense import measure_dense_crossover
 
                 min_batch = measure_dense_crossover()
+            incremental = None
+            if self.options.solver_incremental:
+                # incremental solve engine (--solver-incremental): fed by the
+                # cluster state mirror's delta journal, so the engine and the
+                # views it rebases read the same source of truth
+                from .solver.incremental import IncrementalEngine
+
+                incremental = IncrementalEngine(self.cluster.delta_journal)
             self.dense_solver = DenseSolver(
-                min_batch=min_batch, hbm_budget_bytes=self.options.solver_hbm_budget_bytes
+                min_batch=min_batch, hbm_budget_bytes=self.options.solver_hbm_budget_bytes,
+                incremental=incremental,
             )
         remote_solver = None
         if self.options.solver_service_address:

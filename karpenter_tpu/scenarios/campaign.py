@@ -228,6 +228,46 @@ def _solver_latency_p95():
     return None if math.isnan(value) else round(value, 6)
 
 
+# flatness bound the soak settled predicate enforces when the incremental
+# engine is on: the second-half solve p95 may not exceed twice the
+# first-half p95 — O(delta) steady state means latency tracks the per-tick
+# delta, not the grown cluster (generous enough for CPU-sim timing noise,
+# far below the drift a per-pass full re-encode of a growing cluster shows)
+SOAK_P95_FLATNESS_BOUND = 2.0
+
+
+def _solver_latency_p95_flatness():
+    """Late/early solve-latency ratio this run: p95 of the second half of
+    the real Scheduler.solve observations over p95 of the first half. ~1.0
+    means flat — the incremental engine's O(delta) steady-state claim as
+    the cluster grows at fixed per-tick delta. None when the run solved too
+    little to window (fewer than 8 observations)."""
+    obs = flight.SOLVE_LATENCY.observations()
+    if len(obs) < 8:
+        return None
+
+    def p95(values):
+        ordered = sorted(values)
+        return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+
+    early, late = p95(obs[: len(obs) // 2]), p95(obs[len(obs) // 2 :])
+    if early <= 0.0:
+        return None
+    return round(late / early, 4)
+
+
+def _incremental_delta_passes() -> int:
+    """Process-wide count of incremental-engine delta passes — provision
+    passes whose encode+fill the resident state skipped
+    (solver/incremental.py). run_one snapshots this at start and scores the
+    run's delta as `encode_skipped_passes`; reads through the registry so
+    a host-loop run that never imported the engine scores 0."""
+    from ..metrics import REGISTRY
+
+    counter = REGISTRY.get("karpenter_solver_incremental_passes_total")
+    return int(counter.value(kind="delta")) if counter is not None else 0
+
+
 def breaker_reclosed(ctx: ScenarioContext) -> bool:
     """The device-fault-storm convergence bar: at least one planned fault
     fired (the plan carries one spec per dispatch flavor, so only the active
@@ -291,7 +331,7 @@ def watch_gap_settled(ctx: ScenarioContext) -> bool:
     return gap_ends >= 2 and compactions >= 1
 
 
-def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule) -> bool:
+def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule, require_delta_passes: int = 0) -> bool:
     """The soak convergence bar: the chaos schedule fully delivered (a run
     the weather never reached proves nothing), the solver breaker re-closed
     (a fault storm that permanently abandoned the device path is not
@@ -301,6 +341,23 @@ def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule) -> bool:
         return False
     if solver_faults.BREAKER.state != solver_faults.STATE_CLOSED:
         return False
+    if getattr(ctx.runtime.options, "solver_incremental", False):
+        # the soak tier runs the incremental engine: settling additionally
+        # requires that it ENGAGED (delta passes this run — a soak where
+        # every pass fell back to a full re-encode would pass the invariant
+        # bar while silently losing the O(delta) property) and that solve
+        # latency stayed FLAT as the cluster grew (late-half p95 within
+        # SOAK_P95_FLATNESS_BOUND of the early half; None = too few solves
+        # to window). The engagement floor is per-scenario: the full soak
+        # grows a cluster where delta passes MUST dominate, while the
+        # mini-soak's 1-2-view cluster legitimately rides the bulk-
+        # fallback fulls (its dirty fraction can never sit under the
+        # threshold), so it pins only the flatness bound
+        if _incremental_delta_passes() - ctx.incremental_delta_at_start < require_delta_passes:
+            return False
+        flat = _solver_latency_p95_flatness()
+        if flat is not None and flat > SOAK_P95_FLATNESS_BOUND:
+            return False
     return not invariants.MONITOR.violations()
 
 
@@ -425,6 +482,10 @@ class CampaignRunner:
                     # other scenarios keep the host loop
                     dense_solver_enabled=scenario.dense_solver,
                     dense_min_batch=1,
+                    # the soak tier additionally runs the incremental solve
+                    # engine (solver/incremental.py): settling then requires
+                    # delta passes taken + a flat solve-latency p95
+                    solver_incremental=scenario.solver_incremental,
                     solver_breaker_threshold=scenario.solver_breaker_threshold,
                     solver_breaker_backoff=scenario.solver_breaker_backoff,
                     solver_hbm_budget_bytes=scenario.solver_hbm_budget_bytes,
@@ -470,6 +531,11 @@ class CampaignRunner:
         violations = 0
         launch_failures_at_start = _launch_failures_total()
         recompiles_at_start = flight.FLIGHT.compilations_total()
+        # incremental-engine pass counters are process-lifetime monotonic
+        # (a prior incremental run in the same process would pre-satisfy
+        # the soak engaged bar) — stamp run-start and score the delta
+        incremental_delta_at_start = _incremental_delta_passes()
+        ctx.incremental_delta_at_start = incremental_delta_at_start
         start = time.monotonic()
         try:
             # control-plane fault domain (kube/chaos.py): the seeded
@@ -571,6 +637,12 @@ class CampaignRunner:
                     "unschedulable_pod_seconds": _unschedulable_pod_seconds(samples),
                     "recompiles_total": flight.FLIGHT.compilations_total() - recompiles_at_start,
                     "solver_latency_p95_seconds": _solver_latency_p95(),
+                    # incremental-engine engagement + the O(delta) flatness
+                    # witness (late/early p95 ratio; None when the run
+                    # solved too little to window) — scored on every run,
+                    # asserted by the soak settled predicate
+                    "encode_skipped_passes": int(_incremental_delta_passes() - incremental_delta_at_start),
+                    "solver_latency_p95_flatness": _solver_latency_p95_flatness(),
                     "waterfall": journal.JOURNAL.segment_quantiles(),
                     "solver_faults_total": int(solver_faults.faults_total() - faults_at_start),
                     "degraded_solves_total": int(solver_faults.degraded_total() - degraded_at_start),
@@ -667,6 +739,9 @@ class CampaignRunner:
                 "nodes": len(nodes),
                 "cost_per_hour": round(slo.CLUSTER_COST.value(), 6),
                 "disrupting": in_flight,
+                # informational: the rolling solve p95 at this sample — the
+                # timeline behind the scored flatness ratio
+                "solver_p95": _solver_latency_p95(),
             }
         )
         return 1 if violated else 0
@@ -973,9 +1048,12 @@ def chaos_soak_scenario(seed: int = 11) -> Soak:
         compressed_span=4500.0,
         instance_types=["general-4x8"],
         dense_solver=True,  # the solver seam must sit under real dispatch
+        # device-resident incremental engine under the chaos weather: the
+        # settled predicate then also demands delta passes + flat p95
+        solver_incremental=True,
         fault_specs=schedule.solver_specs(),
         kube_fault_specs=schedule.kube_specs(),
-        settled=functools.partial(soak_settled, schedule=schedule),
+        settled=functools.partial(soak_settled, schedule=schedule, require_delta_passes=1),
         primitives=[trace, schedule],
         description=(
             "the soak tier: 75 compressed minutes of diurnal load replayed 150x under a "
@@ -1022,6 +1100,7 @@ def mini_soak_scenario(seed: int = 5, extra_events: Optional[List[dict]] = None)
         compressed_span=60.0,
         instance_types=["general-4x8"],
         dense_solver=True,
+        solver_incremental=True,  # same engine wiring as the full soak
         fault_specs=schedule.solver_specs(),
         kube_fault_specs=schedule.kube_specs(),
         settled=functools.partial(soak_settled, schedule=schedule),

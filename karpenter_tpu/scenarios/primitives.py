@@ -50,6 +50,9 @@ class ScenarioContext:
         # score this run's delta, not the absolute (a prior run in the same
         # process would pre-satisfy the bar)
         self.solver_chunked_at_start = 0
+        # same run-start stamping for the incremental engine's monotonic
+        # delta-pass counter (the soak settled predicate scores the delta)
+        self.incremental_delta_at_start = 0
         self.stop = threading.Event()
         self._lock = threading.Lock()
         self._desired = 0
@@ -360,6 +363,13 @@ class Scenario:
     # --solver-breaker-threshold / --solver-breaker-backoff /
     # --solver-hbm-budget runtime flags on the scenario's timescale.
     dense_solver: bool = False
+    # incremental solve engine (solver/incremental.py, --solver-incremental):
+    # the scenario's Runtime keeps the warm-view encoding device-resident
+    # across provision passes and applies journal deltas in place. The soak
+    # tier runs with it ON — its settled predicate then requires the engine
+    # to have ENGAGED (delta passes taken) and the solve-latency p95 to stay
+    # FLAT as the cluster grows at fixed per-tick delta
+    solver_incremental: bool = False
     fault_specs: Optional[List[dict]] = None
     # seed fan-out (utils/seeds.py): `seed` is the ONE master knob — the
     # solver fault seed, the kube fault seed, the stand-in's jitter, and a
@@ -415,6 +425,7 @@ class Scenario:
             "consolidation": self.consolidation,
             "offering_ttl": self.offering_ttl,
             "dense_solver": self.dense_solver,
+            "solver_incremental": self.solver_incremental,
             "fault_specs": self.fault_specs,
             "fault_seed": self.fault_seed,
             "solver_breaker_threshold": self.solver_breaker_threshold,

@@ -40,6 +40,13 @@ SCORE_KEYS = (
     # Scheduler.solve wall-clock (null when the run solved nothing)
     "recompiles_total",
     "solver_latency_p95_seconds",
+    # incremental-engine scores (solver/incremental.py): provision passes
+    # whose full encode the device-resident state skipped this run (0 on
+    # every non-incremental scenario), and the late/early solve-latency
+    # p95 ratio (null when the run solved too little to window) — ~1.0 is
+    # the O(delta) steady-state witness the soak settled predicate asserts
+    "encode_skipped_passes",
+    "solver_latency_p95_flatness",
     # the pending-latency waterfall (journal.py): per-segment p50/p95/p99
     # decomposing creation->bind into queue_wait / batch_wait / solve /
     # launch / node_ready / bind — the runner asserts the conservation
@@ -134,6 +141,7 @@ def run_errors(run, where: str = "run") -> List[str]:
             "recompiles_total", "solver_faults_total", "degraded_solves_total", "solver_faults_injected",
             "kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches",
             "leaked_threads", "leaked_watches", "invariant_violations", "chaos_injected_total",
+            "encode_skipped_passes",
         ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
@@ -147,6 +155,9 @@ def run_errors(run, where: str = "run") -> List[str]:
         p95 = scores.get("solver_latency_p95_seconds")
         if p95 is not None and (not isinstance(p95, (int, float)) or isinstance(p95, bool) or p95 < 0):
             errs.append(f"{where}.scores.solver_latency_p95_seconds must be null or a non-negative number")
+        flat = scores.get("solver_latency_p95_flatness")
+        if flat is not None and (not isinstance(flat, (int, float)) or isinstance(flat, bool) or flat < 0):
+            errs.append(f"{where}.scores.solver_latency_p95_flatness must be null or a non-negative number")
         slope = scores.get("rss_growth_slope")
         if slope is not None and (not isinstance(slope, (int, float)) or isinstance(slope, bool)):
             # negative is legal (a heap that SHRANK over the window); only

@@ -195,6 +195,15 @@ class DenseSolveStats:
     # this splits device-link time from host work — the attribution the r5
     # headline-drift bisect ask needed and the artifacts couldn't give
     assemble_seconds: float = 0.0
+    # incremental engine (solver/incremental.py) assembly split: delta
+    # passes rebase the resident encoding in O(changes) (delta_apply);
+    # full passes rebuild it from scratch (full_encode — cold start,
+    # catalog change, journal gap, fault invalidation, bulk churn).
+    # encode_skipped_passes counts the delta passes: solves whose warm-view
+    # encode never ran because the resident mirror stood in for it
+    delta_apply_seconds: float = 0.0
+    full_encode_seconds: float = 0.0
+    encode_skipped_passes: int = 0
     # offering-availability mask application (subset of device_seconds): the
     # [T, Z, C] cube reduced over per-bucket zone/ct allowances as one
     # batched device matmul — quarantined pools are routed around here, and
@@ -260,10 +269,18 @@ class DenseSolver:
         peer_fabric=None,
         hbm_budget_bytes: int = 0,
         use_mesh: bool = True,
+        incremental=None,
     ):
         self.min_batch = min_batch
         self.num_slots = num_slots
         self.stats = DenseSolveStats()
+        # incremental solve engine (solver/incremental.py, --solver-
+        # incremental): keeps the warm-view encoding + device headroom
+        # surface resident across passes and applies the cluster journal's
+        # delta instead of re-encoding; None = fresh-encode every pass.
+        # Simulation re-solves (consolidation what-ifs run against
+        # hypothetical state with no journal feed) always bypass it.
+        self.incremental = incremental
         # solver fault domain (faults.py): pre-solve HBM pressure budget —
         # when the flight recorder's HBM-peak gauge exceeds this many bytes
         # the dispatch surface chunks pre-emptively (--solver-hbm-budget;
@@ -385,6 +402,13 @@ class DenseSolver:
         if not BREAKER.admit(simulation=sim):
             if not sim:
                 DEGRADED_SOLVES.inc(rung=RUNG_HOST)
+                # an OPEN breaker voids the incremental resident state: the
+                # device is suspect, passes are host-routed while it heals,
+                # and the journal checkpoint goes stale meanwhile — the
+                # first re-admitted pass must be a clean full re-encode
+                # (satellite pin: tests/test_incremental_faults.py)
+                if self.incremental is not None:
+                    self.incremental.invalidate("fault-breaker")
                 if JOURNAL.enabled:
                     JOURNAL.solver_event("dense", "degraded", rung=RUNG_HOST, reason="breaker-open")
             return pods
@@ -409,10 +433,16 @@ class DenseSolver:
 
         assemble_before = self.stats.assemble_seconds  # delta -> this solve's assemble child span
         mask_before = self.stats.mask_seconds  # delta -> this solve's mask child span
+        delta_before = self.stats.delta_apply_seconds  # incremental split of the assemble story
+        full_before = self.stats.full_encode_seconds
         t0 = time.perf_counter()
         zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
         capacity_types = scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ())
         ckey = catalog_key(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
+        # the incremental engine keys resident-state validity on this same
+        # catalog key (_fill_existing): a catalog/provisioner bump is a
+        # legitimate full-re-encode trigger, attributed 'catalog'
+        self._solve_ckey = ckey
         entry = self._catalog_encodings.get(ckey)
         if entry is None:
             catalog = encode_catalog(scheduler.node_templates, scheduler.instance_types, zones, capacity_types)
@@ -563,6 +593,8 @@ class DenseSolver:
                     "assemble": stats.assemble_seconds - assemble_before,
                     "commit": stats.commit_seconds - stats_before.commit_seconds,
                     "fill_device": stats.fill_device_seconds - stats_before.fill_device_seconds,
+                    "delta_apply": stats.delta_apply_seconds - delta_before,
+                    "full_encode": stats.full_encode_seconds - full_before,
                 },
                 fill_routing={
                     "fills_vectorized": stats.fills_vectorized - stats_before.fills_vectorized,
@@ -1200,7 +1232,26 @@ class DenseSolver:
         from . import warmfill
 
         fill_items = sum(len(b.pod_rows) for b in buckets) + len(extra_pods)
-        fill_plan = warmfill.plan(scheduler, problem, buckets, extra_pods=extra_pods)
+        enc = None
+        if self.incremental is not None and not scheduler.opts.simulation_mode:
+            # incremental engine (solver/incremental.py): advance the
+            # resident warm-view state by the cluster journal's delta — a
+            # delta pass hands back a byte-equal encoding with the O(cluster)
+            # encode skipped and the device headroom surface already
+            # resident; a full pass rebuilds it (attributed by reason).
+            # Simulation re-solves bypass: hypothetical views have no
+            # journal feed and must not clobber the real resident state.
+            from .incremental import PASS_DELTA, PASS_FULL
+
+            adv = self.incremental.advance(scheduler.existing_nodes, getattr(self, "_solve_ckey", ()))
+            if adv.kind == PASS_DELTA:
+                self.stats.delta_apply_seconds += adv.seconds
+                self.stats.encode_skipped_passes += 1
+                enc = adv.enc
+            elif adv.kind == PASS_FULL:
+                self.stats.full_encode_seconds += adv.seconds
+                enc = adv.enc
+        fill_plan = warmfill.plan(scheduler, problem, buckets, extra_pods=extra_pods, enc=enc)
         if fill_plan is not None:
             # commits rebind view.requests: the pre-fill freeness memo is
             # invalid from here on (same contract as the host loop)
@@ -1575,6 +1626,15 @@ class DenseSolver:
             return
         self._solve_rungs.append(rung)
         DEGRADED_SOLVES.inc(rung=rung)
+        # fault-domain interaction with the incremental engine: a flavor
+        # retirement or a host takeover mid-solve means device buffers may
+        # be stale, half-donated, or pinned to a retired path — void the
+        # resident state so the NEXT pass is a clean full re-encode
+        # (attributed fault-flavor / fault-host; pinned by
+        # tests/test_incremental_faults.py). Chunked dispatch is benign:
+        # the split surface still computed the same program on live buffers.
+        if self.incremental is not None and rung != RUNG_CHUNKED:
+            self.incremental.invalidate(f"fault-{rung}")
         if JOURNAL.enabled:
             JOURNAL.solver_event("dense", "degraded", rung=rung, **attrs)
 
@@ -1655,6 +1715,27 @@ class DenseSolver:
             return np.zeros((B, T), dtype=bool)
         pair = (zmask[:, :, None] & cmask[:, None, :]).reshape(B, Z * C).astype(np.float32)
         cube = avail.reshape(T, Z * C).astype(np.float32)
+        if self.incremental is not None:
+            # incremental residency for the availability cube: it is a pure
+            # function of the catalog, so under the engine it rides device-
+            # resident — only the [B, Z*C] pair matrix moves host->device
+            # per solve. Keyed by the IDENTITY of the catalog's avail array
+            # (held strongly here, so the id can never be recycled — the
+            # same id-reuse discipline as catalog_pin); a catalog change
+            # swaps the array object and naturally misses. Values are
+            # identical: the same f32 array, uploaded once per catalog.
+            cached = getattr(self, "_avail_cube_dev", None)
+            if cached is not None and cached[0] is avail:
+                cube = cached[1]
+            else:
+                try:
+                    import jax.numpy as jnp
+
+                    cube = jnp.asarray(cube)
+                    self._avail_cube_dev = (avail, cube)
+                except Exception as exc:  # noqa: BLE001 - residency is an optimization
+                    log.warning("availability-cube device upload failed; per-solve host cube: %r", exc)
+                    self._avail_cube_dev = None
         try:
             # one fused jitted program (registered flight/contract entry)
             # instead of the former eager asarray/matmul/compare chain; the
@@ -1666,7 +1747,7 @@ class DenseSolver:
             return np.asarray(availability_counts(pair, cube))
         except Exception as exc:  # noqa: BLE001 - the mask must never fail a solve
             log.warning("availability-mask device dispatch failed; numpy fallback: %r", exc)
-            return (pair @ cube.T) > 0.5
+            return (pair @ np.asarray(cube).T) > 0.5
 
     def _device_solve(self, scheduler, problem: DenseProblem, buckets: List[_Bucket], taken: Optional[np.ndarray] = None):
         """Bucket→type choice on device; packing via counts (see

@@ -1,0 +1,364 @@
+"""Incremental solve engine: device-resident cluster state, O(delta) passes.
+
+Every provision pass through round 5 re-encoded the ENTIRE cluster —
+`encode_warm_views` walks every existing view even when the pass only bound
+three pods, and `repack_16k` shows that host-side assembly (~40 ms encode +
+~850 ms fill against ~0 ms device) dominates end-to-end latency at
+production churn. CvxCluster (PAPERS.md) reports 100-1000x from exactly
+this reformulation: keep the encoded problem resident, apply the delta.
+
+The engine keeps three things alive across passes:
+
+  * a host mirror of the last pass's `WarmViewEncoding` plus the node-name →
+    row map that gives its rows identity across passes;
+  * the f32 headroom surface `head0` as a DEVICE buffer, padded to the lane
+    multiple, rebased in place each pass by `ops/rebase.rebase_view_state`
+    — the prior buffer is donated into the rebase (`donate_argnums`), so
+    steady-state residency costs one buffer and zero host->device
+    re-uploads of the unchanged rows;
+  * its checkpoint into the cluster `DeltaJournal` (ir/delta.py), the feed
+    that names the dirty rows.
+
+Each `advance()` classifies the pass:
+
+  delta   the journal covers the span since the checkpoint and the dirty
+          set is small: re-encode ONLY the dirty views (encode_warm_views
+          is row-independent, so the spliced mirror is byte-identical to a
+          fresh full encode), realign survivors by permutation, rebase the
+          device buffer in one fused donated dispatch.
+  full    anything that voids row identity or the journal window: cold
+          start, catalog-key change (`invalidate.catalog` — a catalog bump
+          can re-shape every row), journal gap/overflow (`invalidate.gap`),
+          view-pad regrowth, a forced fault invalidation (breaker opened,
+          flavor retired mid-solve — `invalidate.fault`), or a dirty set so
+          large the delta machinery would cost more than the full encode
+          (`invalidate.bulk`).
+  bypass  the incremental flag is on but there is nothing to manage (no
+          views); the caller runs the fresh path untouched.
+
+Correctness posture: the engine NEVER trusts resident values for a row the
+journal (or the previous pass — see below) named dirty; those rows are
+recomputed from the CURRENT views with the same f64 expressions as the
+fresh path, so the mirror is byte-equal by determinism, pinned every pass
+by tests/test_incremental_parity.py. A mutation that lands between the
+caller's views snapshot and the engine's epoch checkpoint is covered by the
+DOUBLE-WINDOW rule: every pass re-dirties the names the JOURNAL reported
+on the previous pass (the only rows whose recompute a concurrent mutation
+could have straddled), so a row encoded from a stale snapshot is re-encoded
+from a fresh one on the very next pass — exact in single-threaded use,
+one-pass-lag self-healing under concurrency. Rows re-encoded purely for
+healing leave the window immediately: the steady-state dirty set is bounded
+by two passes of churn, never cumulative.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence
+
+import numpy as np
+
+from ..ir.delta import DeltaJournal
+from ..ir.encode import WarmViewEncoding, encode_warm_views
+from ..metrics import REGISTRY
+
+log = logging.getLogger("karpenter_tpu.solver.incremental")
+
+# above this fraction of dirty rows the delta path costs more than it saves
+# (the splice is O(dirty) numpy + one padded dispatch; the full encode is
+# one O(V) vectorized pass) — and a half-churned cluster has no stable
+# steady state to protect anyway
+MAX_DIRTY_FRACTION = 0.5
+
+PASS_FULL = "full"
+PASS_DELTA = "delta"
+PASS_BYPASS = "bypass"
+
+INCREMENTAL_PASSES = REGISTRY.counter(
+    "karpenter_solver_incremental_passes_total",
+    "Incremental-engine provision passes by kind: 'delta' (resident state"
+    " rebased in place, encode skipped), 'full' (resident state rebuilt —"
+    " cold start, catalog change, journal gap, fault invalidation, or bulk"
+    " churn), 'bypass' (nothing to manage; fresh path untouched).",
+    ("kind",),
+)
+INCREMENTAL_INVALIDATIONS = REGISTRY.counter(
+    "karpenter_solver_incremental_invalidations_total",
+    "Resident-state invalidations forcing a full re-encode, by reason:"
+    " 'cold', 'catalog', 'gap', 'grow', 'bulk', or a fault seam"
+    " ('fault-breaker', 'fault-flavor').",
+    ("reason",),
+)
+
+
+@dataclass
+class _Resident:
+    """What survives between passes."""
+
+    epoch: int
+    ckey: tuple
+    enc: WarmViewEncoding
+    names: List[str]
+    row_of: Dict[str, int]
+    head_dev: object  # jax [Vp, R] f32, or None when device residency failed
+    vp: int
+    prev_dirty: FrozenSet[str] = frozenset()
+
+
+@dataclass
+class AdvanceResult:
+    """One pass's outcome: the encoding (byte-equal to a fresh
+    encode_warm_views over the same views), its attribution, and the time
+    the engine spent producing it (charged to delta_apply or full_encode
+    by the caller)."""
+
+    enc: Optional[WarmViewEncoding]
+    kind: str  # PASS_DELTA | PASS_FULL | PASS_BYPASS
+    reason: str  # "" for delta; invalidation reason for full
+    seconds: float
+    dirty_rows: int
+
+
+class IncrementalEngine:
+    """Per-solver resident-state manager. Not thread-safe: it lives inside
+    DenseSolver.presolve's single-threaded provisioning loop (the journal
+    it reads IS thread-safe — that is the concurrent edge)."""
+
+    def __init__(self, journal: DeltaJournal, max_dirty_fraction: float = MAX_DIRTY_FRACTION):
+        self.journal = journal
+        self.max_dirty_fraction = float(max_dirty_fraction)
+        self._resident: Optional[_Resident] = None
+        self._pending_invalidate: Optional[str] = None
+        # pass-kind tallies mirrored off the process-wide counters so tests
+        # and the bench can read one engine's history in isolation
+        self.passes: Dict[str, int] = {PASS_FULL: 0, PASS_DELTA: 0, PASS_BYPASS: 0}
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, reason: str) -> None:
+        """Void the resident state: the next pass is a clean full re-encode
+        attributed `invalidate.<reason>`. Called by the fault seams — an
+        open breaker or a mid-solve flavor retirement means device buffers
+        may be stale, donated-away, or sitting on a retired path."""
+        self._pending_invalidate = reason
+        self._resident = None
+
+    # -- the per-pass entry point -----------------------------------------
+
+    def advance(self, views: Sequence, ckey: tuple) -> AdvanceResult:
+        """Produce this pass's WarmViewEncoding from the resident state plus
+        the journal's delta, or rebuild it. `views` is the caller's
+        already-taken snapshot of scheduler.existing_nodes; `ckey` the
+        catalog key of this solve."""
+        t0 = time.perf_counter()
+        if not views:
+            # nothing resident to protect; drop state so a later non-empty
+            # pass starts clean rather than diffing against a stale map
+            self._resident = None
+            self._note(PASS_BYPASS)
+            return AdvanceResult(None, PASS_BYPASS, "", time.perf_counter() - t0, 0)
+
+        # checkpoint AFTER the views snapshot: over-dirtying (a mutation
+        # between snapshot and checkpoint lands in this window) is safe —
+        # the row is recomputed from the snapshot now and re-dirtied next
+        # pass by the double-window rule, which heals any staleness
+        epoch = self.journal.current_epoch()
+        names = [v.node.name for v in views]
+
+        reason = self._full_reason(names, ckey, epoch)
+        if reason is not None:
+            enc = self._rebuild(views, names, ckey, epoch, reason)
+            dt = time.perf_counter() - t0
+            self._note(PASS_FULL)
+            INCREMENTAL_INVALIDATIONS.inc(reason=reason)
+            return AdvanceResult(enc, PASS_FULL, reason, dt, len(views))
+
+        res = self._resident
+        assert res is not None
+        dirty_names = self._dirty_names  # set by _full_reason's probe
+        dirty_idx = [
+            i for i, n in enumerate(names) if n in dirty_names or n not in res.row_of
+        ]
+        enc = self._apply_delta(views, names, dirty_idx, epoch, ckey)
+        dt = time.perf_counter() - t0
+        self._note(PASS_DELTA)
+        return AdvanceResult(enc, PASS_DELTA, "", dt, len(dirty_idx))
+
+    # -- classification ----------------------------------------------------
+
+    def _full_reason(self, names: List[str], ckey: tuple, epoch: int) -> Optional[str]:
+        from ..ops.rebase import pad_views
+
+        self._dirty_names: FrozenSet[str] = frozenset()
+        self._journal_dirty: FrozenSet[str] = frozenset()
+        if self._pending_invalidate is not None:
+            reason, self._pending_invalidate = self._pending_invalidate, None
+            return reason
+        res = self._resident
+        if res is None:
+            return "cold"
+        if res.ckey != ckey:
+            return "catalog"
+        if res.head_dev is None:
+            # device residency failed last pass (transfer error); the host
+            # mirror alone cannot skip the device re-upload, so rebuild
+            return "cold"
+        dirty = self.journal.dirty_since(res.epoch)
+        if dirty is None:
+            return "gap"
+        if pad_views(len(names)) != res.vp:
+            return "grow"
+        dirty_all = dirty | res.prev_dirty
+        known = set(res.row_of)
+        touched = sum(1 for n in names if n in dirty_all or n not in known)
+        if touched > self.max_dirty_fraction * len(names):
+            return "bulk"
+        self._dirty_names = frozenset(dirty_all)
+        self._journal_dirty = frozenset(dirty)
+        return None
+
+    def _note(self, kind: str) -> None:
+        self.passes[kind] += 1
+        INCREMENTAL_PASSES.inc(kind=kind)
+
+    # -- full rebuild ------------------------------------------------------
+
+    def _rebuild(self, views: Sequence, names: List[str], ckey: tuple, epoch: int, reason: str) -> WarmViewEncoding:
+        enc = encode_warm_views(views)
+        head_dev, vp = self._upload(enc.head0)
+        self._resident = _Resident(
+            epoch=epoch,
+            ckey=ckey,
+            enc=enc,
+            names=names,
+            row_of={n: i for i, n in enumerate(names)},
+            head_dev=head_dev,
+            vp=vp,
+            prev_dirty=frozenset(),
+        )
+        self._attach(enc)
+        if reason != "cold":
+            log.info("incremental resident state invalidated (%s): full re-encode of %d views", reason, len(views))
+        return enc
+
+    def _upload(self, head0: np.ndarray):
+        """Fresh device residency: [V, R] f64 → padded [Vp, R] f32 device
+        buffer, -1.0 pad rows (the dead-row sentinel the rebase keeps)."""
+        from ..ops.rebase import pad_views
+
+        V, R = head0.shape
+        vp = pad_views(V)
+        padded = np.full((vp, R), -1.0, np.float32)
+        padded[:V] = head0.astype(np.float32)
+        try:
+            import jax.numpy as jnp
+
+            return jnp.asarray(padded), vp
+        except Exception as exc:  # noqa: BLE001 - residency is an optimization
+            log.warning("incremental device upload failed; host-only pass: %r", exc)
+            return None, vp
+
+    # -- delta application -------------------------------------------------
+
+    def _apply_delta(
+        self, views: Sequence, names: List[str], dirty_idx: List[int], epoch: int, ckey: tuple
+    ) -> WarmViewEncoding:
+        res = self._resident
+        assert res is not None
+        old = res.enc
+        V = len(views)
+
+        # survivor permutation: new row i ← old row perm[i] (or -1)
+        perm = np.fromiter((res.row_of.get(n, -1) for n in names), dtype=np.int32, count=V)
+        take = np.clip(perm, 0, None)
+        alive = perm >= 0
+
+        usable = old.usable[take] & alive
+        avail_tol = np.where(alive[:, None], old.avail_tol[take], 0.0)
+        requests0 = np.where(alive[:, None], old.requests0[take], 0.0)
+        head0 = np.where(alive[:, None], old.head0[take], -1.0)
+        zone = [old.zone[p] if p >= 0 else None for p in perm]
+        ct = [old.ct[p] if p >= 0 else None for p in perm]
+        hostname = [old.hostname[p] if p >= 0 else "" for p in perm]
+        taint_sig = [old.taint_sig[p] if p >= 0 else () for p in perm]
+
+        # dirty rows: recomputed from the CURRENT views with the exact fresh
+        # expressions (encode_warm_views is row-independent → byte-equal)
+        sub = encode_warm_views([views[i] for i in dirty_idx])
+        for j, i in enumerate(dirty_idx):
+            usable[i] = sub.usable[j]
+            avail_tol[i] = sub.avail_tol[j]
+            requests0[i] = sub.requests0[j]
+            head0[i] = sub.head0[j]
+            zone[i] = sub.zone[j]
+            ct[i] = sub.ct[j]
+            hostname[i] = sub.hostname[j]
+            taint_sig[i] = sub.taint_sig[j]
+
+        enc = WarmViewEncoding(
+            usable=usable,
+            avail_tol=avail_tol,
+            requests0=requests0,
+            head0=head0,
+            zone=zone,
+            ct=ct,
+            hostname=hostname,
+            taint_sig=taint_sig,
+        )
+
+        # device rebase: one fused donated dispatch moves survivors by
+        # permutation and scatters the dirty rows; the prior pass's buffer
+        # is consumed (donate_argnums) and its storage reused
+        head_dev = None
+        if res.head_dev is not None:
+            try:
+                import jax.numpy as jnp
+
+                from ..ops.rebase import pack_rebase, rebase_view_state
+
+                rows32 = sub.head0.astype(np.float32) if dirty_idx else np.zeros((0, head0.shape[1]), np.float32)
+                perm_p, rows_p, idx_p = pack_rebase(
+                    perm, rows32, np.asarray(dirty_idx, dtype=np.int32), res.vp
+                )
+                head_dev = rebase_view_state(
+                    res.head_dev, jnp.asarray(perm_p), jnp.asarray(rows_p), jnp.asarray(idx_p)
+                )
+            except Exception as exc:  # noqa: BLE001 - residency is an optimization
+                log.warning("incremental device rebase failed; host-only pass: %r", exc)
+                head_dev = None
+
+        # next pass's healing window: ONLY the rows the journal named this
+        # pass (plus rows new to the map) can have been encoded from a
+        # snapshot a concurrent mutation straddled. Rows re-encoded merely
+        # because they sat in the previous window are healed and must leave
+        # it — carrying all of dirty_idx would make the window transitively
+        # cumulative, growing every pass until it trips 'bulk' (and crossing
+        # dirty-pad rungs, retracing the rebase kernel, on the way)
+        prev = frozenset(
+            names[i]
+            for i in dirty_idx
+            if names[i] in self._journal_dirty or names[i] not in res.row_of
+        )
+        self._resident = _Resident(
+            epoch=epoch,
+            ckey=ckey,
+            enc=enc,
+            names=names,
+            row_of={n: i for i, n in enumerate(names)},
+            head_dev=head_dev,
+            vp=res.vp,
+            prev_dirty=prev,
+        )
+        self._attach(enc)
+        return enc
+
+    def _attach(self, enc: WarmViewEncoding) -> None:
+        """Carry the resident device buffer on the encoding so the warm-fill
+        admission surface (warmfill._device_counts) can dispatch against it
+        without a fresh host→device transfer."""
+        res = self._resident
+        if res is not None and res.head_dev is not None:
+            enc.head_dev = res.head_dev
+            enc.head_vp = res.vp

@@ -143,9 +143,14 @@ class WarmFillPlan:
         self.P = P
 
 
-def plan(scheduler, problem: DenseProblem, buckets, extra_pods: Sequence = ()) -> Optional[WarmFillPlan]:
+def plan(scheduler, problem: DenseProblem, buckets, extra_pods: Sequence = (), enc: Optional[WarmViewEncoding] = None) -> Optional[WarmFillPlan]:
     """Build the vectorized-fill plan, or None when any item falls outside
-    the certified common case (the caller then runs the host loop)."""
+    the certified common case (the caller then runs the host loop).
+
+    `enc` is an optional precomputed encoding of scheduler.existing_nodes —
+    the incremental engine (solver/incremental.py) passes its resident
+    mirror, byte-equal to a fresh encode_warm_views(views) by the engine's
+    parity contract, so a delta pass skips the O(cluster) encode here."""
     if os.environ.get(NO_VECTOR_ENV):
         return None
     if extra_pods:
@@ -161,7 +166,8 @@ def plan(scheduler, problem: DenseProblem, buckets, extra_pods: Sequence = ()) -
         if bucket.zone == "__infeasible__" or bucket.single_bin:
             return None
 
-    enc = encode_warm_views(views)
+    if enc is None or len(enc.hostname) != len(views):
+        enc = encode_warm_views(views)
     V = len(views)
     topology = scheduler.topology
     shared_inverse = topology.inverse_owner_index()
@@ -377,15 +383,25 @@ def _device_counts(plan_: WarmFillPlan, solver) -> Optional[np.ndarray]:
         # solve; a planned fault here exercises the prune-on-host fallback
         FAULTS.check("warmfill")
         sizes32 = plan_.sizes.astype(np.float32)
-        head32 = plan_.enc.head0.astype(np.float32)
+        head_dev = getattr(plan_.enc, "head_dev", None)
         if solver is not None and solver._pallas_enabled():
             from ..ops.warmfill import warm_fill_counts_pallas
 
-            counts = warm_fill_counts_pallas(sizes32, head32)
+            counts = warm_fill_counts_pallas(sizes32, plan_.enc.head0.astype(np.float32))
+        elif head_dev is not None:
+            # incremental resident surface (solver/incremental.py): the
+            # [Vp, R] f32 headroom buffer is already on device — dispatch
+            # against it with NO host->device re-upload and strip the pad
+            # columns (head -1.0 → base_ok false → count 0, the same dead-row
+            # rule as the pallas pad). Values are bit-identical to the fresh
+            # head0.astype(f32) path: the kernel is elementwise per (s, v)
+            from ..ops.warmfill import warm_fill_counts
+
+            counts = np.asarray(warm_fill_counts(sizes32, head_dev))[:, : len(plan_.views)]
         else:
             from ..ops.warmfill import warm_fill_counts
 
-            counts = np.asarray(warm_fill_counts(sizes32, head32))
+            counts = np.asarray(warm_fill_counts(sizes32, plan_.enc.head0.astype(np.float32)))
         if solver is not None:
             dt = time.perf_counter() - t0
             solver.stats.device_seconds += dt

@@ -134,6 +134,12 @@ class Options:
     # half-open recovery probe that re-admits the fast path on success
     solver_breaker_threshold: int = 3
     solver_breaker_backoff: float = 30.0
+    # incremental solve engine (solver/incremental.py): keep the warm-view
+    # encoding + device headroom surface resident across provision passes
+    # and apply the cluster state journal's delta instead of re-encoding —
+    # O(changes) steady state with byte-equal fallback to the fresh-encode
+    # path on catalog changes, journal gaps, and fault invalidations
+    solver_incremental: bool = False
 
     def validate(self) -> List[str]:
         errs = []
@@ -228,6 +234,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--solver-hbm-budget", dest="solver_hbm_budget_bytes", type=int, default=_env("SOLVER_HBM_BUDGET", defaults.solver_hbm_budget_bytes))
     parser.add_argument("--solver-breaker-threshold", type=int, default=_env("SOLVER_BREAKER_THRESHOLD", defaults.solver_breaker_threshold))
     parser.add_argument("--solver-breaker-backoff", type=float, default=_env("SOLVER_BREAKER_BACKOFF", defaults.solver_breaker_backoff))
+    parser.add_argument("--solver-incremental", dest="solver_incremental", action="store_true", default=_env("SOLVER_INCREMENTAL", defaults.solver_incremental))
     parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     parser.add_argument("--gc-interval", type=float, default=_env("GC_INTERVAL", defaults.gc_interval))

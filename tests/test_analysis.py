@@ -773,6 +773,7 @@ class TestRecompileContract:
             "bucket_type_cost", "bucket_type_cost_packed", "segment_usage",
             "audit_layout", "warm_fill_counts", "warm_fill_counts_pallas",
             "bucket_type_cost_pallas", "sharded_solve_step", "sharded_bucket_cost",
+            "rebase_view_state",
         }
         assert set(committed["entries"]) == expected
         for name, entry in committed["entries"].items():
@@ -784,11 +785,16 @@ class TestRecompileContract:
             )
 
     def test_sharded_step_donates_bin_ids(self, committed):
-        """The one legal donation the audit surfaced: sharded_solve_step's
-        [P] i32 scratch input aliases the equal-sized best_type output."""
+        """The two legal donations the audit surfaced: sharded_solve_step's
+        [P] i32 scratch input aliases the equal-sized best_type output, and
+        the rebase kernel consumes the prior pass's resident buffer in
+        place (the incremental engine's one-buffer steady state)."""
         entry = committed["entries"]["sharded_solve_step"]
         assert entry["donation"]["donated"] == ["bin_ids"]
         assert entry["donation"]["rejected"] == []
+        rebase = committed["entries"]["rebase_view_state"]
+        assert rebase["donation"]["donated"] == ["buf"]
+        assert rebase["donation"]["rejected"] == []
 
 
 class TestContractsBaselineRoundTrip:

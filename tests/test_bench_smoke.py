@@ -39,6 +39,17 @@ def test_bench_smoke():
     assert summary.pop("solver_faults_total") == 0
     assert summary.pop("degraded_solves_total") == 0
     assert summary.pop("breaker_state") == "closed"
+    # the incremental-engine steady-state gate ran with the full acceptance
+    # window: >= 10 consecutive delta passes, zero recompiles, every encode
+    # skipped, zero full-encode time (solver/incremental.py; the placement
+    # parity vs a fresh encode is asserted inside the run itself)
+    inc = summary.pop("incremental_churn")
+    assert inc["passes"] >= 10
+    assert inc["delta_passes"] == inc["passes"]
+    assert inc["encode_skipped_passes"] == inc["passes"]
+    assert inc["compilations"] == 0
+    assert inc["full_encode"] == 0.0
+    assert inc["delta_apply"] >= 0.0
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name

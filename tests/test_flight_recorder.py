@@ -128,7 +128,10 @@ class TestRecordContents:
         assert 0.0 <= record.padding_waste_pct < 100.0
         assert record.dispatch in ("plain", "pallas", "sharded")
         # every DenseSolveStats phase, mask included, as THIS solve's delta
-        assert set(record.phases) == {"encode", "fill", "device", "mask", "assemble", "commit", "fill_device"}
+        assert set(record.phases) == {
+            "encode", "fill", "device", "mask", "assemble", "commit", "fill_device",
+            "delta_apply", "full_encode",
+        }
         assert all(v >= 0 for v in record.phases.values())
         assert record.phases["device"] > 0
         assert set(record.fill_routing) == {

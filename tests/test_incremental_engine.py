@@ -1,0 +1,155 @@
+"""Unit pins for the incremental engine's building blocks.
+
+The differential suites (tests/test_incremental_parity.py,
+tests/test_incremental_faults.py) prove the composed engine byte-equal
+end-to-end; this file pins the two primitives those proofs stand on, at
+their own contracts:
+
+  * ir/delta.py — the journal's epoch/window algebra: monotone epochs,
+    strict-after dirty enumeration, ring eviction moving the floor,
+    mark_gap voiding every outstanding checkpoint;
+  * ops/rebase.py — the donated device rebase: the jit kernel must
+    byte-match the exact numpy reference on randomized permutation +
+    scatter cases, including dead-row sentinels and dropped pad indices,
+    and the pad helpers must keep shapes on the lane/pow2 ladders the
+    registered contract (SOLVER_CONTRACTS.json) pins.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.ir.delta import (
+    DELTA_KINDS,
+    NODE_ADDED,
+    NODE_REMOVED,
+    POD_BOUND,
+    POD_REMOVED,
+    DeltaJournal,
+)
+from karpenter_tpu.ops.rebase import (
+    pack_rebase,
+    pad_dirty,
+    pad_views,
+    rebase_view_state,
+    rebase_view_state_np,
+)
+
+
+class TestDeltaJournal:
+    def test_epochs_are_monotone_and_checkpointable(self):
+        j = DeltaJournal()
+        assert j.current_epoch() == 0
+        e1 = j.record("n1", NODE_ADDED)
+        e2 = j.record("n2", POD_BOUND)
+        assert 0 < e1 < e2 == j.current_epoch()
+
+    def test_dirty_since_is_strictly_after(self):
+        j = DeltaJournal()
+        j.record("a", NODE_ADDED)
+        mark = j.current_epoch()
+        j.record("b", POD_BOUND)
+        j.record("c", POD_REMOVED)
+        assert j.dirty_since(mark) == frozenset({"b", "c"})
+        assert j.dirty_since(j.current_epoch()) == frozenset()
+
+    def test_all_kinds_accepted_and_unknown_rejected(self):
+        j = DeltaJournal()
+        for kind in DELTA_KINDS:
+            j.record("n", kind)
+        with pytest.raises(ValueError):
+            j.record("n", "node-exploded")
+
+    def test_ring_eviction_moves_the_floor(self):
+        j = DeltaJournal(capacity=4)
+        j.record("a", NODE_ADDED)
+        mark = j.current_epoch()
+        for i in range(4):  # fill past capacity: 'a' is evicted
+            j.record(f"x{i}", POD_BOUND)
+        assert j.dirty_since(0) is None, "a reader from before the window must resync"
+        assert j.dirty_since(mark) == frozenset({"x0", "x1", "x2", "x3"})
+
+    def test_mark_gap_voids_every_checkpoint(self):
+        j = DeltaJournal()
+        j.record("a", NODE_ADDED)
+        mark = j.current_epoch()
+        j.mark_gap()
+        assert j.dirty_since(mark) is None
+        # but a checkpoint taken AFTER the gap works again
+        mark = j.current_epoch()
+        j.record("b", NODE_REMOVED)
+        assert j.dirty_since(mark) == frozenset({"b"})
+
+    def test_deltas_since_orders_by_epoch(self):
+        j = DeltaJournal()
+        j.record("a", NODE_ADDED)
+        mark = j.current_epoch()
+        j.record("b", POD_BOUND)
+        j.record("b", POD_REMOVED)
+        out = j.deltas_since(mark)
+        assert [(d.node, d.kind) for d in out] == [("b", POD_BOUND), ("b", POD_REMOVED)]
+
+
+class TestRebaseKernel:
+    def test_pad_ladders(self):
+        assert pad_views(1) == 128 and pad_views(128) == 128 and pad_views(129) == 256
+        assert pad_dirty(0) == 8 and pad_dirty(8) == 8 and pad_dirty(9) == 16
+        assert pad_dirty(100) == 128
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_jit_rebase_byte_matches_numpy_reference(self, seed):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(600 + seed)
+        R = int(rng.integers(2, 6))
+        v_old = int(rng.integers(3, 40))
+        v_new = int(rng.integers(3, 40))
+        vp = pad_views(max(v_old, v_new))
+
+        buf = np.full((vp, R), -1.0, np.float32)
+        buf[:v_old] = rng.standard_normal((v_old, R)).astype(np.float32)
+
+        # survivors: each new row maps to a random old row or -1 (fresh)
+        perm = np.where(
+            rng.random(v_new) < 0.7, rng.integers(0, v_old, v_new), -1
+        ).astype(np.int32)
+        dirty = np.flatnonzero(rng.random(v_new) < 0.4).astype(np.int32)
+        rows = rng.standard_normal((len(dirty), R)).astype(np.float32)
+
+        perm_p, rows_p, idx_p = pack_rebase(perm, rows, dirty, vp)
+        assert perm_p.shape == (vp,)
+        assert rows_p.shape[0] == idx_p.shape[0] == pad_dirty(len(dirty))
+
+        want = rebase_view_state_np(buf, perm_p, rows_p, idx_p)
+        # the jit kernel donates its buffer: hand it a fresh device copy
+        got = np.asarray(
+            rebase_view_state(
+                jnp.asarray(buf), jnp.asarray(perm_p), jnp.asarray(rows_p), jnp.asarray(idx_p)
+            )
+        )
+        assert got.dtype == np.float32 and got.shape == (vp, R)
+        assert np.array_equal(got, want), f"seed {seed}: jit rebase diverges from reference"
+        # dead rows (perm -1, not scattered) carry the sentinel
+        dead = (perm_p < 0) & ~np.isin(np.arange(vp), idx_p[idx_p < vp])
+        assert np.all(got[dead] == np.float32(-1.0))
+
+    def test_pad_indices_are_dropped_not_wrapped(self):
+        import jax.numpy as jnp
+
+        vp = pad_views(4)
+        buf = np.zeros((vp, 2), np.float32)
+        perm = np.arange(vp, dtype=np.int32)
+        # one real dirty row + pad slots pointing at vp (out of range)
+        dirty = np.asarray([1], np.int32)
+        rows = np.full((1, 2), 7.0, np.float32)
+        perm_p, rows_p, idx_p = pack_rebase(perm, rows, dirty, vp)
+        assert np.all(idx_p[1:] == vp), "pad slots must target the dropped index"
+        got = np.asarray(
+            rebase_view_state(
+                jnp.asarray(buf), jnp.asarray(perm_p), jnp.asarray(rows_p), jnp.asarray(idx_p)
+            )
+        )
+        assert np.all(got[1] == 7.0)
+        # no pad row leaked into a real slot
+        assert np.all(got[2:] == 0.0) and np.all(got[0] == 0.0)

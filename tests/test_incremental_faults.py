@@ -1,0 +1,180 @@
+"""Incremental engine x solver fault domain: invalidation at the seams.
+
+The engine's resident state (encoded mirror + donated device headroom
+buffer) is only valid while the device path is trusted. Two fault seams
+void it (ISSUE satellite pin):
+
+  * an OPEN circuit breaker — presolve short-circuits to the host loop,
+    the journal checkpoint goes stale while the device heals, and the
+    donated buffer may sit on a suspect device: the first re-admitted pass
+    must be a clean FULL re-encode attributed 'fault-breaker';
+  * a mid-solve flavor retirement (degradation ladder rung 'flavor' — a
+    kernel fault retired the Pallas/mesh flavor this solve dispatched on):
+    the resident buffer may have been donated into a dispatch that died,
+    so the NEXT pass must be a clean full re-encode attributed
+    'fault-flavor'.
+
+Both are driven end-to-end — real injected faults at real dispatch
+boundaries of real solves against a real cluster mirror — and both assert
+the taxonomy's prime directive: ZERO lost pods, every pass, fault or not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.state.cluster import Cluster
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.solver.faults import (
+    BREAKER,
+    FAULTS,
+    KIND_DEVICE_LOST,
+    KIND_KERNEL,
+    RUNG_FLAVOR,
+    STATE_OPEN,
+    FaultPlan,
+    FaultSpec,
+)
+from karpenter_tpu.solver.incremental import (
+    INCREMENTAL_INVALIDATIONS,
+    PASS_DELTA,
+    PASS_FULL,
+    IncrementalEngine,
+)
+from tests.helpers import make_pod
+from tests.test_differential_campaign import _provisioners, _rename
+from tests.test_incremental_parity import _Churn
+from tests.test_warm_fill_vectorized import _fill_fingerprint
+
+
+@pytest.fixture(autouse=True)
+def _fault_domain_hygiene():
+    FAULTS.clear()
+    BREAKER.reset()
+    BREAKER.configure(threshold=3, backoff=30.0)
+    yield
+    FAULTS.clear()
+    BREAKER.reset()
+    BREAKER.configure(threshold=3, backoff=30.0)
+
+
+def _rig(seed, tag):
+    provider = FakeCloudProvider(instance_types(40))
+    kube = KubeCluster()
+    churn = _Churn(kube, seed, tag, min_nodes=8)
+    churn.seed_nodes(10)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    solver = DenseSolver(min_batch=1, incremental=engine)
+    return provider, kube, churn, cluster, engine, solver
+
+
+def _solve(solver, cluster, provider, tag, step, count=8, memory="256Mi"):
+    prng = np.random.default_rng(7700 + step)
+    pods = _rename(
+        [
+            make_pod(
+                labels={"app": "faulted"},
+                requests={"cpu": float(prng.choice([0.25, 0.5])), "memory": memory},
+            )
+            for _ in range(count)
+        ],
+        f"{tag}{step}",
+    )
+    scheduler = build_scheduler(
+        _provisioners(), provider, pods, cluster=cluster,
+        state_nodes=cluster.nodes_snapshot(), dense_solver=solver,
+    )
+    results = scheduler.solve(pods)
+    placed = sum(len(n.pods) for n in results.new_nodes) + sum(
+        len(v.pods) for v in results.existing_nodes
+    )
+    assert placed == len(pods), f"{tag} step {step}: a fault must never lose pods"
+    return results, scheduler
+
+
+def _warm_to_delta(engine, solver, cluster, provider, churn, tag):
+    """Cold pass then a churned delta pass: the engine holds live resident
+    state whose NEXT pass would be delta — the precondition every
+    invalidation test must start from."""
+    _solve(solver, cluster, provider, tag, 0)
+    churn.step()
+    _solve(solver, cluster, provider, tag, 1)
+    assert engine.passes[PASS_DELTA] >= 1, "rig failed to reach a live delta state"
+    assert engine._resident is not None
+
+
+def test_open_breaker_voids_resident_state_with_zero_lost_pods():
+    provider, kube, churn, cluster, engine, solver = _rig(8800, "brk")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "brk")
+    base_inval = INCREMENTAL_INVALIDATIONS.value(reason="fault-breaker")
+    full_before = engine.passes[PASS_FULL]
+
+    # three consecutive device-lost faults open the breaker
+    for _ in range(3):
+        BREAKER.record_fault(KIND_DEVICE_LOST)
+    assert BREAKER.state == STATE_OPEN
+
+    # the breaker-open pass: host loop owns the batch, resident state voided
+    churn.step()
+    _solve(solver, cluster, provider, "brk", 2)
+    assert engine._resident is None, "an open breaker must drop the resident state"
+    assert engine.passes[PASS_FULL] == full_before, (
+        "the short-circuited pass never reaches the engine — invalidation is "
+        "pending, not a pass"
+    )
+
+    # device heals, breaker re-admits: the first device pass is a clean full
+    # re-encode attributed to the breaker seam — not a delta against a
+    # checkpoint that went stale while passes were host-routed
+    BREAKER.reset()
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "brk", 3)
+    assert engine.passes[PASS_FULL] == full_before + 1
+    assert INCREMENTAL_INVALIDATIONS.value(reason="fault-breaker") == base_inval + 1
+
+    # and the rebuilt pass is still byte-equal to a fresh solver's
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "brk", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+
+    # steady state resumes: the pass after the rebuild is delta again
+    delta_before = engine.passes[PASS_DELTA]
+    churn.step()
+    _solve(solver, cluster, provider, "brk", 4)
+    assert engine.passes[PASS_DELTA] == delta_before + 1
+
+
+def test_flavor_retirement_mid_solve_voids_resident_state():
+    provider, kube, churn, cluster, engine, solver = _rig(8900, "flv")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "flv")
+    base_inval = INCREMENTAL_INVALIDATIONS.value(reason="fault-flavor")
+    full_before = engine.passes[PASS_FULL]
+
+    # tier-1 runs on the conftest's virtual 8-device mesh, so the new-node
+    # dispatch flavor is 'sharded'; a kernel fault at that boundary retires
+    # the mesh flavor mid-solve (RUNG_FLAVOR) — the injection raises BEFORE
+    # the kernel body, exactly like a Mosaic trap would
+    FAULTS.install(FaultPlan([FaultSpec(kind=KIND_KERNEL, entry="sharded", nth=1)]))
+    churn.step()
+    # a memory-bound batch that overflows the warm cluster: the spill forces
+    # the new-node dense dispatch, which is where the flavor runs
+    _solve(solver, cluster, provider, "flv", 2, count=60, memory="16Gi")
+    FAULTS.clear()
+    if not solver._solve_rungs:
+        pytest.skip("no multi-device mesh in this environment; sharded flavor never dispatched")
+    assert RUNG_FLAVOR in solver._solve_rungs, "the injected kernel fault must retire the flavor"
+    assert solver._mesh is None, "the faulted mesh flavor must be retired"
+    assert engine._resident is None, "a mid-solve flavor retirement must drop the resident state"
+
+    # next pass: clean full re-encode attributed to the flavor seam, still
+    # byte-equal to a fresh solver, zero lost pods throughout
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "flv", 3)
+    assert engine.passes[PASS_FULL] == full_before + 1
+    assert INCREMENTAL_INVALIDATIONS.value(reason="fault-flavor") == base_inval + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "flv", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)

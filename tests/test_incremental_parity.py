@@ -1,0 +1,356 @@
+"""Incremental solve engine vs fresh encode: byte-exact differential parity.
+
+The incremental engine (solver/incremental.py) claims its delta-rebased
+WarmViewEncoding is BYTE-IDENTICAL to a fresh `encode_warm_views` over the
+same views — survivors carry their prior f64 rows unchanged, dirty rows are
+recomputed with the exact fresh expressions (encode is row-independent),
+and the donated device rebase (ops/rebase.py) reproduces the f32 headroom
+mirror exactly. This suite enforces the claim differentially across
+randomized delta sequences driven through a REAL cluster mirror: nodes
+launch and terminate, pods bind and vanish, through KubeCluster watch
+events into Cluster's delta journal — then every pass's engine output is
+compared field-for-field (host arrays AND device mirror) against a fresh
+encode, and full solves through a persistent incremental DenseSolver are
+compared fingerprint-for-fingerprint against a fresh solver on identical
+inputs. Every invalidation seam is walked: catalog-key bump, forced fault
+invalidation, journal gap (resync), view-pad regrowth, and bulk churn —
+each must yield an attributed full re-encode whose output is still
+byte-equal.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_INSTANCE_TYPE,
+    LABEL_TOPOLOGY_ZONE,
+    PROVISIONER_NAME_LABEL,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types
+from karpenter_tpu.controllers.state.cluster import Cluster
+from karpenter_tpu.ir.encode import encode_warm_views
+from karpenter_tpu.kube.cluster import KubeCluster
+from karpenter_tpu.scheduler import build_scheduler
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.solver.incremental import (
+    PASS_BYPASS,
+    PASS_DELTA,
+    PASS_FULL,
+    IncrementalEngine,
+)
+from tests.helpers import make_node, make_pod
+from tests.test_differential_campaign import _provisioners, _rename
+from tests.test_warm_fill_vectorized import _fill_fingerprint
+
+_ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+
+def _warm_node(name, rng):
+    return make_node(
+        name=name,
+        labels={
+            PROVISIONER_NAME_LABEL: "default",
+            LABEL_INSTANCE_TYPE: "fake-it-3",
+            LABEL_CAPACITY_TYPE: "on-demand",
+            LABEL_TOPOLOGY_ZONE: _ZONES[int(rng.integers(3))],
+        },
+        allocatable={"cpu": int(rng.integers(8, 33)), "memory": "64Gi", "pods": 110},
+    )
+
+
+class _Churn:
+    """Randomized cluster churn through the real watch seam: every mutation
+    goes kube -> watch event -> Cluster handler -> delta journal, exactly
+    the production feed the engine consumes."""
+
+    def __init__(self, kube: KubeCluster, seed: int, tag: str, min_nodes: int = 6):
+        self.kube = kube
+        self.rng = np.random.default_rng(seed)
+        self.tag = tag
+        self.min_nodes = min_nodes
+        self._n = 0
+        self._p = 0
+        self.bound = []
+
+    def add_node(self):
+        name = f"{self.tag}-n{self._n:03d}"
+        self._n += 1
+        self.kube.create(_warm_node(name, self.rng))
+        return name
+
+    def seed_nodes(self, count):
+        for _ in range(count):
+            self.add_node()
+
+    def drop_node(self):
+        nodes = self.kube.list_nodes()
+        if len(nodes) <= self.min_nodes:
+            return
+        self.kube.delete(nodes[int(self.rng.integers(len(nodes)))], grace=False)
+
+    def bind(self):
+        nodes = self.kube.list_nodes()
+        if not nodes:
+            return
+        node = nodes[int(self.rng.integers(len(nodes)))]
+        pod = make_pod(
+            name=f"{self.tag}-bp{self._p:04d}",
+            labels={"app": "warm"},
+            requests={"cpu": 0.25, "memory": "256Mi"},
+            node_name=node.name,
+            phase="Running",
+            unschedulable=False,
+        )
+        self._p += 1
+        self.kube.create(pod)
+        self.bound.append(pod)
+
+    def unbind(self):
+        if not self.bound:
+            return
+        pod = self.bound.pop(int(self.rng.integers(len(self.bound))))
+        self.kube.delete(pod, grace=False)
+
+    def step(self):
+        r = self.rng
+        for _ in range(int(r.integers(0, 3))):
+            self.bind()
+        if r.random() < 0.4:
+            self.add_node()
+        if r.random() < 0.3:
+            self.drop_node()
+        if r.random() < 0.3:
+            self.unbind()
+
+
+def _views(cluster, provider):
+    """The engine's real input: scheduler.existing_nodes built from a fresh
+    cluster snapshot, exactly as presolve sees them."""
+    scheduler = build_scheduler(
+        _provisioners(), provider, [], cluster=cluster,
+        state_nodes=cluster.nodes_snapshot(), dense_solver=None,
+    )
+    return scheduler.existing_nodes
+
+
+def _assert_enc_equal(enc, ref, ctx):
+    """Field-for-field byte equality of the engine's encoding against a
+    fresh encode over the same views — including the resident f32 device
+    mirror, which must equal the f32 cast of the fresh f64 headroom."""
+    assert np.array_equal(enc.usable, ref.usable), f"{ctx}: usable"
+    assert np.array_equal(enc.avail_tol, ref.avail_tol), f"{ctx}: avail_tol"
+    assert np.array_equal(enc.requests0, ref.requests0), f"{ctx}: requests0"
+    assert np.array_equal(enc.head0, ref.head0), f"{ctx}: head0"
+    assert enc.zone == ref.zone, f"{ctx}: zone"
+    assert enc.ct == ref.ct, f"{ctx}: ct"
+    assert enc.hostname == ref.hostname, f"{ctx}: hostname"
+    assert enc.taint_sig == ref.taint_sig, f"{ctx}: taint_sig"
+    head_dev = getattr(enc, "head_dev", None)
+    if head_dev is not None:
+        dev = np.asarray(head_dev)
+        v = ref.head0.shape[0]
+        assert np.array_equal(dev[:v], ref.head0.astype(np.float32)), f"{ctx}: device mirror"
+        assert np.all(dev[v:] == np.float32(-1.0)), f"{ctx}: device pad rows"
+
+
+# -- engine-level array parity across a randomized delta sequence -------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_engine_delta_sequence_byte_parity(seed):
+    provider = FakeCloudProvider(instance_types(50))
+    kube = KubeCluster()
+    churn = _Churn(kube, 4100 + seed, f"ip{seed}", min_nodes=10)
+    churn.seed_nodes(12)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    ckey = ("ck", 0)
+
+    def advance(ctx):
+        views = _views(cluster, provider)
+        ref = encode_warm_views(views)
+        adv = engine.advance(views, ckey)
+        _assert_enc_equal(adv.enc, ref, f"seed {seed} {ctx}")
+        return adv
+
+    adv = advance("cold")
+    assert adv.kind == PASS_FULL and adv.reason == "cold"
+
+    for step in range(6):
+        churn.step()
+        adv = advance(f"step{step}")
+        assert adv.kind in (PASS_DELTA, PASS_FULL)
+        if adv.kind == PASS_DELTA:
+            # delta cost is bounded by the delta, not the cluster
+            assert adv.dirty_rows < len(kube.list_nodes())
+    assert engine.passes[PASS_DELTA] >= 3, (
+        f"seed {seed}: small churn over a 12-node cluster must take the delta path"
+    )
+
+    # a catalog-key bump can re-shape every row: attributed full re-encode
+    ckey = ("ck", 1)
+    adv = advance("catalog")
+    assert adv.kind == PASS_FULL and adv.reason == "catalog"
+    churn.step()
+    adv = advance("post-catalog")
+    assert adv.kind == PASS_DELTA, "resident state must rebuild after a catalog bump"
+
+    # a forced fault invalidation (the breaker / flavor seams call this)
+    engine.invalidate("fault-breaker")
+    adv = advance("fault")
+    assert adv.kind == PASS_FULL and adv.reason == "fault-breaker"
+
+    # a journal gap (resync relist) voids the delta window
+    cluster.delta_journal.mark_gap()
+    adv = advance("gap")
+    assert adv.kind == PASS_FULL and adv.reason == "gap"
+    churn.step()
+    adv = advance("post-gap")
+    assert adv.kind == PASS_DELTA, "the delta path must resume after a gap rebuild"
+
+
+def test_engine_steady_state_dirty_window_stays_bounded():
+    """Constant churn must yield a CONSTANT dirty window (two passes of
+    churn), not a cumulative one: rows re-encoded purely to heal the
+    previous window leave it immediately. A transitively-accumulating
+    window inflates every pass until it trips 'bulk' — and climbs the
+    dirty-pad ladder, retracing the rebase kernel, on the way."""
+    provider = FakeCloudProvider(instance_types(30))
+    kube = KubeCluster()
+    churn = _Churn(kube, 4700, "bw", min_nodes=30)
+    churn.seed_nodes(30)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    assert engine.advance(_views(cluster, provider), ("ck",)).kind == PASS_FULL
+
+    for step in range(14):
+        # exactly two pod binds per pass -> at most 2 journal names + the
+        # previous pass's 2 healing names: dirty_rows must never exceed 4
+        for _ in range(2):
+            churn.bind()
+        adv = engine.advance(_views(cluster, provider), ("ck",))
+        assert adv.kind == PASS_DELTA, f"step {step}: {adv.kind} ({adv.reason})"
+        assert adv.dirty_rows <= 4, (
+            f"step {step}: dirty window grew to {adv.dirty_rows} rows — "
+            "the healing window is accumulating instead of rolling"
+        )
+
+
+def test_engine_bulk_churn_takes_attributed_full_reencode():
+    provider = FakeCloudProvider(instance_types(30))
+    kube = KubeCluster()
+    churn = _Churn(kube, 4400, "bulk", min_nodes=0)
+    churn.seed_nodes(12)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    assert engine.advance(_views(cluster, provider), ("ck",)).kind == PASS_FULL
+
+    # churn past MAX_DIRTY_FRACTION: 7 of 12 die, 8 launch -> 8 dirty of 13
+    for node in kube.list_nodes()[:7]:
+        kube.delete(node, grace=False)
+    for _ in range(8):
+        churn.add_node()
+    views = _views(cluster, provider)
+    ref = encode_warm_views(views)
+    adv = engine.advance(views, ("ck",))
+    assert adv.kind == PASS_FULL and adv.reason == "bulk"
+    _assert_enc_equal(adv.enc, ref, "bulk")
+
+
+def test_engine_view_pad_regrowth_rebuilds():
+    # crossing the lane-pad boundary (128) voids the donated buffer shape
+    provider = FakeCloudProvider(instance_types(20))
+    kube = KubeCluster()
+    churn = _Churn(kube, 4500, "grow", min_nodes=0)
+    churn.seed_nodes(124)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    assert engine.advance(_views(cluster, provider), ("ck",)).kind == PASS_FULL
+
+    for _ in range(8):  # 124 -> 132 views: pad 128 -> 256
+        churn.add_node()
+    views = _views(cluster, provider)
+    ref = encode_warm_views(views)
+    adv = engine.advance(views, ("ck",))
+    assert adv.kind == PASS_FULL and adv.reason == "grow"
+    _assert_enc_equal(adv.enc, ref, "grow")
+
+
+def test_engine_bypasses_and_drops_state_on_empty_views():
+    provider = FakeCloudProvider(instance_types(20))
+    kube = KubeCluster()
+    churn = _Churn(kube, 4600, "mt", min_nodes=0)
+    churn.seed_nodes(4)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    assert engine.advance(_views(cluster, provider), ("ck",)).kind == PASS_FULL
+    adv = engine.advance([], ("ck",))
+    assert adv.kind == PASS_BYPASS and adv.enc is None
+    # state was dropped: the next non-empty pass starts clean, not diffing
+    # against a map whose rows the bypass never tracked
+    adv = engine.advance(_views(cluster, provider), ("ck",))
+    assert adv.kind == PASS_FULL and adv.reason == "cold"
+
+
+# -- full-solve parity: persistent incremental solver vs fresh solver ---------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_incremental_solve_parity_randomized(seed):
+    """Per churn step, the SAME cluster snapshot and an identical pod batch
+    are solved twice — once through a persistent DenseSolver carrying the
+    incremental engine across passes, once through a fresh solver — and the
+    full placement fingerprint (per-view pods in order, residual requests,
+    topology domains, new-node packing) must match byte-for-byte. Includes
+    a forced mid-sequence invalidation; the engine is asserted to ENGAGE
+    (delta passes actually taken) so the sweep can never silently degrade
+    to full-vs-full."""
+    provider = FakeCloudProvider(instance_types(50))
+    kube = KubeCluster()
+    churn = _Churn(kube, 5200 + seed, f"is{seed}", min_nodes=8)
+    churn.seed_nodes(10)
+    cluster = Cluster(kube, None)
+    engine = IncrementalEngine(cluster.delta_journal)
+    inc_solver = DenseSolver(min_batch=1, incremental=engine)
+
+    def pods_for(step):
+        prng = np.random.default_rng(9000 + 100 * seed + step)
+        pods = [
+            make_pod(
+                labels={"app": "churned"},
+                requests={"cpu": float(prng.choice([0.25, 0.5, 1.0])), "memory": "512Mi"},
+            )
+            for _ in range(int(prng.integers(4, 12)))
+        ]
+        return _rename(pods, f"is{seed}s{step}")
+
+    def solve(solver, step):
+        pods = pods_for(step)
+        scheduler = build_scheduler(
+            _provisioners(), provider, pods, cluster=cluster,
+            state_nodes=cluster.nodes_snapshot(), dense_solver=solver,
+        )
+        return scheduler.solve(pods), scheduler
+
+    for step in range(8):
+        churn.step()
+        if step == 5:
+            # a fault seam fired between passes: resident state is void, the
+            # next pass must be a clean full re-encode — and still byte-equal
+            engine.invalidate("fault-breaker")
+        results_i, sched_i = solve(inc_solver, step)
+        results_f, sched_f = solve(DenseSolver(min_batch=1), step)
+        fp_i = _fill_fingerprint(results_i, sched_i)
+        fp_f = _fill_fingerprint(results_f, sched_f)
+        assert fp_i == fp_f, f"seed {seed} step {step}: incremental solve diverges from fresh"
+
+    assert engine.passes[PASS_DELTA] >= 3, f"seed {seed}: the delta path never engaged"
+    assert engine.passes[PASS_FULL] >= 2, "cold start + forced invalidation"
+    assert inc_solver.stats.encode_skipped_passes == engine.passes[PASS_DELTA], (
+        "every delta pass must flow through the presolve stats seam"
+    )
+    assert inc_solver.stats.delta_apply_seconds > 0.0
+    assert inc_solver.stats.full_encode_seconds > 0.0

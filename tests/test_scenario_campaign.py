@@ -67,6 +67,8 @@ class TestSchemaValidator:
                         "unschedulable_pod_seconds": 0.4,
                         "recompiles_total": 0,
                         "solver_latency_p95_seconds": 0.01,
+                        "encode_skipped_passes": 0,
+                        "solver_latency_p95_flatness": 1.05,
                         "solver_faults_total": 0,
                         "degraded_solves_total": 0,
                         "solver_faults_injected": 0,
@@ -138,6 +140,22 @@ class TestSchemaValidator:
         assert scenario_doc_errors(doc) == []
         doc["runs"][0]["scores"]["solver_latency_p95_seconds"] = -0.1
         assert any("solver_latency_p95_seconds" in e for e in scenario_doc_errors(doc))
+
+    def test_incremental_engine_scores_required_and_typed(self):
+        # the incremental-engine keys are schema-gated on ALL runs (scored
+        # 0 / null when the scenario never wired the engine)
+        doc = self._valid_doc()
+        del doc["runs"][0]["scores"]["encode_skipped_passes"]
+        assert any("encode_skipped_passes" in e for e in scenario_doc_errors(doc))
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["encode_skipped_passes"] = 2.5
+        assert any("encode_skipped_passes" in e for e in scenario_doc_errors(doc))
+        # flatness is nullable (too few solves to window) but never negative
+        doc = self._valid_doc()
+        doc["runs"][0]["scores"]["solver_latency_p95_flatness"] = None
+        assert scenario_doc_errors(doc) == []
+        doc["runs"][0]["scores"]["solver_latency_p95_flatness"] = -1.0
+        assert any("solver_latency_p95_flatness" in e for e in scenario_doc_errors(doc))
 
     def test_solver_fault_scores_required_and_typed(self):
         doc = self._valid_doc()
