@@ -718,13 +718,16 @@ def smoke() -> dict:
     (cold configs) or the vectorized warm fill engaged with nonzero device
     time (repack config); the node-guard never tripped and the dense node
     count stayed within the guard ratio of the host floor."""
+    from karpenter_tpu.capsule import CAPSULE
     from karpenter_tpu.flight import FLIGHT
     from karpenter_tpu.tracing import TRACER
 
     was_enabled = TRACER.enabled
     flight_was_enabled = FLIGHT.enabled
+    capsule_was_enabled = CAPSULE.enabled
     TRACER.enable()  # smoke runs traced: an empty span tree is a tier-1 failure
     FLIGHT.enable()  # and flight-recorded: compile/HBM telemetry per config
+    CAPSULE.enable()  # and capsule-armed: a healthy smoke must capture NOTHING
     try:
         return _smoke()
     finally:
@@ -735,6 +738,8 @@ def smoke() -> dict:
             TRACER.disable()
         if not flight_was_enabled:
             FLIGHT.disable()
+        if not capsule_was_enabled:
+            CAPSULE.disable()
 
 
 # smoke configs whose workloads carry NO multi-rule affinity cohorts (every
@@ -898,7 +903,7 @@ def _smoke() -> dict:
     # run_incremental_churn asserts the gates internally; the ISSUE pins are
     # re-asserted here so a softened helper can't silently pass the smoke
     log("smoke: incremental_churn (O(delta) steady state)")
-    _, inc_info = run_incremental_churn(80, 25, 12)
+    _, inc_info = run_incremental_churn(80, 25, 12, phase_key="incremental_churn")
     assert inc_info["compilations"] == 0, (
         f"[incremental_churn] {inc_info['compilations']} recompile(s) in steady state"
     )
@@ -906,6 +911,13 @@ def _smoke() -> dict:
         "[incremental_churn] a steady-state pass re-encoded from scratch"
     )
     assert inc_info["full_encode"] == 0.0, "[incremental_churn] nonzero full-encode time"
+    # the PR 17 gate gap: the O(delta) keys must land in the phases JSON
+    # itself (the block --compare diffs across rounds), not only in this
+    # smoke summary — a helper that stopped reporting them would have
+    # silently dropped the regression surface
+    churn_phase = PHASE_BREAKDOWN.get("incremental_churn") or {}
+    for key in ("delta_apply", "full_encode", "encode_skipped_passes"):
+        assert key in churn_phase, f"[incremental_churn] phases JSON missing {key!r}"
     summary["incremental_churn"] = inc_info
 
     log("smoke: interruption queue counters")
@@ -979,6 +991,20 @@ def _smoke() -> dict:
     summary["solver_faults_total"] = smoke_faults
     summary["degraded_solves_total"] = smoke_degraded
     summary["breaker_state"] = solver_faults.BREAKER.state
+
+    # incident-capsule steady-state gate (capsule.py): the engine was armed
+    # for the whole smoke; a healthy run must trip NO trigger — no breaker
+    # opens, no host rungs, no steady-recompile contract violations, and
+    # burn rates below threshold — so a final poll must capture nothing
+    log("smoke: zero-capsule steady-state gate")
+    from karpenter_tpu.capsule import CAPSULE as _capsule
+
+    _capsule.poll()
+    smoke_capsules = _capsule.captures_total()
+    assert smoke_capsules == 0, (
+        f"healthy smoke captured {smoke_capsules} incident capsule(s): {_capsule.fingerprints()}"
+    )
+    summary["capsules_captured"] = smoke_capsules
 
     summary["provenance"] = bench_provenance("smoke")
     summary["ok"] = True

@@ -133,6 +133,13 @@ def main(argv=None) -> int:
 
         extra_routes.update(journal.routes())
         debug_descriptions.update(journal.route_descriptions())
+    if options.enable_capsules:
+        # incident-capsule read surface: the captured evidence bundles and
+        # live burn rates on the metrics port
+        from .. import capsule
+
+        extra_routes.update(capsule.routes())
+        debug_descriptions.update(capsule.route_descriptions())
     if options.coherence_interval > 0:
         # informer-coherence witness read surface: registered caches,
         # confirmed divergences vs the store, last check on the metrics port

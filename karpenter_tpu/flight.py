@@ -55,12 +55,31 @@ from typing import Dict, List, Optional
 
 from .analysis.guards import guarded_by
 from .analysis.witness import WITNESS
+from .capsule import CAPSULE, TRIGGER_STEADY_RECOMPILE
 from .logsetup import get_logger
 from .metrics import REGISTRY
 
 log = get_logger("flight")
 
 DEFAULT_RING = 128
+
+# the committed solver contract (SOLVER_CONTRACTS.json at the repo root),
+# loaded once per process for the capsule engine's steady-recompile
+# cross-check; None (missing file) disables the check rather than firing
+_CONTRACT_DOC: Optional[dict] = None
+_CONTRACT_DOC_LOADED = False
+
+
+def _committed_contracts() -> Optional[dict]:
+    global _CONTRACT_DOC, _CONTRACT_DOC_LOADED
+    if not _CONTRACT_DOC_LOADED:
+        import os
+
+        from .analysis import contracts as _contracts
+
+        _CONTRACT_DOC = _contracts.load_committed(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        _CONTRACT_DOC_LOADED = True
+    return _CONTRACT_DOC
 
 # the backend-compile event jax.monitoring emits once per XLA compilation
 # (trace-cache hits emit nothing): the one signal that IS a recompile
@@ -234,7 +253,7 @@ class SolveRecord:
         }
 
 
-@guarded_by("_lock", "_ring", "_next_id", "_prev_signature", "_entries")
+@guarded_by("_lock", "_ring", "_next_id", "_prev_signature", "_entries", "_run_engaged")
 class FlightRecorder:
     """Bounded ring of per-solve records + the compile/HBM instruments."""
 
@@ -254,6 +273,9 @@ class FlightRecorder:
         self._prev_signature: Optional[Dict[str, int]] = None
         # named jitted entries whose _cache_size() growth attributes compiles
         self._entries: Dict[str, List[object]] = {}
+        # entries that compiled at least once since the last reset() — the
+        # steady-recompile capsule cross-check's warm-up exemption
+        self._run_engaged: set = set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -287,6 +309,7 @@ class FlightRecorder:
             if self._ring is not None:
                 self._ring.clear()
             self._prev_signature = None
+            self._run_engaged.clear()
         RECORDS_STORED.set(0)
         SOLVE_LATENCY.clear()
         HBM_PEAK.set(0.0)
@@ -474,11 +497,45 @@ class FlightRecorder:
             )
             self._next_id += 1
             self._prev_signature = dict(signature)
+            # entries engaging for the first time SINCE THE LAST reset(): in
+            # a long-lived process (a scenario campaign) the jit executable
+            # caches survive across runs, so a warm entry's first growth in
+            # a run is warm-up re-engagement, not a steady-state retrace —
+            # the capsule cross-check below exempts it the way the contract
+            # checker exempts process-wide first compiles
+            run_first = {
+                name for name in compiled if name != "other" and name not in self._run_engaged
+            }
+            self._run_engaged.update(name for name in compiled if name != "other")
             self._ring.append(record)
             if len(self._ring) > self.capacity:
                 del self._ring[0]
                 RECORDS_DROPPED.inc()
             RECORDS_STORED.set(float(len(self._ring)))
+        if CAPSULE.enabled and record.recompile and attribution and attribution != ["cold-start"]:
+            # the steady-state recompile cross-check: a recompile whose
+            # attribution is entirely declared-STATIC axes contradicts the
+            # committed solver contract — that IS the incident (healthy
+            # runs and legitimate churn recompiles attribute to varying
+            # axes and never fire). Only entries that already compiled this
+            # run count as retraces: without the run_first exemption the
+            # trigger is transport-asymmetric in campaigns (the first
+            # transport populates the process-wide caches; the second sees
+            # no compiles at all)
+            doc = _committed_contracts()
+            if doc is not None:
+                from .analysis.contracts import recompile_violations
+
+                view = {
+                    "id": record.id,
+                    "recompile": record.recompile,
+                    "recompile_attribution": attribution,
+                    "compiled_fns": record.compiled_fns,
+                    "first_compiles": sorted(set(record.first_compiles) | run_first),
+                    "signature": record.signature,
+                }
+                if recompile_violations([view], doc):
+                    CAPSULE.trigger(TRIGGER_STEADY_RECOMPILE, attribution=sorted(attribution))
         return record
 
     def observe_solve_latency(self, seconds: float) -> None:

@@ -60,8 +60,9 @@ VIOLATIONS = REGISTRY.counter(
     "karpenter_invariant_violations_total",
     "Distinct invariant violations the monitor confirmed, by invariant"
     " (threads.leak, watches.leak, journal.ring/entities/completed/spool,"
-    " flight.ring, locks.cycle, informer.divergence, cloud.double-launch) —"
-    " each (invariant, entity) pair counts once, however long it persists.",
+    " flight.ring, capsule.ring/spool, locks.cycle, informer.divergence,"
+    " cloud.double-launch) — each (invariant, entity) pair counts once,"
+    " however long it persists.",
     ("invariant",),
 )
 SAMPLES = REGISTRY.counter(
@@ -169,6 +170,21 @@ def _flight_budget_rows() -> List[Tuple[str, str, int, int]]:
     if not FLIGHT.enabled:
         return []
     return [("flight.ring", "records", len(FLIGHT.records()), FLIGHT.capacity)]
+
+
+def _capsule_budget_rows() -> List[Tuple[str, str, int, int]]:
+    """The capsule engine's declared bounds: the in-memory ring and — when
+    spooling — the on-disk byte budget (the journal's rotation-budget
+    invariant, shared by the capsule spool)."""
+    from .capsule import CAPSULE
+
+    if not CAPSULE.enabled:
+        return []
+    stats = CAPSULE.stats()
+    rows = [("capsule.ring", "capsules", stats["capsules_stored"], stats["capacity"])]
+    if stats.get("spool_bytes") is not None:
+        rows.append(("capsule.spool", "bytes", stats["spool_bytes"], stats["spool_max_bytes"]))
+    return rows
 
 
 @guarded_by(
@@ -322,7 +338,7 @@ class InvariantMonitor:
         watcher_count_fn = getattr(kube, "watcher_count", None)
         watchers = int(watcher_count_fn()) if watcher_count_fn is not None else baseline_watchers
         leaked_watches = max(0, watchers - baseline_watchers)
-        budget_rows = _journal_budget_rows() + _flight_budget_rows()
+        budget_rows = _journal_budget_rows() + _flight_budget_rows() + _capsule_budget_rows()
         cycles = LOCK_WITNESS.cycles()
         divergence_delta = divergences_total() - coherence_baseline
         double_launches = int(backend.double_launches()) if backend is not None else 0

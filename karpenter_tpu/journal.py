@@ -591,6 +591,10 @@ class Journal:
             "enabled": self.enabled,
             "events_stored": stored,
             "events_total": seq,
+            # evidence-loss surface (mirrors /debug/traces): events already
+            # evicted from the ring, and the bound eviction happens at
+            "events_dropped": int(EVENTS_DROPPED.value()),
+            "capacity": self.capacity,
             "entities_tracked": entities,
             "waterfalls_completed": completed,
             "spool": spooling,

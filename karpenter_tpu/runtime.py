@@ -31,6 +31,7 @@ from .controllers.termination import TerminationController
 from .events import DedupeRecorder, Recorder
 from .kube.cluster import KubeCluster
 from .logsetup import configure as configure_logging, get_logger, set_level
+from .capsule import CAPSULE
 from .flight import FLIGHT
 from .journal import JOURNAL
 from .metrics import REGISTRY
@@ -114,6 +115,18 @@ class Runtime:
             if self.options.journal_spool:
                 JOURNAL.set_spool(self.options.journal_spool, self.options.journal_spool_max_bytes)
             JOURNAL.attach(self.kube)
+        if self.options.enable_capsules:
+            # incident capsules (capsule.py): the typed trigger bus + the
+            # SLO burn-rate monitor freeze every telemetry ring into one
+            # evidence bundle at /debug/capsules; enabled AFTER the rings
+            # it snapshots, clocked by this runtime's seam, polled by the
+            # metrics loop below
+            CAPSULE.enable(
+                spool=self.options.capsule_spool or None,
+                spool_max_bytes=self.options.capsule_spool_max_bytes,
+                debounce_seconds=self.options.capsule_debounce_seconds,
+                clock=self.kube.clock,
+            )
         self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree
@@ -555,6 +568,10 @@ class Runtime:
             self._pass("node-metrics", self.node_metrics.scrape)
             if self.options.enable_slo:
                 self._pass("slo-metrics", self.slo_metrics.scrape)
+            if self.options.enable_capsules:
+                # drain the trigger bus + run the burn-rate monitor; never
+                # leader-gated — a follower's breaker trips are evidence too
+                self._pass("capsule-poll", CAPSULE.poll)
 
     def _coherence_loop(self) -> None:
         from .kube.coherence import COHERENCE

@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import flight, invariants, journal, slo
+from .. import capsule, flight, invariants, journal, slo
 from ..kube import chaos as kube_chaos
 from ..kube.coherence import COHERENCE
 from ..solver import faults as solver_faults
@@ -279,7 +279,11 @@ def breaker_reclosed(ctx: ScenarioContext) -> bool:
     if plan is None or plan.fired() < 1:
         return False
     breaker = solver_faults.BREAKER
-    return breaker.opened_total >= 1 and breaker.state == solver_faults.STATE_CLOSED
+    if breaker.opened_total < 1 or breaker.state != solver_faults.STATE_CLOSED:
+        return False
+    # the breaker trip must have produced an incident capsule: the storm's
+    # acceptance bar includes the evidence, not just the recovery
+    return bool(capsule.CAPSULE.fingerprints().get(capsule.TRIGGER_BREAKER_OPEN))
 
 
 def hbm_degraded_settled(ctx: ScenarioContext) -> bool:
@@ -331,7 +335,7 @@ def watch_gap_settled(ctx: ScenarioContext) -> bool:
     return gap_ends >= 2 and compactions >= 1
 
 
-def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule, require_delta_passes: int = 0) -> bool:
+def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule, require_delta_passes: int = 0, require_capsules: int = 0) -> bool:
     """The soak convergence bar: the chaos schedule fully delivered (a run
     the weather never reached proves nothing), the solver breaker re-closed
     (a fault storm that permanently abandoned the device path is not
@@ -358,6 +362,12 @@ def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule, require_delta_pa
         flat = _solver_latency_p95_flatness()
         if flat is not None and flat > SOAK_P95_FLATNESS_BOUND:
             return False
+    if capsule.CAPSULE.captures_total() < require_capsules:
+        # the soak's seeded solver faults must leave evidence behind: the
+        # full soak demands at least one incident capsule (the host-rung
+        # capture from the seeded compile faults); the mini-soak's shorter
+        # schedule keeps the default of zero
+        return False
     return not invariants.MONITOR.violations()
 
 
@@ -420,6 +430,7 @@ class CampaignRunner:
         slo.SLO.reset()
         flight.FLIGHT.reset()  # per-run solver-latency quantiles + records
         journal.JOURNAL.reset()  # per-run lifecycle events + waterfalls
+        capsule.CAPSULE.reset()  # per-run captures + dedupe/debounce state
         # solver fault domain (solver/faults.py): each run starts from a
         # CLOSED breaker and scores only its own fault/degradation deltas;
         # a device-chaos scenario installs its seeded FaultPlan for the
@@ -503,6 +514,10 @@ class CampaignRunner:
                     # the conservation invariant enforced) and records the
                     # arrival trace replay builds on
                     enable_journal=True,
+                    # incident capsules ride every scenario: chaos runs
+                    # must capture their evidence bundles (scored below),
+                    # healthy runs must capture exactly none
+                    enable_capsules=True,
                     gc_interval=1.0,
                     gc_registration_grace=3.0,
                     # scenario timescales are seconds: a parked pod must
@@ -661,6 +676,13 @@ class CampaignRunner:
                     ),
                     "chaos_history_digest": schedules[0].history_digest() if schedules else None,
                     "compressed_seconds": round(compressed, 3),
+                    # incident-capsule scores (capsule.py): evidence bundles
+                    # captured this run (chaos scenarios require >=1 via
+                    # their settled predicates; healthy scenarios pin 0)
+                    # and the per-trigger fingerprint lists — equal maps
+                    # across transports pin the capture-determinism witness
+                    "capsules_captured": int(capsule.CAPSULE.captures_total()),
+                    "capsule_triggers": capsule.CAPSULE.fingerprints(),
                 },
                 "samples": samples,
             }
@@ -689,6 +711,7 @@ class CampaignRunner:
             flight.FLIGHT.disable()
             journal.JOURNAL.set_spool(None)  # close (and keep) the capture
             journal.JOURNAL.disable()
+            capsule.CAPSULE.disable()
             solver_faults.FAULTS.clear()  # never leak a fault plan past its run
             kube.chaos_watch_gap_end()  # a gap leaked past its run wedges nothing
             kube_chaos.KUBE_CHAOS.clear()
@@ -732,6 +755,10 @@ class CampaignRunner:
         # per compressed minute — the "sample every N compressed minutes"
         # contract without a second timer
         invariants.MONITOR.sample()
+        # the capsule engine polls on the same cadence (drains the trigger
+        # bus + runs the burn-rate monitor) so captures exist BEFORE the
+        # settled predicates that require them are checked
+        capsule.CAPSULE.poll()
         samples.append(
             {
                 "t": round(time.monotonic() - start, 3),
@@ -761,6 +788,14 @@ class CampaignRunner:
             errors = scenario_doc_errors(doc)
             if errors:
                 raise AssertionError(f"scenario {scenario.name} emitted an invalid document: {errors}")
+            # the capture-determinism witness: the same scenario on every
+            # transport must trip the same triggers with byte-identical
+            # fingerprints (details carry only transport-stable fields)
+            trigger_maps = [run["scores"]["capsule_triggers"] for run in doc["runs"]]
+            if any(t != trigger_maps[0] for t in trigger_maps[1:]):
+                raise AssertionError(
+                    f"scenario {scenario.name} captured different capsules across transports: {trigger_maps}"
+                )
             path = os.path.join(self.out_dir, f"SCENARIO_{scenario.name}.json")
             with open(path, "w", encoding="utf-8") as f:
                 json.dump(doc, f, indent=1, sort_keys=True)
@@ -1053,7 +1088,7 @@ def chaos_soak_scenario(seed: int = 11) -> Soak:
         solver_incremental=True,
         fault_specs=schedule.solver_specs(),
         kube_fault_specs=schedule.kube_specs(),
-        settled=functools.partial(soak_settled, schedule=schedule, require_delta_passes=1),
+        settled=functools.partial(soak_settled, schedule=schedule, require_delta_passes=1, require_capsules=1),
         primitives=[trace, schedule],
         description=(
             "the soak tier: 75 compressed minutes of diurnal load replayed 150x under a "

@@ -96,6 +96,13 @@ SCORE_KEYS = (
     "chaos_injected_total",
     "chaos_history_digest",
     "compressed_seconds",
+    # incident-capsule scores (capsule.py): evidence bundles captured this
+    # run (chaos scenarios require >=1 through their settled predicates,
+    # healthy scenarios pin 0) and the per-trigger fingerprint lists —
+    # equal maps across transports are the capture-determinism witness the
+    # campaign runner asserts before an artifact lands
+    "capsules_captured",
+    "capsule_triggers",
 )
 
 BREAKER_STATES = ("closed", "half-open", "open")
@@ -141,7 +148,7 @@ def run_errors(run, where: str = "run") -> List[str]:
             "recompiles_total", "solver_faults_total", "degraded_solves_total", "solver_faults_injected",
             "kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches",
             "leaked_threads", "leaked_watches", "invariant_violations", "chaos_injected_total",
-            "encode_skipped_passes",
+            "encode_skipped_passes", "capsules_captured",
         ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):
@@ -166,6 +173,17 @@ def run_errors(run, where: str = "run") -> List[str]:
         digest = scores.get("chaos_history_digest")
         if digest is not None and (not isinstance(digest, str) or not digest):
             errs.append(f"{where}.scores.chaos_history_digest must be null or a non-empty string")
+        triggers = scores.get("capsule_triggers")
+        if triggers is not None:
+            if not isinstance(triggers, dict):
+                errs.append(f"{where}.scores.capsule_triggers must be a dict of trigger -> fingerprint list")
+            else:
+                for trigger, fps in triggers.items():
+                    if not isinstance(fps, list) or not fps or any(not isinstance(fp, str) or not fp for fp in fps):
+                        errs.append(
+                            f"{where}.scores.capsule_triggers[{trigger!r}] must be a non-empty list of"
+                            " non-empty fingerprint strings"
+                        )
         compressed = scores.get("compressed_seconds")
         if compressed is not None and (
             not isinstance(compressed, (int, float)) or isinstance(compressed, bool) or compressed < 0

@@ -54,6 +54,7 @@ log = logging.getLogger("karpenter_tpu.solver")
 
 from ..api import labels as lbl
 from ..api.objects import OP_IN, Pod
+from ..capsule import CAPSULE, TRIGGER_HOST_RUNG
 from ..flight import FLIGHT, HBM_PEAK
 from ..ir.encode import DenseProblem, GroupKind, catalog_key, catalog_pin, encode_catalog, encode_problem, resource_vector
 from ..journal import JOURNAL
@@ -1635,6 +1636,10 @@ class DenseSolver:
         # the split surface still computed the same program on live buffers.
         if self.incremental is not None and rung != RUNG_CHUNKED:
             self.incremental.invalidate(f"fault-{rung}")
+        if rung == RUNG_HOST and CAPSULE.enabled:
+            # the ladder hit the floor: freeze the evidence rings (the
+            # capsule engine captures on its next poll)
+            CAPSULE.trigger(TRIGGER_HOST_RUNG, rung=rung)
         if JOURNAL.enabled:
             JOURNAL.solver_event("dense", "degraded", rung=rung, **attrs)
 

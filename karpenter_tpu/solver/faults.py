@@ -52,6 +52,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..analysis.guards import guarded_by
 from ..analysis.witness import WITNESS
+from ..capsule import CAPSULE, TRIGGER_BREAKER_OPEN
 from ..journal import JOURNAL
 from ..logsetup import get_logger
 from ..metrics import REGISTRY
@@ -398,6 +399,11 @@ class SolverCircuitBreaker:
         BREAKER_STATE.set(_STATE_GAUGE[state])
         if JOURNAL.enabled:
             JOURNAL.solver_event("breaker", f"breaker-{'opened' if state == STATE_OPEN else state}")
+        if state == STATE_OPEN and CAPSULE.enabled:
+            # enqueue-only while this lock is held: the capsule engine
+            # captures later, in poll(), without the breaker lock — the
+            # breaker->capsule edge stays a leaf in the lock-order graph
+            CAPSULE.trigger(TRIGGER_BREAKER_OPEN, fault_kind=self.last_fault_kind, threshold=self.threshold)
         log.warning("solver circuit breaker -> %s (consecutive=%d threshold=%d)", state, self.consecutive, self.threshold)
 
     def admit(self, simulation: bool = False) -> bool:

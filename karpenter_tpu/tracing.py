@@ -555,7 +555,20 @@ def _json(status, payload) -> tuple:
 def _traces_route(query: dict) -> tuple:
     trace_id = (query.get("id") or [None])[0]
     if trace_id is None:
-        return _json(200, {"enabled": TRACER.enabled, "traces": TRACER.traces()})
+        traces = TRACER.traces()
+        # evidence-loss surface: how much of the ring is full and how many
+        # traces have already been overwritten — a reader of a triggered
+        # incident needs to know whether the window still covers it
+        return _json(
+            200,
+            {
+                "enabled": TRACER.enabled,
+                "traces": traces,
+                "traces_dropped": int(TRACES_DROPPED.value()),
+                "occupancy": len(traces),
+                "capacity": TRACER.capacity,
+            },
+        )
     fmt = (query.get("format") or ["tree"])[0]
     if fmt == "chrome":
         payload = TRACER.export_chrome(trace_id)

@@ -140,6 +140,18 @@ class Options:
     # O(changes) steady state with byte-equal fallback to the fresh-encode
     # path on catalog changes, journal gaps, and fault invalidations
     solver_incremental: bool = False
+    # incident capsules (capsule.py): triggered cross-subsystem evidence
+    # capture — breaker opens, host-rung falls, conservation violations,
+    # steady-state recompiles, lock cycles, invariant breaches, and the
+    # multi-window SLO burn-rate monitor each freeze every telemetry ring
+    # into one CAPSULE_<trigger>_<seq>.json bundle at /debug/capsules;
+    # capsule_spool lands them on disk under a byte budget (the journal's
+    # rotation discipline), capsule_debounce_seconds rate-limits per
+    # trigger kind
+    enable_capsules: bool = False
+    capsule_spool: str = ""
+    capsule_spool_max_bytes: int = 32 * 2**20
+    capsule_debounce_seconds: float = 30.0
 
     def validate(self) -> List[str]:
         errs = []
@@ -177,6 +189,10 @@ class Options:
             errs.append("journal ring size must be positive")
         if self.journal_spool_max_bytes <= 0:
             errs.append("journal spool max bytes must be positive")
+        if self.capsule_spool_max_bytes <= 0:
+            errs.append("capsule spool max bytes must be positive")
+        if self.capsule_debounce_seconds < 0:
+            errs.append("capsule debounce must be non-negative")
         from ..logsetup import is_valid_level
 
         if not is_valid_level(self.log_level):
@@ -214,6 +230,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--journal-ring-size", type=int, default=_env("JOURNAL_RING_SIZE", defaults.journal_ring_size))
     parser.add_argument("--journal-spool", default=_env("JOURNAL_SPOOL", defaults.journal_spool))
     parser.add_argument("--journal-spool-max-bytes", type=int, default=_env("JOURNAL_SPOOL_MAX_BYTES", defaults.journal_spool_max_bytes))
+    parser.add_argument("--enable-capsules", action="store_true", default=_env("ENABLE_CAPSULES", defaults.enable_capsules))
+    parser.add_argument("--capsule-spool", default=_env("CAPSULE_SPOOL", defaults.capsule_spool))
+    parser.add_argument("--capsule-spool-max-bytes", type=int, default=_env("CAPSULE_SPOOL_MAX_BYTES", defaults.capsule_spool_max_bytes))
+    parser.add_argument("--capsule-debounce-seconds", type=float, default=_env("CAPSULE_DEBOUNCE_SECONDS", defaults.capsule_debounce_seconds))
     parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false", default=_env("LEADER_ELECT", defaults.leader_elect))
     parser.add_argument("--lease-duration", type=float, default=_env("LEASE_DURATION", defaults.lease_duration))
     parser.add_argument("--lease-renew-period", type=float, default=_env("LEASE_RENEW_PERIOD", defaults.lease_renew_period))

@@ -50,6 +50,14 @@ def test_bench_smoke():
     assert inc["compilations"] == 0
     assert inc["full_encode"] == 0.0
     assert inc["delta_apply"] >= 0.0
+    # the PR 17 gate gap, closed: the O(delta) keys land in the PHASES
+    # block --compare diffs across rounds, not only in the smoke summary
+    churn_phase = bench.PHASE_BREAKDOWN.get("incremental_churn") or {}
+    assert {"delta_apply", "full_encode", "encode_skipped_passes"} <= set(churn_phase), sorted(churn_phase)
+    # the incident-capsule steady-state gate ran armed for the whole smoke
+    # and captured NOTHING: no breaker opens, no host rungs, no contract
+    # violations, burn rates under threshold (capsule.py)
+    assert summary.pop("capsules_captured") == 0
     assert set(summary) == {"anti_spread", "ffd_parity", "selectors_taints", "repack", "spot_od", "ice_mask"}
     for name, info in summary.items():
         assert info["pods"] > 0, name

@@ -1,0 +1,505 @@
+"""Incident capsules: the trigger bus, debounce/dedupe discipline, the
+multi-window burn-rate monitor, the size-bounded spool (shared
+rotation-budget invariant with the journal), the /debug/capsules contract,
+and the offline `capsule inspect [--replay]` loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from karpenter_tpu import capsule as capsule_mod
+from karpenter_tpu.capsule import (
+    CAPSULE,
+    SPOOL_EVICTIONS,
+    SUPPRESSED,
+    TRIGGER_BREAKER_OPEN,
+    TRIGGER_CONSERVATION,
+    TRIGGER_HOST_RUNG,
+    TRIGGER_INVARIANT,
+    TRIGGER_LOCK_CYCLE,
+    TRIGGER_SLO_BURN,
+    TRIGGER_STEADY_RECOMPILE,
+    CapsuleEngine,
+    capsule_errors,
+    fingerprint,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _capsule_teardown():
+    yield
+    CAPSULE.disable()
+    CAPSULE.reset()
+    CAPSULE._spool_dir = None
+    CAPSULE._spool_dead = False
+    CAPSULE._spool_max_bytes = capsule_mod.DEFAULT_SPOOL_MAX_BYTES
+    CAPSULE.debounce_seconds = capsule_mod.DEFAULT_DEBOUNCE_SECONDS
+    CAPSULE.pending_objective = capsule_mod.DEFAULT_PENDING_OBJECTIVE_SECONDS
+    CAPSULE.cost_objective = capsule_mod.DEFAULT_COST_DRIFT_OBJECTIVE
+    CAPSULE.error_budget = capsule_mod.DEFAULT_ERROR_BUDGET
+    CAPSULE.burn_threshold = capsule_mod.DEFAULT_BURN_THRESHOLD
+    CAPSULE.fast_window = capsule_mod.DEFAULT_FAST_WINDOW
+    CAPSULE.slow_window = capsule_mod.DEFAULT_SLOW_WINDOW
+    CAPSULE.min_samples = capsule_mod.DEFAULT_MIN_SAMPLES
+    from karpenter_tpu import journal as journal_mod
+    from karpenter_tpu import slo as slo_mod
+
+    slo_mod.PENDING_LATENCY.clear()
+    slo_mod.COST_DRIFT.set(0.0)
+    journal_mod.JOURNAL.disable()
+    journal_mod.JOURNAL.reset()
+
+
+def _enable(engine, **kwargs):
+    kwargs.setdefault("debounce_seconds", 0.0)
+    kwargs.setdefault("clock", FakeClock())
+    engine.enable(**kwargs)
+    return engine
+
+
+class TestDisabledIsFree:
+    def test_disabled_allocates_nothing(self):
+        eng = CapsuleEngine()
+        assert not eng.enabled and eng._ring is None
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host")
+        assert eng.poll() == 0
+        assert eng._ring is None and eng._queue is None, "a disabled trigger must not allocate"
+        assert eng.index() == [] and eng.fingerprints() == {}
+        # the process singleton ships disabled (--enable-capsules opts in)
+        assert not CAPSULE.enabled
+
+    def test_disabled_trigger_overhead_at_the_tracing_bar(self):
+        # interleave to wash out warmup bias; the bound is deliberately
+        # generous (the tracing suite's 3x + constant) — a tripwire for
+        # accidentally making the disabled path more than an attribute read
+        eng = CapsuleEngine()
+
+        def noop(**detail):
+            return None
+
+        base, triggered = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(20000):
+                noop(rung="host")
+            base.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            for _ in range(20000):
+                eng.trigger(TRIGGER_HOST_RUNG, rung="host")
+            triggered.append(time.perf_counter() - t0)
+        assert min(triggered) <= min(base) * 3.0 + 0.05, (
+            f"disabled trigger too slow: {min(triggered) * 1000:.1f}ms vs {min(base) * 1000:.1f}ms no-op"
+        )
+        assert eng._ring is None
+
+
+class TestTriggerBus:
+    def test_capture_round_trip_is_schema_valid(self):
+        eng = _enable(CapsuleEngine())
+        eng.trigger(TRIGGER_BREAKER_OPEN, fault_kind="device-lost", threshold=3)
+        assert eng.poll() == 1
+        [row] = eng.index()
+        assert row["id"] == "breaker-open-0001"
+        assert row["trigger"] == TRIGGER_BREAKER_OPEN
+        assert row["detail"] == {"fault_kind": "device-lost", "threshold": 3}
+        doc = eng.capsule_by_id(row["id"])
+        assert capsule_errors(doc) == []
+        # every evidence block landed, cross-linked by the layers' own ids
+        assert set(capsule_mod.CAPSULE_KEYS) <= set(doc)
+        assert doc["fault_domain"]["breaker"]["state"] in ("closed", "open", "half-open")
+        assert isinstance(doc["metrics"], str) and "karpenter_capsule_captures_total" in doc["metrics"]
+
+    def test_unknown_kind_is_rejected_by_the_typed_bus(self):
+        eng = _enable(CapsuleEngine())
+        before = SUPPRESSED.value(reason="invalid")
+        eng.trigger("not-a-trigger", foo=1)
+        assert SUPPRESSED.value(reason="invalid") - before == 1
+        assert eng.poll() == 0
+
+    def test_same_incident_captured_once_per_run(self):
+        eng = _enable(CapsuleEngine())
+        before = SUPPRESSED.value(reason="duplicate")
+        for _ in range(3):
+            eng.trigger(TRIGGER_BREAKER_OPEN, fault_kind="device-lost", threshold=3)
+        assert eng.poll() == 1
+        assert SUPPRESSED.value(reason="duplicate") - before == 2
+        # re-observed in a later round: still the same fingerprint, still once
+        eng.trigger(TRIGGER_BREAKER_OPEN, fault_kind="device-lost", threshold=3)
+        assert eng.poll() == 0
+        assert eng.captures_total() == 1
+
+    def test_debounce_suppresses_distinct_incidents_within_the_window(self):
+        clock = FakeClock()
+        eng = CapsuleEngine()
+        eng.enable(debounce_seconds=10.0, clock=clock)
+        before = SUPPRESSED.value(reason="debounce")
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=1)
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=2)
+        assert eng.poll() == 1, "two distinct fingerprints inside the window capture once"
+        assert SUPPRESSED.value(reason="debounce") - before == 1
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=3)
+        assert eng.poll() == 0
+        clock.step(11.0)
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=3)
+        assert eng.poll() == 1, "past the window the kind captures again"
+
+    def test_queue_is_bounded_and_overflow_counted(self):
+        eng = _enable(CapsuleEngine())
+        before = SUPPRESSED.value(reason="queue-full")
+        for i in range(capsule_mod.DEFAULT_QUEUE + 7):
+            eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=i)
+        assert SUPPRESSED.value(reason="queue-full") - before == 7
+
+    def test_fingerprint_is_byte_stable_across_detail_ordering(self):
+        # the cross-transport determinism witness: canonical JSON, so the
+        # same incident fingerprints identically wherever it is observed
+        a = fingerprint(TRIGGER_BREAKER_OPEN, {"fault_kind": "device-lost", "threshold": 3})
+        b = fingerprint(TRIGGER_BREAKER_OPEN, {"threshold": 3, "fault_kind": "device-lost"})
+        assert a == b == "9aaff8a2da843a8e"
+        assert a != fingerprint(TRIGGER_BREAKER_OPEN, {"fault_kind": "device-lost", "threshold": 4})
+
+    def test_reset_drops_state_but_keeps_the_spool_directory(self, tmp_path):
+        eng = _enable(CapsuleEngine(), spool=str(tmp_path / "sp"))
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host")
+        assert eng.poll() == 1
+        eng.reset()
+        assert eng.index() == [] and eng.captures_total() == 0 and eng.fingerprints() == {}
+        assert eng.stats()["spool"] == str(tmp_path / "sp"), "reset is per-run, not per-process"
+
+
+class TestEmitSites:
+    def test_breaker_open_transition_emits_from_inside_the_lock(self):
+        from karpenter_tpu.solver.faults import KIND_DEVICE_LOST, STATE_OPEN, SolverCircuitBreaker
+
+        _enable(CAPSULE)
+        breaker = SolverCircuitBreaker(threshold=2, backoff=10.0)
+        breaker.configure(clock=FakeClock())
+        breaker.record_fault(KIND_DEVICE_LOST)
+        breaker.record_fault(KIND_DEVICE_LOST)
+        assert breaker.state == STATE_OPEN
+        assert CAPSULE.poll() == 1
+        [row] = CAPSULE.index()
+        assert row["trigger"] == TRIGGER_BREAKER_OPEN
+        assert row["detail"] == {"fault_kind": KIND_DEVICE_LOST, "threshold": 2}
+
+    def test_steady_recompile_fires_only_on_within_run_retrace(self, monkeypatch):
+        """The flight/contracts cross-check: a recompile attributed entirely
+        to declared-STATIC axes is the incident — but only for entries that
+        already compiled this run. A warm entry's first growth after a
+        per-run reset is campaign warm-up (the process-wide jit caches
+        survive resets), and firing on it would make the trigger
+        transport-asymmetric."""
+        from karpenter_tpu import flight as flight_mod
+
+        class FakeJit:
+            def __init__(self):
+                self.size = 0
+
+            def _cache_size(self):
+                return self.size
+
+        contract = {"entries": {"fake_entry": {"varying_axes": ["pods"], "static_axes": ["zones"]}}}
+        monkeypatch.setattr(flight_mod, "_committed_contracts", lambda: contract)
+        _enable(CAPSULE)
+        fresh = flight_mod.FlightRecorder()
+        fresh.enable()
+        fake = FakeJit()
+        fresh.register_jit_entry("fake_entry", fake)
+        try:
+            def solve(signature, compiles):
+                token = fresh.begin_solve()
+                if compiles:
+                    fake.size += 1
+                    with flight_mod._TALLY._lock:
+                        flight_mod._TALLY.events += 1
+                fresh.complete_solve(
+                    token=token,
+                    signature=signature,
+                    dispatch=None,
+                    phases={},
+                    fill_routing={},
+                    pods_committed=0,
+                    pods_to_host=0,
+                    duration=0.0,
+                )
+
+            solve({"pods": 10, "zones": 1}, compiles=True)  # previous run: cold-start
+            fresh.reset()  # the campaign's per-run reset; jit caches survive
+            solve({"pods": 10, "zones": 1}, compiles=False)  # run warm-up: all cached
+            solve({"pods": 10, "zones": 2}, compiles=True)  # warm re-engagement on a static axis
+            assert CAPSULE.poll() == 0, "a warm entry's first growth this run is warm-up, not a retrace"
+            solve({"pods": 10, "zones": 3}, compiles=True)  # a true within-run retrace
+            assert CAPSULE.poll() == 1
+            [row] = CAPSULE.index()
+            assert row["trigger"] == TRIGGER_STEADY_RECOMPILE
+            assert row["detail"] == {"attribution": ["zones"]}
+            solve({"pods": 99, "zones": 3}, compiles=True)  # varying-axis churn never fires
+            assert CAPSULE.poll() == 0
+        finally:
+            fresh.disable()
+
+    def test_conservation_violation_polled_from_the_journal(self, monkeypatch):
+        from karpenter_tpu import journal as journal_mod
+
+        journal_mod.JOURNAL.enable(capacity=64, clock=FakeClock())
+        monkeypatch.setattr(
+            journal_mod.JOURNAL, "conservation_errors", lambda: ["pod p-42: segments sum 5.0 != span 4.0"]
+        )
+        _enable(CAPSULE)
+        assert CAPSULE.poll() == 1
+        [row] = CAPSULE.index()
+        assert row["trigger"] == TRIGGER_CONSERVATION
+        assert row["detail"] == {"pod": "p-42"}
+
+    def test_lock_cycle_and_invariant_breach_polled(self, monkeypatch):
+        from karpenter_tpu import invariants
+        from karpenter_tpu.analysis.witness import WITNESS
+
+        monkeypatch.setattr(WITNESS, "cycles", lambda: [("a.lock", "b.lock", "a.lock")])
+        monkeypatch.setattr(invariants.MONITOR, "armed", lambda: True)
+        monkeypatch.setattr(
+            invariants.MONITOR,
+            "violations",
+            lambda: [{"invariant": "threads.leak", "entity": "straggler", "detail": "x", "t": 0.0}],
+        )
+        _enable(CAPSULE)
+        assert CAPSULE.poll() == 2
+        triggers = {row["trigger"]: row["detail"] for row in CAPSULE.index()}
+        assert triggers[TRIGGER_LOCK_CYCLE] == {"cycle": "a.lock->b.lock->a.lock"}
+        assert triggers[TRIGGER_INVARIANT] == {"invariant": "threads.leak", "entity": "straggler"}
+
+
+class TestBurnRate:
+    def test_no_samples_means_no_burn(self):
+        eng = _enable(CapsuleEngine())
+        rates = eng.burn_rates()
+        assert rates == {
+            "pending_latency": {"fast": 0.0, "slow": 0.0},
+            "cost_drift": {"fast": 0.0, "slow": 0.0},
+        }
+        assert eng.poll() == 0
+
+    def test_fast_window_alone_does_not_fire(self):
+        from karpenter_tpu import slo as slo_mod
+
+        eng = _enable(
+            CapsuleEngine(),
+            pending_objective=1.0,
+            error_budget=0.5,
+            fast_window=4,
+            slow_window=20,
+            min_samples=4,
+        )
+        for value in [0.1] * 16 + [5.0] * 4:
+            slo_mod.PENDING_LATENCY.observe(value, provisioner="default")
+        rates = eng.burn_rates()
+        assert rates["pending_latency"]["fast"] >= 1.0
+        assert rates["pending_latency"]["slow"] < 1.0
+        assert eng.poll() == 0, "the cliff without the sustained burn is a blip, not an incident"
+        # the gauges export both windows regardless (the alerting surface)
+        assert capsule_mod.BURN_RATE.value(slo="pending_latency", window="fast") >= 1.0
+        assert capsule_mod.BURN_RATE.value(slo="pending_latency", window="slow") < 1.0
+
+    def test_both_windows_burning_captures_an_slo_burn_capsule(self):
+        from karpenter_tpu import slo as slo_mod
+
+        eng = _enable(
+            CapsuleEngine(),
+            pending_objective=1.0,
+            error_budget=0.5,
+            fast_window=4,
+            slow_window=20,
+            min_samples=4,
+        )
+        for _ in range(20):
+            slo_mod.PENDING_LATENCY.observe(5.0, provisioner="default")
+        assert eng.poll() == 1
+        [row] = eng.index()
+        assert row["trigger"] == TRIGGER_SLO_BURN and row["detail"] == {"slo": "pending_latency"}
+        # the capsule snapshots the burn rates that fired it
+        doc = eng.capsule_by_id(row["id"])
+        assert doc["burn_rate"]["pending_latency"]["slow"] >= 1.0
+        # the same sustained burn is one incident, not one per poll
+        assert eng.poll() == 0
+
+    def test_cost_drift_series_is_poll_sampled(self):
+        from karpenter_tpu import slo as slo_mod
+
+        eng = _enable(
+            CapsuleEngine(),
+            cost_objective=2.0,
+            error_budget=1.0,
+            fast_window=3,
+            slow_window=5,
+            min_samples=3,
+        )
+        slo_mod.COST_DRIFT.set(5.0)
+        captured = sum(eng.poll() for _ in range(4))
+        assert captured == 1
+        [row] = eng.index()
+        assert row["trigger"] == TRIGGER_SLO_BURN and row["detail"] == {"slo": "cost_drift"}
+
+
+class TestSpool:
+    def _capture(self, eng, n):
+        eng.trigger(TRIGGER_HOST_RUNG, rung="host", solve=n)
+        assert eng.poll() == 1
+
+    def _on_disk(self, path):
+        return {name: os.path.getsize(os.path.join(path, name)) for name in os.listdir(path)}
+
+    def test_rotation_never_exceeds_the_byte_budget(self, tmp_path):
+        # measure one real capsule, then give the spool room for ~2
+        probe = _enable(CapsuleEngine(), spool=str(tmp_path / "probe"))
+        self._capture(probe, 0)
+        [size] = self._on_disk(str(tmp_path / "probe")).values()
+        budget = int(size * 2.5)
+        evictions_before = SPOOL_EVICTIONS.value()
+        spool = str(tmp_path / "spool")
+        eng = _enable(CapsuleEngine(), spool=spool, spool_max_bytes=budget)
+        for i in range(6):
+            self._capture(eng, i)
+            on_disk = self._on_disk(spool)
+            assert sum(on_disk.values()) <= budget, f"capture {i}: {sum(on_disk.values())} bytes > {budget} budget"
+        assert SPOOL_EVICTIONS.value() - evictions_before >= 1, "load never evicted a capsule"
+        # oldest evicted first: the newest capture always survives on disk
+        assert any(name.endswith("_0006.json") for name in self._on_disk(spool))
+        assert eng.stats()["spool_bytes"] == sum(self._on_disk(spool).values())
+        # every surviving file round-trips through the schema
+        for name in self._on_disk(spool):
+            with open(os.path.join(spool, name), encoding="utf-8") as f:
+                assert capsule_errors(json.load(f)) == [], name
+
+    def test_single_capsule_over_budget_evicts_itself_but_rings(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        eng = _enable(CapsuleEngine(), spool=spool, spool_max_bytes=1024)
+        self._capture(eng, 0)
+        assert self._on_disk(spool) == {}, "a capsule larger than the whole budget must not stay on disk"
+        assert len(eng.index()) == 1, "the in-memory ring still serves it"
+        assert eng.stats()["spool_bytes"] == 0
+
+    def test_dead_disk_disables_spool_not_capture(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        eng = _enable(CapsuleEngine(), spool=spool)
+        self._capture(eng, 0)
+        assert len(self._on_disk(spool)) == 1
+        eng._spool_dir = str(tmp_path / "vanished")  # simulate the disk dying under the spool
+        self._capture(eng, 1)
+        self._capture(eng, 2)
+        assert len(eng.index()) == 3, "ring capture survives the dead disk"
+        assert eng.stats()["spool"] is None, "a dead spool reports itself on the debug surface"
+
+    def test_unwritable_spool_path_never_blocks_enable(self, tmp_path):
+        blocker = tmp_path / "file-not-dir"
+        blocker.write_text("x")
+        eng = _enable(CapsuleEngine(), spool=str(blocker / "nested"))
+        self._capture(eng, 0)
+        assert len(eng.index()) == 1
+        assert eng.stats()["spool"] is None
+
+    def test_restart_seeds_accounting_and_sequence_from_disk(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        first = _enable(CapsuleEngine(), spool=spool)
+        self._capture(first, 0)
+        self._capture(first, 1)
+        on_disk = self._on_disk(spool)
+        second = _enable(CapsuleEngine(), spool=spool)
+        assert second.stats()["spool_bytes"] == sum(on_disk.values()), "a restart must keep honoring the budget"
+        self._capture(second, 2)
+        assert any(name.endswith("_0003.json") for name in self._on_disk(spool)), sorted(self._on_disk(spool))
+
+    def test_budget_invariant_covers_ring_and_spool(self, tmp_path, monkeypatch):
+        """The shared rotation-budget invariant: the soak monitor watches the
+        capsule ring and spool the same way it watches the journal's —
+        declared bound dropping under live occupancy is a violation."""
+        from karpenter_tpu import invariants
+        from karpenter_tpu.kube.cluster import KubeCluster
+
+        kube = KubeCluster(clock=FakeClock())
+        CAPSULE.enable(spool=str(tmp_path / "sp"), debounce_seconds=0.0, clock=kube.clock)
+        CAPSULE.trigger(TRIGGER_HOST_RUNG, rung="host")
+        assert CAPSULE.poll() == 1
+        invariants.MONITOR.arm(kube, clock=kube.clock)
+        try:
+            assert invariants.MONITOR.sample()["violations"] == 0
+            monkeypatch.setattr(CAPSULE, "_spool_max_bytes", 1)
+            monkeypatch.setattr(CAPSULE, "capacity", 0)
+            invariants.MONITOR.sample()
+            fired = {v["invariant"] for v in invariants.MONITOR.violations()}
+            assert {"capsule.ring", "capsule.spool"} <= fired, fired
+        finally:
+            invariants.MONITOR.disarm()
+
+
+class TestDebugRoute:
+    def test_index_and_404_json_contract(self):
+        _enable(CAPSULE)
+        CAPSULE.trigger(TRIGGER_BREAKER_OPEN, fault_kind="device-lost", threshold=3)
+        assert CAPSULE.poll() == 1
+        status, ctype, body = capsule_mod._capsules_route({})
+        assert status == 200 and "json" in ctype
+        payload = json.loads(body)
+        assert payload["enabled"] is True and payload["captures_total"] == 1
+        assert {"capsules", "burn_rate", "suppressed", "spool_bytes"} <= set(payload)
+        [row] = payload["capsules"]
+        status, _, body = capsule_mod._capsules_route({"id": [row["id"]]})
+        assert status == 200
+        assert capsule_errors(json.loads(body)) == []
+        status, ctype, body = capsule_mod._capsules_route({"id": ["nope"]})
+        assert status == 404 and "json" in ctype
+        assert json.loads(body) == {"error": "no capsule with id 'nope'", "status": 404}
+
+    def test_route_descriptions_in_lockstep(self):
+        assert set(capsule_mod.routes()) == set(capsule_mod.route_descriptions())
+
+
+class TestInspectCLI:
+    def _spooled_capsule(self, tmp_path):
+        from karpenter_tpu import journal as journal_mod
+
+        clock = FakeClock()
+        journal_mod.JOURNAL.enable(capacity=256, clock=clock)
+        journal_mod.JOURNAL.reset()
+        for i in range(5):
+            journal_mod.JOURNAL.pod_event(f"pod-{i}", "created")
+            clock.step(0.25)
+        spool = str(tmp_path / "spool")
+        eng = _enable(CapsuleEngine(), spool=spool, clock=clock)
+        eng.trigger(TRIGGER_BREAKER_OPEN, fault_kind="device-lost", threshold=3)
+        assert eng.poll() == 1
+        [name] = os.listdir(spool)
+        return os.path.join(spool, name)
+
+    def test_inspect_prints_the_incident_story(self, tmp_path, capsys):
+        from karpenter_tpu.cmd import capsule as cmd_capsule
+
+        path = self._spooled_capsule(tmp_path)
+        assert cmd_capsule.main(["inspect", path]) == 0
+        out = capsys.readouterr().out
+        assert "breaker-open-0001" in out
+        assert "fault_kind=device-lost" in out
+        assert "burn rate" in out and "fault timeline" in out and "breaker" in out
+
+    def test_replay_round_trips_the_journal_slice(self, tmp_path, capsys):
+        from karpenter_tpu.cmd import capsule as cmd_capsule
+
+        path = self._spooled_capsule(tmp_path)
+        assert cmd_capsule.main(["inspect", path, "--replay", "--compress", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "replay schedule" in out and "digest" in out
+        assert "5 arrivals" in out and "pod-0" in out
+
+    def test_unreadable_and_invalid_capsules_exit_nonzero(self, tmp_path, capsys):
+        from karpenter_tpu.cmd import capsule as cmd_capsule
+
+        assert cmd_capsule.main(["inspect", str(tmp_path / "missing.json")]) == 1
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"capsule": {}}))
+        assert cmd_capsule.main(["inspect", str(bad)]) == 1
+        assert "capsule schema" in capsys.readouterr().err

@@ -10,6 +10,7 @@ suite.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -77,6 +78,7 @@ def test_deployment_shaped_topology(apiserver):
     controller = _spawn(
         "karpenter_tpu.cmd.controller",
         "--disable-dense-solver",
+        "--enable-capsules",
         "--batch-max-duration", "0.3",
         "--batch-idle-duration", "0.05",
         "--health-probe-port", str(health_port),
@@ -100,7 +102,7 @@ def test_deployment_shaped_topology(apiserver):
                 with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=2) as resp:
                     return resp.status, resp.read().decode()
             except urllib.error.HTTPError as err:
-                return err.code, ""
+                return err.code, err.read().decode()
             except OSError:
                 return None, ""
 
@@ -108,6 +110,20 @@ def test_deployment_shaped_topology(apiserver):
         assert _wait(lambda: http_status(health_port, "/readyz")[0] == 200 or None, message="readyz")
         code, metrics_text = http_status(metrics_port, "/metrics")
         assert code == 200 and "karpenter" in metrics_text
+
+        # incident-capsule debug surface over the REAL server: the index is
+        # JSON with the spool stats, and a missing id honours the 404-JSON
+        # contract every debug route shares (never an HTML error page)
+        code, capsules_text = http_status(metrics_port, "/debug/capsules")
+        assert code == 200, capsules_text
+        capsules_index = json.loads(capsules_text)
+        assert capsules_index["enabled"] is True
+        assert capsules_index["capsules"] == []
+        assert {"captures_total", "suppressed", "spool_bytes"} <= set(capsules_index)
+        code, missing_text = http_status(metrics_port, "/debug/capsules?id=nope")
+        assert code == 404
+        missing = json.loads(missing_text)
+        assert missing["status"] == 404 and "nope" in missing["error"]
 
         # admission enforces THROUGH the self-registered configuration
         with pytest.raises(ApiStatusError):
@@ -126,6 +142,60 @@ def test_deployment_shaped_topology(apiserver):
                 proc.kill()
                 proc.communicate()
         client.stop()
+
+
+def test_controller_process_serves_capsule_debug_surface(apiserver):
+    """The incident-capsule read surface over a REAL controller process
+    (no webhook, so this runs even without the TLS stack): the
+    /debug/capsules index is JSON with the spool stats, and a missing id
+    honours the 404-JSON contract every debug route shares."""
+    import urllib.error
+    import urllib.request
+
+    health_port, metrics_port = _free_port(), _free_port()
+    controller = _spawn(
+        "karpenter_tpu.cmd.controller",
+        "--disable-dense-solver",
+        "--enable-capsules",
+        "--batch-max-duration", "0.3",
+        "--batch-idle-duration", "0.05",
+        "--health-probe-port", str(health_port),
+        "--metrics-port", str(metrics_port),
+        env_extra={"KUBERNETES_APISERVER_URL": apiserver.url},
+    )
+
+    def fetch(path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{metrics_port}{path}", timeout=2) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+        except OSError:
+            return None, ""
+
+    try:
+        assert _wait(lambda: fetch("/debug/capsules")[0] is not None or None, message="metrics listener")
+        code, body = fetch("/debug/capsules")
+        assert code == 200, body
+        index = json.loads(body)
+        assert index["enabled"] is True
+        assert index["capsules"] == [] and index["captures_total"] == 0, "a healthy controller captures nothing"
+        assert {"suppressed", "spool_bytes", "burn_rate"} <= set(index)
+        code, body = fetch("/debug/capsules?id=nope")
+        assert code == 404
+        missing = json.loads(body)
+        assert missing["status"] == 404 and "nope" in missing["error"]
+        # the route is registered in the /debug index alongside its description
+        code, body = fetch("/debug")
+        if code == 200:
+            assert "/debug/capsules" in body
+    finally:
+        controller.terminate()
+        try:
+            controller.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            controller.kill()
+            controller.communicate()
 
 
 def test_full_deployment_topology(apiserver):

@@ -84,6 +84,8 @@ class TestSchemaValidator:
                         "chaos_injected_total": 0,
                         "chaos_history_digest": None,
                         "compressed_seconds": 1.0,
+                        "capsules_captured": 0,
+                        "capsule_triggers": {},
                         "waterfall": {
                             "queue_wait": {"p50": 0.0, "p95": 0.01, "p99": 0.01, "count": 4},
                             "solve": {"p50": 0.02, "p95": 0.03, "p99": 0.03, "count": 4},
