@@ -76,7 +76,7 @@ def log(msg: str) -> None:
 # are diffed in the report but never gate.
 COMPARE_PHASE_KEYS = (
     "encode", "fill", "device", "mask", "assemble", "commit", "fill_device",
-    "delta_apply", "full_encode", "compilations",
+    "delta_apply", "full_encode", "audit_seconds", "compilations",
 )
 COMPARE_DEFAULT_THRESHOLD = 10.0  # percent
 
@@ -425,7 +425,7 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         k: []
         for k in (
             "encode", "fill", "device", "mask", "assemble", "commit", "fill_device",
-            "delta_apply", "full_encode",
+            "delta_apply", "full_encode", "audit_seconds",
         )
     }
     last_stats = None
@@ -451,6 +451,10 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
         # everywhere so --compare diffs the same key set across artifacts
         phase_trials["delta_apply"].append(stats.delta_apply_seconds)
         phase_trials["full_encode"].append(stats.full_encode_seconds)
+        # residency-auditor overhead (solver/audit.py): zero on the stock
+        # configs (the auditor is disabled), populated when the
+        # incremental_churn config runs with auditing on
+        phase_trials["audit_seconds"].append(stats.audit_seconds)
         log(
             f"  [{name}] trial {elapsed*1000:.1f} ms (encode {stats.encode_seconds*1000:.0f}"
             f" fill {stats.fill_seconds*1000:.0f} device {stats.device_seconds*1000:.0f}"
@@ -490,7 +494,7 @@ def run_config(name, pods, provider, provisioners, solver, state_nodes=(), trial
     return float(np.median(times) * 1000), times
 
 
-def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phase_key=None):
+def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phase_key=None, audit_interval: int = 0):
     """INCREMENTAL config: a large standing cluster absorbing a small
     per-pass delta — the O(delta) steady-state claim, measured and PINNED.
 
@@ -541,6 +545,21 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
     cluster = Cluster(kube, None)
     engine = IncrementalEngine(cluster.delta_journal)
     solver = DenseSolver(min_batch=1, incremental=engine)
+
+    # optional residency auditing (solver/audit.py) riding the measured
+    # window: audit_interval=1 audits EVERY pass, so both audit shapes — the
+    # 8-row sampled gather and the full-shadow gather on the 128 dirty-pad
+    # rung — trace during the two warmup passes (audit 0 is a shadow, audit
+    # 1 is sampled) and the steady-state recompile gate below covers
+    # audit-induced compiles too
+    audit_div_base = audit_pass_base = 0
+    if audit_interval:
+        from karpenter_tpu.solver import audit as solver_audit
+
+        solver_audit.AUDITOR.reset()
+        solver_audit.AUDITOR.enable(interval=audit_interval, seed=7)
+        audit_div_base = solver_audit.divergences_total()
+        audit_pass_base = solver_audit.audit_passes_total()
 
     def churn(step):
         # three pod binds + one node-status refresh: <= 4 dirty node names
@@ -601,7 +620,7 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
     full_base = engine.passes[PASS_FULL]
     compile_base = flight.FLIGHT.compilations_total()
 
-    times, delta_apply, full_encode = [], [], []
+    times, delta_apply, full_encode, audit_times = [], [], [], []
     skipped = 0
     for step in range(2, passes + 2):
         churn(step)
@@ -609,6 +628,7 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
         times.append(elapsed)
         delta_apply.append(stats.delta_apply_seconds)
         full_encode.append(stats.full_encode_seconds)
+        audit_times.append(stats.audit_seconds)
         skipped += stats.encode_skipped_passes
         log(
             f"  [incremental_churn] pass {step} {elapsed*1000:.1f} ms "
@@ -630,6 +650,28 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
     assert compilations == 0, (
         f"[incremental_churn] {compilations} XLA recompile(s) across {passes} consecutive delta passes"
     )
+    audit_info = {}
+    if audit_interval:
+        audit_divergences = solver_audit.divergences_total() - audit_div_base
+        audit_passes = solver_audit.audit_passes_total() - audit_pass_base
+        # the auditor rode every measured pass: a byte-equal resident state
+        # under clean churn must diverge ZERO times (a nonzero here is a
+        # real integrity bug, not noise), it must actually have audited,
+        # and its overhead must stay bounded — note the compilations==0
+        # assert above already proved the audit gathers re-traced nothing
+        assert audit_divergences == 0, (
+            f"[incremental_churn] auditor found {audit_divergences} divergence(s) on clean churn"
+        )
+        assert audit_passes >= passes, (
+            f"[incremental_churn] auditor ran {audit_passes} audits across {passes} passes"
+        )
+        audit_ms = round(float(np.median(audit_times)) * 1000, 3)
+        assert audit_ms < 50.0, f"[incremental_churn] audit overhead {audit_ms} ms/pass"
+        audit_info = {
+            "audit_passes": audit_passes,
+            "audit_divergences": audit_divergences,
+            "audit_seconds": audit_ms,
+        }
 
     # parity coda (outside the measured window): the next delta pass must
     # place identically to a fresh-encode solver on the same snapshot + batch
@@ -648,6 +690,9 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
     assert sig(results_i) == sig(results_f), (
         "[incremental_churn] incremental placements diverge from a fresh encode"
     )
+    if audit_interval:
+        solver_audit.AUDITOR.disable()
+        solver_audit.AUDITOR.reset()
 
     info = {
         "nodes": node_count,
@@ -658,6 +703,7 @@ def run_incremental_churn(node_count: int, pods_per_pass: int, passes: int, phas
         "delta_apply": round(float(np.median(delta_apply)) * 1000, 3),
         "full_encode": round(float(max(full_encode)) * 1000, 3),
         "compilations": compilations,
+        **audit_info,
     }
     if phase_key is not None:
         PHASE_BREAKDOWN[phase_key] = {**info, "span_tree": capture_span_tree()}
@@ -902,11 +948,19 @@ def _smoke() -> dict:
     # acceptance window (12 consecutive delta passes >= the 10-pass pin):
     # run_incremental_churn asserts the gates internally; the ISSUE pins are
     # re-asserted here so a softened helper can't silently pass the smoke
-    log("smoke: incremental_churn (O(delta) steady state)")
-    _, inc_info = run_incremental_churn(80, 25, 12, phase_key="incremental_churn")
+    log("smoke: incremental_churn (O(delta) steady state, auditor riding every pass)")
+    _, inc_info = run_incremental_churn(80, 25, 12, phase_key="incremental_churn", audit_interval=1)
     assert inc_info["compilations"] == 0, (
         f"[incremental_churn] {inc_info['compilations']} recompile(s) in steady state"
     )
+    # the residency auditor rode every measured pass: zero divergences on
+    # clean churn, zero audit-induced recompiles (covered by the
+    # compilations==0 pin above — audit gather shapes ride the pow2 ladder
+    # traced in warmup), bounded overhead asserted inside the helper
+    assert inc_info["audit_divergences"] == 0, (
+        f"[incremental_churn] {inc_info['audit_divergences']} audit divergence(s) on clean churn"
+    )
+    assert inc_info["audit_passes"] >= 12, "[incremental_churn] auditor never engaged in the smoke"
     assert inc_info["encode_skipped_passes"] == inc_info["passes"], (
         "[incremental_churn] a steady-state pass re-encoded from scratch"
     )
@@ -916,7 +970,7 @@ def _smoke() -> dict:
     # smoke summary — a helper that stopped reporting them would have
     # silently dropped the regression surface
     churn_phase = PHASE_BREAKDOWN.get("incremental_churn") or {}
-    for key in ("delta_apply", "full_encode", "encode_skipped_passes"):
+    for key in ("delta_apply", "full_encode", "encode_skipped_passes", "audit_seconds"):
         assert key in churn_phase, f"[incremental_churn] phases JSON missing {key!r}"
     summary["incremental_churn"] = inc_info
 

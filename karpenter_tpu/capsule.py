@@ -86,6 +86,7 @@ TRIGGER_CONSERVATION = "conservation-violation"
 TRIGGER_LOCK_CYCLE = "lock-cycle"
 TRIGGER_INVARIANT = "invariant-breach"
 TRIGGER_SLO_BURN = "slo-burn"
+TRIGGER_RESIDENCY = "residency-divergence"
 
 TRIGGERS = (
     TRIGGER_BREAKER_OPEN,
@@ -95,6 +96,7 @@ TRIGGERS = (
     TRIGGER_LOCK_CYCLE,
     TRIGGER_INVARIANT,
     TRIGGER_SLO_BURN,
+    TRIGGER_RESIDENCY,
 )
 
 # the capsule document's required top-level blocks (capsule_errors gates

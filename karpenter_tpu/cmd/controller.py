@@ -140,6 +140,13 @@ def main(argv=None) -> int:
 
         extra_routes.update(capsule.routes())
         debug_descriptions.update(capsule.route_descriptions())
+    if options.residency_audit_interval > 0:
+        # residency-auditor read surface: audit cadence, divergences by
+        # kind, heal count, last divergence detail on the metrics port
+        from ..solver import audit
+
+        extra_routes.update(audit.routes())
+        debug_descriptions.update(audit.route_descriptions())
     if options.coherence_interval > 0:
         # informer-coherence witness read surface: registered caches,
         # confirmed divergences vs the store, last check on the metrics port

@@ -341,6 +341,10 @@ class FlightRecorder:
             # stable shapes are exactly what the zero-steady-state-recompile
             # gate pins, so it MUST be attributable by name
             self.register_jit_entry("rebase_view_state", rebase.rebase_view_state)
+            # the residency auditor's sampled-row readback rides the same
+            # pow2 ladder; attributable by name so an audit-induced
+            # recompile is visible (bench --smoke pins it at zero)
+            self.register_jit_entry("gather_rows", rebase.gather_rows)
         except Exception as exc:  # noqa: BLE001 - per-fn attribution is best-effort
             log.warning("rebase jit entry unavailable: %r", exc)
         try:

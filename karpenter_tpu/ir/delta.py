@@ -48,6 +48,24 @@ POD_REMOVED = "pod-removed"
 
 DELTA_KINDS = (NODE_ADDED, NODE_REMOVED, POD_BOUND, POD_REMOVED)
 
+# seeded corruption seam (solver/faults.py): when armed, record() consults
+# this hook and — if it answers True — SUPPRESSES the delta (no epoch bump,
+# no ring entry), modeling a missed journal event for the residency
+# auditor's detection proofs. This module is an import leaf, so the fault
+# injector reaches in through a module global instead of an import; None
+# (the production state) keeps record() at one global read.
+_corrupt_consult = None
+
+
+def set_corrupt_seam(consult) -> None:
+    """Arm (callable `(node, kind) -> bool`, True suppresses the record) or
+    disarm (None) the journal's corruption seam. The hook sees the delta
+    before deciding so injectors can target a kind family — suppressing a
+    pod-level record is the detectable missed-delta shape; node add/remove
+    suppressions are invisible (the engine diffs the row set directly)."""
+    global _corrupt_consult
+    _corrupt_consult = consult
+
 # default ring capacity: sized for a large cluster's worst-case burst
 # between two provision passes (a reclaim wave touching every node once is
 # ~cluster-size events; 4096 covers the 16k-view bench's per-pass churn
@@ -98,6 +116,13 @@ class DeltaJournal:
         """Append one delta; returns its epoch. Thread-safe, leaf-locked."""
         if kind not in DELTA_KINDS:
             raise ValueError(f"unknown delta kind: {kind!r}")
+        consult = _corrupt_consult
+        if consult is not None and consult(node, kind):
+            # seeded suppression: the mutation happened but the journal
+            # never hears of it — the missed-delta shape the auditor hunts.
+            # The current epoch is returned so callers see a valid handle.
+            with self._lock:
+                return self._epoch
         with self._lock:
             self._epoch += 1
             entry = Delta(self._epoch, node, kind)

@@ -32,6 +32,7 @@ exactly on host) already absorbs f32 rounding.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -70,6 +71,32 @@ def rebase_view_state(buf: jax.Array, perm: jax.Array, rows: jax.Array, idx: jax
     returns [Vp, R] f32 in buf's storage."""
     gathered = jnp.where((perm >= 0)[:, None], buf[jnp.clip(perm, 0, None)], jnp.float32(-1.0))
     return gathered.at[idx].set(rows, mode="drop")
+
+
+@jax.jit
+def gather_rows(buf: jax.Array, idx: jax.Array) -> jax.Array:
+    """Sampled-row readback for the residency auditor: gather `idx` rows of
+    the resident buffer in one dispatch. `idx` is ladder-padded by
+    `pack_gather` (pad slots point at row 0 — harmless duplicates the host
+    discards), so steady-state audits reuse a handful of compiled shapes
+    and never recompile. `buf` is NOT donated: the audit is a read."""
+    return buf[idx]
+
+
+def pack_gather(idx: np.ndarray, pad: Optional[int] = None) -> np.ndarray:
+    """Host-side padding for gather_rows: logical row indices → padded i32
+    (pad slots 0; callers slice the gather back to len(idx)). Default pad
+    is the pow2 dirty ladder; the residency auditor instead passes the
+    resident buffer's own row pad, so a sampled audit and a full shadow
+    share ONE compiled gather shape per buffer shape — an audit can then
+    only ever compile alongside a views-pad change, which the solve
+    signature attributes (contract-declared varying axis), never on its
+    own mid-steady-state."""
+    d = idx.shape[0]
+    dp = pad_dirty(d) if pad is None else max(int(pad), d)
+    idx_p = np.zeros(dp, np.int32)
+    idx_p[:d] = idx
+    return idx_p
 
 
 def rebase_view_state_np(buf: np.ndarray, perm: np.ndarray, rows: np.ndarray, idx: np.ndarray) -> np.ndarray:

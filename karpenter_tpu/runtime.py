@@ -127,6 +127,14 @@ class Runtime:
                 debounce_seconds=self.options.capsule_debounce_seconds,
                 clock=self.kube.clock,
             )
+        if self.options.residency_audit_interval > 0:
+            # residency auditor (solver/audit.py): interval + clock only —
+            # enable() is a kwargs-merge, so a harness's shadow cadence and
+            # audit seed survive a Runtime restart (the BREAKER.configure
+            # discipline)
+            from .solver.audit import AUDITOR
+
+            AUDITOR.enable(interval=self.options.residency_audit_interval, clock=self.kube.clock)
         self.config = Config(self.options.batch_max_duration, self.options.batch_idle_duration, self.options.log_level)
         # live log-level reload, the config-logging ConfigMap analog
         # (controllers.go:240-248): a config update re-levels the tree

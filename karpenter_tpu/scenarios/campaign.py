@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 from .. import capsule, flight, invariants, journal, slo
 from ..kube import chaos as kube_chaos
 from ..kube.coherence import COHERENCE
+from ..solver import audit as solver_audit
 from ..solver import faults as solver_faults
 from ..utils.seeds import split_seed
 from ..api import labels as lbl
@@ -51,6 +52,7 @@ from .primitives import (
     DiurnalRamp,
     DriftRollout,
     LeaseSteal,
+    OutOfBandBind,
     PoolCapacity,
     ProcessCrash,
     Scenario,
@@ -368,7 +370,41 @@ def soak_settled(ctx: ScenarioContext, schedule: ChaosSchedule, require_delta_pa
         # capture from the seeded compile faults); the mini-soak's shorter
         # schedule keeps the default of zero
         return False
+    if getattr(ctx.runtime.options, "residency_audit_interval", 0) > 0:
+        # the residency auditor rode the soak: it must have actually audited
+        # (>= 1 executed audit), and — since a soak plans no corruption
+        # specs — divergences pin at EXACTLY zero. Compressed hours of churn
+        # with byte-equal residency is the auditor's specificity witness:
+        # the storm scenario proves it catches real corruption, the soak
+        # proves it never cries wolf
+        if solver_audit.audit_passes_total() - ctx.audit_passes_at_start < 1:
+            return False
+        if solver_audit.divergences_total() - ctx.residency_divergences_at_start != 0:
+            return False
     return not invariants.MONITOR.violations()
+
+
+def residency_settled(ctx: ScenarioContext) -> bool:
+    """The residency-divergence-storm convergence bar: both seeded
+    corruptions actually fired (a run the injections never reached proves
+    nothing), the auditor detected EXACTLY one divergence per injection
+    (none missed, none spurious), every divergence healed (invalidate with
+    reason 'audit' forced the byte-equal full re-encode path), at least one
+    clean audit has run since the last divergence (the rebuilt resident
+    state re-verified against cluster truth — the placement-parity
+    witness), and each divergence kind left its own capsule behind."""
+    plan = solver_faults.FAULTS.plan
+    if plan is None or plan.corruptions_fired() < 2:
+        return False
+    divergences = solver_audit.divergences_total() - ctx.residency_divergences_at_start
+    heals = solver_audit.heals_total() - ctx.residency_heals_at_start
+    if divergences != plan.corruptions_fired() or heals != divergences:
+        return False
+    if solver_audit.AUDITOR.clean_streak() < 1:
+        return False
+    # two injections of different kinds -> two distinct fingerprints (the
+    # capsule detail is {kinds, rows}, transport-stable by construction)
+    return len(capsule.CAPSULE.fingerprints().get(capsule.TRIGGER_RESIDENCY, ())) >= 2
 
 
 def _lost_pods(ctx: ScenarioContext) -> int:
@@ -447,6 +483,15 @@ class CampaignRunner:
             solver_faults.FAULTS.install(
                 solver_faults.FaultPlan.from_specs(scenario.fault_specs, seed=derived_seeds["fault_seed"])
             )
+        # residency auditor (solver/audit.py): per-run audit state drops;
+        # the campaign pre-seeds the sampling knobs HERE (shadow_every=1 —
+        # scenario clusters are small, so every audit is a full shadow and
+        # detection is same-pass deterministic; the derived audit seed makes
+        # both transports draw identical samples) and the scenario's Runtime
+        # merges in interval + clock via its own kwargs-merge enable()
+        solver_audit.AUDITOR.reset()
+        if scenario.residency_audit_interval > 0:
+            solver_audit.AUDITOR.enable(shadow_every=1, seed=derived_seeds["audit_seed"])
         kube_conflicts_at_start = kube_chaos.conflicts_total()
         kube = KubeCluster()
         backend = CloudBackend(clock=kube.clock)
@@ -497,6 +542,11 @@ class CampaignRunner:
                     # engine (solver/incremental.py): settling then requires
                     # delta passes taken + a flat solve-latency p95
                     solver_incremental=scenario.solver_incremental,
+                    # the residency storm (and the soak's healthy pin) audit
+                    # the resident state on the scenario's cadence; restarts
+                    # re-wire interval + clock without clobbering the
+                    # campaign's pre-seeded sampling knobs above
+                    residency_audit_interval=scenario.residency_audit_interval,
                     solver_breaker_threshold=scenario.solver_breaker_threshold,
                     solver_breaker_backoff=scenario.solver_breaker_backoff,
                     solver_hbm_budget_bytes=scenario.solver_hbm_budget_bytes,
@@ -518,6 +568,14 @@ class CampaignRunner:
                     # must capture their evidence bundles (scored below),
                     # healthy runs must capture exactly none
                     enable_capsules=True,
+                    # per-kind capture debounce override: the residency
+                    # storm needs BOTH of its distinct divergence captures,
+                    # which land closer together than the production default
+                    **(
+                        {"capsule_debounce_seconds": scenario.capsule_debounce_seconds}
+                        if scenario.capsule_debounce_seconds is not None
+                        else {}
+                    ),
                     gc_interval=1.0,
                     gc_registration_grace=3.0,
                     # scenario timescales are seconds: a parked pod must
@@ -551,6 +609,12 @@ class CampaignRunner:
         # the soak engaged bar) — stamp run-start and score the delta
         incremental_delta_at_start = _incremental_delta_passes()
         ctx.incremental_delta_at_start = incremental_delta_at_start
+        # residency-auditor counters are process-lifetime monotonic too:
+        # stamp run-start so scores and settled predicates see THIS run's
+        # divergence/heal/audit deltas
+        ctx.residency_divergences_at_start = solver_audit.divergences_total()
+        ctx.residency_heals_at_start = solver_audit.heals_total()
+        ctx.audit_passes_at_start = solver_audit.audit_passes_total()
         start = time.monotonic()
         try:
             # control-plane fault domain (kube/chaos.py): the seeded
@@ -626,6 +690,22 @@ class CampaignRunner:
             invariant_report = invariants.MONITOR.report()
             schedules = [p for p in scenario.primitives if isinstance(p, ChaosSchedule)]
             solver_injected = int(solver_faults.FAULTS.fired())
+            # residency-integrity accounting: this run's divergence/heal/
+            # audit deltas. A divergence on a run with NO corruption specs
+            # is a REAL resident-state integrity bug (the auditor compared
+            # against freshly re-encoded truth and lost) — fail the run
+            # loudly, exactly like a conservation violation
+            residency_divergences = int(solver_audit.divergences_total() - ctx.residency_divergences_at_start)
+            residency_heals = int(solver_audit.heals_total() - ctx.residency_heals_at_start)
+            audit_passes = int(solver_audit.audit_passes_total() - ctx.audit_passes_at_start)
+            corruption_planned = any(
+                spec.get("kind") in solver_faults.CORRUPTION_KINDS for spec in (scenario.fault_specs or ())
+            )
+            if residency_divergences and not corruption_planned:
+                raise AssertionError(
+                    f"[{scenario.name}/{transport}] residency auditor found {residency_divergences}"
+                    f" divergence(s) on a run with no corruption specs: resident state diverged from truth"
+                )
             kube_injected = int(kube_chaos.KUBE_CHAOS.fired())
             duration_wall = time.monotonic() - start
             compressed = scenario.compressed_span if isinstance(scenario, Soak) and scenario.compressed_span > 0 else duration_wall
@@ -683,6 +763,13 @@ class CampaignRunner:
                     # across transports pin the capture-determinism witness
                     "capsules_captured": int(capsule.CAPSULE.captures_total()),
                     "capsule_triggers": capsule.CAPSULE.fingerprints(),
+                    # residency-auditor scores (solver/audit.py): healthy
+                    # runs pin divergences at 0 (asserted above); the storm
+                    # scenario's settled predicate requires divergences ==
+                    # injections and heals == divergences
+                    "residency_divergences": residency_divergences,
+                    "residency_heals": residency_heals,
+                    "audit_passes": audit_passes,
                 },
                 "samples": samples,
             }
@@ -713,6 +800,8 @@ class CampaignRunner:
             journal.JOURNAL.disable()
             capsule.CAPSULE.disable()
             solver_faults.FAULTS.clear()  # never leak a fault plan past its run
+            solver_audit.AUDITOR.disable()  # same discipline for the auditor
+            solver_audit.AUDITOR.reset()
             kube.chaos_watch_gap_end()  # a gap leaked past its run wedges nothing
             kube_chaos.KUBE_CHAOS.clear()
             invariants.MONITOR.disarm()  # ends the window; tracemalloc off
@@ -1048,6 +1137,49 @@ def default_campaign() -> List[Scenario]:
             ],
             description="burst under a degraded cloud API: injected latency + 429 throttling",
         ),
+        Scenario(
+            name="residency_divergence_storm",
+            desired=0,
+            duration=10.0,
+            dense_solver=True,
+            solver_incremental=True,
+            residency_audit_interval=1,  # every real pass audited
+            capsule_debounce_seconds=0.0,  # both divergences captured, not debounced
+            instance_types=["general-4x8"],
+            # the seeded corruption pair (solver/faults.py): flip one value
+            # in the resident HOST mirror at the first resident pass — the
+            # same-pass full shadow detects it as row-drift before the fill
+            # consumes the encoding — then suppress the 11th pod-level
+            # DeltaJournal record: the OUT-OF-BAND bind at t=4.8 below
+            # (8 burst binds + t=2.0 + t=3.4 = records 1-10, so 11 is the
+            # interloper). It must be out-of-band — the engine rebases its
+            # OWN placements into the mirror before the record matters, so a
+            # suppressed solver-planned bind is undetectable by design. The
+            # 0.1-cpu pod lands on the burst-filled first node (0.4 cpu
+            # spare, too tight for the stand-in's 0.5-cpu replicas), so that
+            # node's journal window stays silent and the NEXT pass's audit
+            # classifies the stale mirror row missed-delta, not row-drift
+            fault_specs=[
+                {"kind": "corrupt-row", "entry": "resident-row", "nth": 1},
+                {"kind": "suppress-delta", "entry": "journal-record", "nth": 11},
+            ],
+            settled=residency_settled,
+            primitives=[
+                Burst(offset=0.3, count=8),  # builds the fleet; the engine warms to resident
+                Burst(offset=2.0, count=1),  # single binds from here on: each pass's journal
+                Burst(offset=3.4, count=1),  # traffic is exactly one record — no sibling masking
+                OutOfBandBind(offset=4.8, cpu=0.1),  # the suppressed record (see fault_specs)
+                Burst(offset=6.2, count=1),  # the detection pass: audit sees the stale row
+                Burst(offset=7.6, count=1),  # post-heal pass: the clean-audit parity witness
+            ],
+            description=(
+                "seeded resident-state corruption under churn: a host-mirror row flip and a "
+                "suppressed delta-journal record — the auditor must detect exactly one divergence "
+                "per injection (row-drift, then missed-delta), heal each by forcing the byte-equal "
+                "full re-encode, re-verify clean, and leave one capsule per divergence kind, with "
+                "zero lost pods"
+            ),
+        ),
         chaos_soak_scenario(),
     ]
 
@@ -1086,6 +1218,10 @@ def chaos_soak_scenario(seed: int = 11) -> Soak:
         # device-resident incremental engine under the chaos weather: the
         # settled predicate then also demands delta passes + flat p95
         solver_incremental=True,
+        # the residency auditor rides every pass of the soak: with no
+        # corruption specs planned, soak_settled pins divergences at
+        # exactly zero — the specificity half of the auditor's proof
+        residency_audit_interval=1,
         fault_specs=schedule.solver_specs(),
         kube_fault_specs=schedule.kube_specs(),
         settled=functools.partial(soak_settled, schedule=schedule, require_delta_passes=1, require_capsules=1),
@@ -1136,6 +1272,7 @@ def mini_soak_scenario(seed: int = 5, extra_events: Optional[List[dict]] = None)
         instance_types=["general-4x8"],
         dense_solver=True,
         solver_incremental=True,  # same engine wiring as the full soak
+        residency_audit_interval=1,  # and the same zero-divergence pin
         fault_specs=schedule.solver_specs(),
         kube_fault_specs=schedule.kube_specs(),
         settled=functools.partial(soak_settled, schedule=schedule),

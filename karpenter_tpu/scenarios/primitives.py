@@ -53,6 +53,12 @@ class ScenarioContext:
         # same run-start stamping for the incremental engine's monotonic
         # delta-pass counter (the soak settled predicate scores the delta)
         self.incremental_delta_at_start = 0
+        # run-start stamps for the residency auditor's monotonic counters
+        # (solver/audit.py): scores and settled predicates read this run's
+        # divergence/heal/audit deltas, not process-lifetime absolutes
+        self.residency_divergences_at_start = 0
+        self.residency_heals_at_start = 0
+        self.audit_passes_at_start = 0
         self.stop = threading.Event()
         self._lock = threading.Lock()
         self._desired = 0
@@ -129,6 +135,47 @@ class ScaleTo(Primitive):
 
     def run(self, ctx: ScenarioContext) -> None:
         ctx.desired = self.count
+
+
+@dataclass
+class OutOfBandBind(Primitive):
+    """Create a pod already BOUND to live capacity, bypassing both the
+    provisioner and the stand-in scheduler — the way a second scheduler, a
+    static pod, or a manual bind lands in the informer. The solver never
+    planned this placement, so the incremental engine's resident mirror can
+    only learn it from the DeltaJournal record — which makes this the one
+    bind whose SUPPRESSED record is detectable (suppressing a solver-planned
+    bind is a no-op: the engine rebases its own placements into the mirror
+    before the record ever matters). The residency storm aims its
+    dropped-delta injection here."""
+
+    cpu: float = 0.1
+    app: str = "oob"
+
+    def run(self, ctx: ScenarioContext) -> None:
+        from .standin import pod_cpu_request, workload_pod
+
+        for node in ctx.kube.list_nodes():
+            if node.spec.unschedulable or node.metadata.deletion_timestamp is not None or not node.ready():
+                continue
+            used = sum(pod_cpu_request(p) for p in ctx.kube.pods_on_node(node.name))
+            if node.status.allocatable.get("cpu", 0.0) - used < self.cpu:
+                continue
+            pod = workload_pod(self.cpu, app=self.app)
+            pod.spec.node_name = node.name
+            pod.status.phase = "Running"
+            pod.status.conditions = []
+            ctx.kube.create(pod)
+            # the ReplicaSet stand-in reconciles ALL live pods against
+            # `desired`: account for the interloper or the next tick would
+            # evict a scenario replica to compensate
+            ctx.add_desired(1)
+            log.info(
+                "out-of-band bind: %s -> %s (%.2f cpu, no solver involvement)",
+                pod.metadata.name, node.name, self.cpu,
+            )
+            return
+        log.warning("out-of-band bind found no schedulable spare capacity; skipped")
 
 
 @dataclass
@@ -371,6 +418,16 @@ class Scenario:
     # FLAT as the cluster grows at fixed per-tick delta
     solver_incremental: bool = False
     fault_specs: Optional[List[dict]] = None
+    # residency auditor (solver/audit.py, --residency-audit-interval): audit
+    # every Nth incremental pass against re-encoded cluster truth; 0 = off.
+    # Scenarios that turn it on score residency_divergences/heals/audit_passes
+    # — healthy runs pin divergences at 0, the storm requires them to equal
+    # its injections
+    residency_audit_interval: int = 0
+    # per-kind capsule capture debounce override (None = the campaign's
+    # default): the residency storm injects two distinct corruptions close
+    # together and needs BOTH residency-divergence captures inside its window
+    capsule_debounce_seconds: Optional[float] = None
     # seed fan-out (utils/seeds.py): `seed` is the ONE master knob — the
     # solver fault seed, the kube fault seed, the stand-in's jitter, and a
     # chaos schedule's streams all derive from it splitmix-style, so two
@@ -406,6 +463,7 @@ class Scenario:
             ),
             "standin_jitter_seed": split_seed(self.seed, "standin.jitter"),
             "chaos_schedule_seed": split_seed(self.seed, "chaos.schedule"),
+            "audit_seed": split_seed(self.seed, "solver.audit"),
         }
 
     def config(self) -> dict:
@@ -426,6 +484,8 @@ class Scenario:
             "offering_ttl": self.offering_ttl,
             "dense_solver": self.dense_solver,
             "solver_incremental": self.solver_incremental,
+            "residency_audit_interval": self.residency_audit_interval,
+            "capsule_debounce_seconds": self.capsule_debounce_seconds,
             "fault_specs": self.fault_specs,
             "fault_seed": self.fault_seed,
             "solver_breaker_threshold": self.solver_breaker_threshold,

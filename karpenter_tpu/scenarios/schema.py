@@ -103,6 +103,15 @@ SCORE_KEYS = (
     # campaign runner asserts before an artifact lands
     "capsules_captured",
     "capsule_triggers",
+    # residency-auditor scores (solver/audit.py): divergences the auditor
+    # detected this run (healthy scenarios pin 0 — a nonzero here on a run
+    # with no corruption specs is a REAL integrity bug, and run_one raises),
+    # auto-heals issued (the storm scenario requires heals == divergences),
+    # and audits executed (>= 1 proves the auditor actually ran where the
+    # scenario enabled it)
+    "residency_divergences",
+    "residency_heals",
+    "audit_passes",
 )
 
 BREAKER_STATES = ("closed", "half-open", "open")
@@ -149,6 +158,7 @@ def run_errors(run, where: str = "run") -> List[str]:
             "kube_conflicts_total", "kube_faults_injected", "informer_divergences", "double_launches",
             "leaked_threads", "leaked_watches", "invariant_violations", "chaos_injected_total",
             "encode_skipped_passes", "capsules_captured",
+            "residency_divergences", "residency_heals", "audit_passes",
         ):
             value = scores.get(field)
             if value is not None and not isinstance(value, int):

@@ -1,0 +1,498 @@
+"""Residency integrity auditor: continuous divergence detection + auto-heal.
+
+PR 16's incremental engine introduced the repo's first LONG-LIVED state: a
+host mirror of the warm-view encoding plus a donated device buffer that
+survive across provision passes. Every prior fault domain protects a
+stateless solve — a missed DeltaJournal record, a donation-aliasing bug, or
+a silent device corruption now compounds into every future placement, and
+nothing would notice until placements drift. This module is the integrity
+domain for that state: on a configurable cadence it re-derives a bounded
+sample of the truth (re-encoding view rows straight from cluster state, the
+same f64 expressions as the fresh path) and compares it against everything
+the engine holds resident.
+
+Four divergence kinds, each a distinct failure shape:
+
+  row-drift       a resident host-mirror row disagrees with a fresh encode
+                  of the same view and the world did NOT move under it —
+                  the mirror itself was damaged (bit flip, aliasing bug,
+                  a splice that copied the wrong row);
+  missed-delta    the world moved (the row's truth changed since the last
+                  audit) but the DeltaJournal never named the node, so the
+                  engine kept serving the stale row — the lost-journal-
+                  record shape the double-window rule cannot heal;
+  device-corrupt  the resident device buffer's sampled rows disagree with
+                  the host mirror's f32 projection (they are byte-equal by
+                  construction: _upload writes f32(head0), every rebase
+                  scatters f32 recomputes) — the donated buffer rotted;
+  cube-stale      the dense solver's cached availability cube no longer
+                  matches the host availability array it was built from.
+
+Audit shape discipline: the per-audit sample is a SEEDED bounded draw
+(`sample_rows`, deterministic in (seed, audit index)) whose device gather
+rides the same pow2 ladder as the rebase kernel (`ops/rebase.pad_dirty`),
+so steady-state audits never recompile; every `shadow_every`-th audit
+upgrades to a FULL shadow encode when the cluster fits the byte budget
+(`shadow_budget_bytes`), which is also the end-state parity witness the
+residency chaos scenario settles on.
+
+Divergence ⇒ `karpenter_solver_residency_divergences_total{kind}`, a
+`residency-divergence` capsule trigger (detail carries the divergence kinds
+and row count — row NAMES are process-relative and would break the
+cross-transport fingerprint witness; the full row list rides
+/debug/residency and the capsule's journal block), and AUTO-HEAL: the
+engine's residency is invalidated with reason 'audit', so the next pass is
+the existing byte-equal full re-encode — zero lost pods by construction.
+The caller additionally discards the audited pass's encoding (the fresh
+path re-derives it), so a corrupted mirror never shapes a placement.
+
+Singleton discipline matches TRACER/FLIGHT: process-wide `AUDITOR`, true
+no-op when disabled (one attribute read at the hook), clock-seam timed
+stamps, `@guarded_by` under a witnessed `solver.audit` lock, and a
+/debug/residency route in routes()/route_descriptions() lockstep.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..analysis.guards import guarded_by
+from ..analysis.witness import WITNESS
+from ..capsule import CAPSULE, TRIGGER_RESIDENCY
+from ..logsetup import get_logger
+from ..metrics import REGISTRY
+from ..utils.clock import Clock
+
+log = get_logger("solver.audit")
+
+# -- divergence taxonomy ------------------------------------------------------
+
+KIND_ROW_DRIFT = "row-drift"
+KIND_MISSED_DELTA = "missed-delta"
+KIND_CUBE_STALE = "cube-stale"
+KIND_DEVICE_CORRUPT = "device-corrupt"
+DIVERGENCE_KINDS = (KIND_ROW_DRIFT, KIND_MISSED_DELTA, KIND_CUBE_STALE, KIND_DEVICE_CORRUPT)
+
+# the encoded fields one audited row compares; the digest below covers all
+# of them, so ANY damaged field diverges the row
+ROW_FIELDS = ("usable", "avail_tol", "requests0", "head0", "zone", "ct", "hostname", "taint_sig")
+
+# approximate bytes one shadow-encoded row costs (three [R] f64 arrays plus
+# the identity lists) — the budget arithmetic only needs the right order of
+# magnitude to keep a 16k-view shadow from landing on every audit
+SHADOW_ROW_BYTES = 256
+
+DEFAULT_SAMPLE_ROWS = 8
+DEFAULT_SHADOW_EVERY = 8
+DEFAULT_SHADOW_BUDGET_BYTES = 16 * 2**20
+
+# registered at import so gen_docs sees the families without a live auditor
+RESIDENCY_DIVERGENCES = REGISTRY.counter(
+    "karpenter_solver_residency_divergences_total",
+    "Resident-state divergences the residency auditor detected, by kind:"
+    " 'row-drift' (host mirror row damaged), 'missed-delta' (truth moved but"
+    " the DeltaJournal never named the node), 'cube-stale' (cached"
+    " availability cube disagrees with its host source), 'device-corrupt'"
+    " (resident device buffer disagrees with the mirror's f32 projection).",
+    ("kind",),
+)
+RESIDENCY_HEALS = REGISTRY.counter(
+    "karpenter_solver_residency_heals_total",
+    "Auto-heals the residency auditor issued: audits that found at least one"
+    " divergence, invalidated the engine's resident state (reason 'audit'),"
+    " and discarded the audited pass's encoding so the fresh full re-encode"
+    " path owns the next placement.",
+)
+AUDIT_PASSES = REGISTRY.counter(
+    "karpenter_solver_residency_audit_passes_total",
+    "Residency audits executed (cadenced provision passes that re-encoded a"
+    " seeded row sample — or a full shadow — from cluster truth and compared"
+    " it against the engine's resident state).",
+)
+
+
+def divergences_total() -> int:
+    """Sum of the divergence counter across kinds (score surface)."""
+    return int(sum(RESIDENCY_DIVERGENCES.values().values()))
+
+
+def heals_total() -> int:
+    return int(RESIDENCY_HEALS.value())
+
+
+def audit_passes_total() -> int:
+    return int(AUDIT_PASSES.value())
+
+
+def _row_digest(enc, i: int) -> str:
+    """16-hex digest over every encoded field of row `i` — the unit of
+    truth/mirror comparison. f64 bytes are hashed raw, so the digest is
+    exact, not tolerance-based (encode_warm_views is deterministic and
+    row-independent; byte equality is the pinned contract)."""
+    h = hashlib.sha256()
+    h.update(b"1" if bool(enc.usable[i]) else b"0")
+    h.update(np.ascontiguousarray(enc.avail_tol[i], dtype=np.float64).tobytes())
+    h.update(np.ascontiguousarray(enc.requests0[i], dtype=np.float64).tobytes())
+    h.update(np.ascontiguousarray(enc.head0[i], dtype=np.float64).tobytes())
+    h.update(repr((enc.zone[i], enc.ct[i], enc.hostname[i], tuple(enc.taint_sig[i]))).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+def _differing_fields(fresh, j: int, mirror, i: int) -> List[str]:
+    """Which encoded fields disagree between fresh row j and mirror row i —
+    divergence-detail only, never on the clean path."""
+    out = []
+    if bool(fresh.usable[j]) != bool(mirror.usable[i]):
+        out.append("usable")
+    for name in ("avail_tol", "requests0", "head0"):
+        if not np.array_equal(getattr(fresh, name)[j], getattr(mirror, name)[i]):
+            out.append(name)
+    if fresh.zone[j] != mirror.zone[i]:
+        out.append("zone")
+    if fresh.ct[j] != mirror.ct[i]:
+        out.append("ct")
+    if fresh.hostname[j] != mirror.hostname[i]:
+        out.append("hostname")
+    if tuple(fresh.taint_sig[j]) != tuple(mirror.taint_sig[i]):
+        out.append("taint_sig")
+    return out
+
+
+@guarded_by(
+    "_lock",
+    "_passes",
+    "_audits",
+    "_heals",
+    "_divergences",
+    "_truth_digest",
+    "_last_epoch",
+    "_last_divergence",
+    "_clean_streak",
+)
+class ResidencyAuditor:
+    """The process-wide resident-state integrity auditor (the TRACER/FLIGHT
+    singleton pattern). DenseSolver consults `maybe_audit` once per real
+    provision pass, right after the engine advances and before the warm fill
+    consumes the encoding — the one point where the resident state, the
+    caller's view snapshot, and the journal checkpoint all describe the same
+    instant, so an exact byte comparison carries no concurrency false
+    positives (views are per-solve snapshots; ExistingNodeView copies its
+    state)."""
+
+    def __init__(self):
+        self._lock = WITNESS.lock("solver.audit")
+        self.enabled = False
+        self.interval = 0  # audit every Nth eligible pass; 0 = never
+        self.sample_rows = DEFAULT_SAMPLE_ROWS
+        self.shadow_every = DEFAULT_SHADOW_EVERY
+        self.shadow_budget_bytes = DEFAULT_SHADOW_BUDGET_BYTES
+        self.seed = 0
+        self.clock: Clock = Clock()
+        self._passes = 0
+        self._audits = 0
+        self._heals = 0
+        self._divergences: Dict[str, int] = {}
+        # last observed TRUTH digest per audited row: the classifier's
+        # memory — a divergent row whose truth moved since its last audit
+        # without the journal naming the node is a missed delta, not drift
+        self._truth_digest: Dict[str, str] = {}
+        # journal epoch at the end of the previous audit: the window
+        # `dirty_since` answers the classifier over
+        self._last_epoch = 0
+        self._last_divergence: Optional[dict] = None
+        # consecutive clean audits since the last divergence — >=1 is the
+        # end-state parity witness the residency storm settles on (a clean
+        # full shadow means any solve from this state is byte-identical to
+        # a fresh solver's)
+        self._clean_streak = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def enable(
+        self,
+        interval: Optional[int] = None,
+        sample_rows: Optional[int] = None,
+        shadow_every: Optional[int] = None,
+        shadow_budget_bytes: Optional[int] = None,
+        seed: Optional[int] = None,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        """Arm the auditor; None keeps a knob's current value, so a Runtime
+        restart re-wiring interval+clock does not clobber a harness's
+        shadow cadence (the BREAKER.configure merge discipline)."""
+        if WITNESS.enabled and isinstance(self._lock, __import__("threading").Lock().__class__):
+            # constructed before the witness came up: adopt a witnessed lock
+            # (enable runs at Runtime assembly, before any solve holds it)
+            self._lock = WITNESS.lock("solver.audit")
+        if interval is not None:
+            self.interval = max(0, int(interval))
+        if sample_rows is not None:
+            self.sample_rows = max(1, int(sample_rows))
+        if shadow_every is not None:
+            self.shadow_every = max(1, int(shadow_every))
+        if shadow_budget_bytes is not None:
+            self.shadow_budget_bytes = max(0, int(shadow_budget_bytes))
+        if seed is not None:
+            self.seed = int(seed)
+        if clock is not None:
+            self.clock = clock
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop per-run audit state (cadence counters, digest memory, last
+        divergence). The monotonic metric families survive — campaign
+        consumers score deltas, the counter discipline every other
+        singleton follows."""
+        with self._lock:
+            self._passes = 0
+            self._audits = 0
+            self._heals = 0
+            self._divergences = {}
+            self._truth_digest = {}
+            self._last_epoch = 0
+            self._last_divergence = None
+            self._clean_streak = 0
+
+    # -- the per-pass hook (dense.py) ----------------------------------------
+
+    def maybe_audit(self, engine, views: Sequence, cube_host=None, cube_dev=None) -> Optional[dict]:
+        """Cadence gate + audit. Returns None when no audit ran or the audit
+        was clean; on divergence returns a report dict (kinds, rows,
+        cube_stale) AFTER healing the engine (invalidate reason 'audit') —
+        the caller must then discard the pass's encoding and, on
+        cube_stale, its cube cache."""
+        if not self.enabled or self.interval <= 0:
+            return None
+        res = getattr(engine, "_resident", None)
+        if res is None or not views:
+            return None
+        with self._lock:
+            self._passes += 1
+            due = self._passes % self.interval == 0
+            audit_index = self._audits
+        if not due:
+            return None
+        # the mirror's row identity must match the caller's snapshot
+        # exactly (advance() just committed against these views); anything
+        # else means the engine is mid-transition — skip, never guess
+        names = [v.node.name for v in views]
+        if res.names != names:
+            return None
+        t0 = time.perf_counter()
+        report = self._audit(engine, res, views, names, audit_index, cube_host, cube_dev)
+        AUDIT_PASSES.inc()
+        dt = time.perf_counter() - t0
+        if report is not None:
+            log.warning(
+                "residency divergence: kinds=%s rows=%s (audit #%d, %.1fms) — healing via full re-encode",
+                report["kinds"], report["rows"], audit_index, dt * 1000.0,
+            )
+        return report
+
+    def _audit(
+        self,
+        engine,
+        res,
+        views: Sequence,
+        names: List[str],
+        audit_index: int,
+        cube_host,
+        cube_dev,
+    ) -> Optional[dict]:
+        from ..ir.encode import encode_warm_views
+
+        V = len(views)
+        # sample selection: a full shadow when the cadence says so and the
+        # cluster fits the byte budget, else the seeded bounded draw (the
+        # draw is a pure function of (seed, audit index) — deterministic,
+        # and it walks the whole cluster over successive audits)
+        shadow = (
+            audit_index % self.shadow_every == 0
+            and V * SHADOW_ROW_BYTES <= self.shadow_budget_bytes
+        )
+        if shadow or V <= self.sample_rows:
+            idx = list(range(V))
+        else:
+            rng = random.Random((self.seed, audit_index))
+            idx = sorted(rng.sample(range(V), self.sample_rows))
+
+        # truth: re-encode the sampled views with the exact fresh-path
+        # expressions (encode_warm_views is row-independent, so sub-row j
+        # is byte-identical to full-encode row idx[j])
+        fresh = encode_warm_views([views[i] for i in idx])
+
+        findings: List[dict] = []  # {"row": name, "kind": ..., "fields": [...]}
+        mirror = res.enc
+        fresh_digests: Dict[str, str] = {}
+        with self._lock:
+            window = engine.journal.dirty_since(self._last_epoch)
+            for j, i in enumerate(idx):
+                name = names[i]
+                truth_digest = _row_digest(fresh, j)
+                fresh_digests[name] = truth_digest
+                if truth_digest == _row_digest(mirror, i):
+                    continue
+                prior = self._truth_digest.get(name)
+                # classification: the journal window since the previous
+                # audit is the engine's only knowledge of motion — truth
+                # that moved OUTSIDE it is a record the journal lost
+                if prior is not None and prior != truth_digest and window is not None and name not in window:
+                    kind = KIND_MISSED_DELTA
+                else:
+                    kind = KIND_ROW_DRIFT
+                findings.append({"row": name, "kind": kind, "fields": _differing_fields(fresh, j, mirror, i)})
+
+        # device residency: the sampled buffer rows must equal the mirror's
+        # f32 projection byte-for-byte (inductively true: _upload writes
+        # f32(head0) and every rebase scatters f32 recomputes). The gather
+        # index pads to the resident buffer's OWN row pad — not the pow2
+        # dirty ladder — so sampled audits and full shadows share one
+        # compiled gather shape per buffer shape: a fresh gather compile can
+        # only coincide with a views-pad change, which the solve signature
+        # attributes to a contract-declared varying axis (a row-count pad
+        # crossing a pow2 bucket mid-soak would otherwise read as a
+        # steady-state recompile on the first transport leg only).
+        device_rows: List[str] = []
+        if res.head_dev is not None:
+            try:
+                import jax.numpy as jnp
+
+                from ..ops.rebase import gather_rows, pack_gather
+
+                idx_p = pack_gather(np.asarray(idx, dtype=np.int32), pad=int(res.head_dev.shape[0]))
+                got = np.asarray(gather_rows(res.head_dev, jnp.asarray(idx_p)))[: len(idx)]
+                want = mirror.head0[idx].astype(np.float32)
+                if not np.array_equal(got, want):
+                    bad = np.nonzero(~np.all(got == want, axis=1))[0]
+                    device_rows = [names[idx[int(b)]] for b in bad]
+            except Exception as exc:  # noqa: BLE001 - the audit must never fail a solve
+                log.warning("residency device audit unavailable this pass: %r", exc)
+
+        # availability cube: dense's cached device cube vs the host array
+        # it was derived from (same reshape+cast the cache performs)
+        cube_stale = False
+        if cube_host is not None and cube_dev is not None:
+            try:
+                want_cube = np.ascontiguousarray(cube_host).reshape(cube_host.shape[0], -1).astype(np.float32)
+                got_cube = np.asarray(cube_dev)
+                cube_stale = got_cube.shape != want_cube.shape or not np.array_equal(got_cube, want_cube)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("residency cube audit unavailable this pass: %r", exc)
+
+        kinds = [f["kind"] for f in findings] + [KIND_DEVICE_CORRUPT] * len(device_rows)
+        if cube_stale:
+            kinds.append(KIND_CUBE_STALE)
+        row_names = sorted({f["row"] for f in findings} | set(device_rows))
+
+        with self._lock:
+            self._audits += 1
+            self._truth_digest.update(fresh_digests)
+            self._last_epoch = engine.journal.current_epoch()
+            if not kinds:
+                self._clean_streak += 1
+                return None
+            self._clean_streak = 0
+            for kind in kinds:
+                self._divergences[kind] = self._divergences.get(kind, 0) + 1
+                RESIDENCY_DIVERGENCES.inc(kind=kind)
+            self._heals += 1
+            self._last_divergence = {
+                "t": self.clock.now(),
+                "audit": audit_index,
+                "rows": row_names,
+                "kinds": sorted(set(kinds)),
+                "findings": findings + [{"row": n, "kind": KIND_DEVICE_CORRUPT, "fields": ["head_dev"]} for n in device_rows],
+                "cube_stale": cube_stale,
+                "journal_window": sorted(window) if window is not None else None,
+                "shadow": shadow,
+            }
+            # capsule detail carries only transport-stable fields (kinds +
+            # counts): row names embed process-relative instance counters
+            # and would break the byte-identical-fingerprint witness
+            if CAPSULE.enabled:
+                CAPSULE.trigger(TRIGGER_RESIDENCY, kinds=sorted(set(kinds)), rows=len(row_names))
+        # heal OUTSIDE the audit lock: invalidate is two attribute writes on
+        # the single-threaded engine, but keeping it out preserves the
+        # audit lock as a leaf
+        RESIDENCY_HEALS.inc()
+        engine.invalidate("audit")
+        return {"kinds": sorted(set(kinds)), "rows": row_names, "cube_stale": cube_stale}
+
+    # -- read surfaces -------------------------------------------------------
+
+    def clean_streak(self) -> int:
+        with self._lock:
+            return self._clean_streak
+
+    def stats(self) -> dict:
+        """The /debug/residency index document."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "interval": self.interval,
+                "sample_rows": self.sample_rows,
+                "shadow_every": self.shadow_every,
+                "shadow_budget_bytes": self.shadow_budget_bytes,
+                "passes_seen": self._passes,
+                "audits": self._audits,
+                "divergences": dict(sorted(self._divergences.items())),
+                "heals": self._heals,
+                "clean_streak": self._clean_streak,
+                "rows_tracked": len(self._truth_digest),
+                "last_divergence": json.loads(json.dumps(self._last_divergence)),
+            }
+
+    def row_detail(self, name: str) -> Optional[dict]:
+        """Per-row shadow state for ?row= queries; None when the row was
+        never audited."""
+        with self._lock:
+            digest = self._truth_digest.get(name)
+            if digest is None:
+                return None
+            return {"row": name, "truth_digest": digest, "audits": self._audits}
+
+
+AUDITOR = ResidencyAuditor()
+
+
+def enabled() -> bool:
+    return AUDITOR.enabled
+
+
+# -- HTTP route (ObservabilityServer extra routes) ----------------------------
+
+
+def _json(status, payload) -> tuple:
+    return status, "application/json; charset=utf-8", json.dumps(payload) + "\n"
+
+
+def _residency_route(query: dict) -> tuple:
+    raw = (query.get("row") or [None])[0]
+    if raw is None:
+        return _json(200, AUDITOR.stats())
+    detail = AUDITOR.row_detail(raw)
+    if detail is None:
+        return _json(404, {"error": f"row {raw!r} has never been audited", "status": 404})
+    return _json(200, detail)
+
+
+def routes() -> dict:
+    """The residency-auditor read surface, served from the metrics listener
+    (cmd/controller.py wires it behind --residency-audit-interval)."""
+    return {"/debug/residency": _residency_route}
+
+
+def route_descriptions() -> dict:
+    """/debug-index descriptions, keyed like routes() (see tracing.py)."""
+    return {
+        "/debug/residency": "residency auditor: audit cadence/counters, divergences by kind, heal count, last divergence detail; ?row= per-row shadow digest",
+    }

@@ -205,6 +205,10 @@ class DenseSolveStats:
     delta_apply_seconds: float = 0.0
     full_encode_seconds: float = 0.0
     encode_skipped_passes: int = 0
+    # residency auditor (solver/audit.py): time spent re-encoding the seeded
+    # row sample / full shadow and comparing it against the resident state —
+    # the integrity tax on the incremental path, bounded by bench --smoke
+    audit_seconds: float = 0.0
     # offering-availability mask application (subset of device_seconds): the
     # [T, Z, C] cube reduced over per-bucket zone/ct allowances as one
     # batched device matmul — quarantined pools are routed around here, and
@@ -436,6 +440,7 @@ class DenseSolver:
         mask_before = self.stats.mask_seconds  # delta -> this solve's mask child span
         delta_before = self.stats.delta_apply_seconds  # incremental split of the assemble story
         full_before = self.stats.full_encode_seconds
+        audit_before = self.stats.audit_seconds  # residency auditor's share of the fill phase
         t0 = time.perf_counter()
         zones = scheduler.topology.domains.get(lbl.LABEL_TOPOLOGY_ZONE, ())
         capacity_types = scheduler.topology.domains.get(lbl.LABEL_CAPACITY_TYPE, ())
@@ -596,6 +601,7 @@ class DenseSolver:
                     "fill_device": stats.fill_device_seconds - stats_before.fill_device_seconds,
                     "delta_apply": stats.delta_apply_seconds - delta_before,
                     "full_encode": stats.full_encode_seconds - full_before,
+                    "audit_seconds": stats.audit_seconds - audit_before,
                 },
                 fill_routing={
                     "fills_vectorized": stats.fills_vectorized - stats_before.fills_vectorized,
@@ -1242,10 +1248,34 @@ class DenseSolver:
             # resident; a full pass rebuilds it (attributed by reason).
             # Simulation re-solves bypass: hypothetical views have no
             # journal feed and must not clobber the real resident state.
+            from .audit import AUDITOR
             from .incremental import PASS_DELTA, PASS_FULL
 
             adv = self.incremental.advance(scheduler.existing_nodes, getattr(self, "_solve_ckey", ()))
-            if adv.kind == PASS_DELTA:
+            healed = None
+            if AUDITOR.enabled:
+                # residency auditor (solver/audit.py): this is the one point
+                # where the resident state, the views snapshot, and the
+                # journal checkpoint all describe the same instant — audit
+                # BEFORE the pass's encoding shapes any placement
+                ta = time.perf_counter()
+                cached_cube = getattr(self, "_avail_cube_dev", None)
+                healed = AUDITOR.maybe_audit(
+                    self.incremental,
+                    scheduler.existing_nodes,
+                    cube_host=cached_cube[0] if cached_cube is not None else None,
+                    cube_dev=cached_cube[1] if cached_cube is not None else None,
+                )
+                self.stats.audit_seconds += time.perf_counter() - ta
+            if healed is not None:
+                # divergence found and healed (residency already invalidated
+                # with reason 'audit'): the audited pass's encoding is
+                # suspect — discard it so warmfill takes the fresh path, and
+                # drop the cached availability cube when it was the stale
+                # artifact
+                if healed.get("cube_stale"):
+                    self._avail_cube_dev = None
+            elif adv.kind == PASS_DELTA:
                 self.stats.delta_apply_seconds += adv.seconds
                 self.stats.encode_skipped_passes += 1
                 enc = adv.enc
@@ -1627,14 +1657,15 @@ class DenseSolver:
             return
         self._solve_rungs.append(rung)
         DEGRADED_SOLVES.inc(rung=rung)
-        # fault-domain interaction with the incremental engine: a flavor
-        # retirement or a host takeover mid-solve means device buffers may
-        # be stale, half-donated, or pinned to a retired path — void the
-        # resident state so the NEXT pass is a clean full re-encode
-        # (attributed fault-flavor / fault-host; pinned by
-        # tests/test_incremental_faults.py). Chunked dispatch is benign:
-        # the split surface still computed the same program on live buffers.
-        if self.incremental is not None and rung != RUNG_CHUNKED:
+        # fault-domain interaction with the incremental engine: ANY rung
+        # taken mid-solve means a device dispatch already faulted under this
+        # pass — buffers may be stale, half-donated, or pinned to a retired
+        # path, and the chunked path's split-dispatch lifetimes are outside
+        # the residency contract too — so every rung voids the resident
+        # state and the NEXT pass is a clean full re-encode (attributed
+        # fault-flavor / fault-chunked / fault-host; pinned by
+        # tests/test_incremental_faults.py).
+        if self.incremental is not None:
             self.incremental.invalidate(f"fault-{rung}")
         if rung == RUNG_HOST and CAPSULE.enabled:
             # the ladder hit the floor: freeze the evidence rings (the

@@ -121,6 +121,17 @@ _FAULT_BY_KIND = {
     KIND_DEVICE_LOST: SolverDeviceLostError,
 }
 
+# state-corruption kinds: unlike the raising taxonomy above, these never
+# raise — a fired corruption spec tells ITS seam to silently damage the
+# incremental engine's resident state (flip a resident row, suppress a
+# DeltaJournal record, perturb the donated device buffer), so the
+# residency auditor's detection claims are provable against a known,
+# seeded, history-witnessed injection rather than vacuous on healthy runs
+KIND_CORRUPT_ROW = "corrupt-row"
+KIND_SUPPRESS_DELTA = "suppress-delta"
+KIND_CORRUPT_DEVICE = "corrupt-device"
+CORRUPTION_KINDS = (KIND_CORRUPT_ROW, KIND_SUPPRESS_DELTA, KIND_CORRUPT_DEVICE)
+
 # textual signatures per kind, checked in order: jaxlib raises version-soup
 # exception types, but the gRPC status words and the XLA error vocabulary
 # are stable across releases. HBM first (an OOM message often also says
@@ -209,10 +220,12 @@ def degraded_total() -> int:
 @dataclass
 class FaultSpec:
     """One planned trigger. `entry` names the dispatch boundary ('plain',
-    'sharded', 'pallas', 'chunk', 'warmfill', or '*'); `nth` fires on the
-    nth matching call (1-based) for `count` consecutive matching calls;
-    with `nth` None, `probability` draws a seeded coin per matching call —
-    still fully deterministic for a given (plan, seed, call sequence)."""
+    'sharded', 'pallas', 'chunk', 'warmfill', 'rebase', or '*' — corruption
+    kinds target the state seams 'resident-row', 'journal-record',
+    'rebase'); `nth` fires on the nth matching call (1-based) for `count`
+    consecutive matching calls; with `nth` None, `probability` draws a
+    seeded coin per matching call — still fully deterministic for a given
+    (plan, seed, call sequence)."""
 
     kind: str
     entry: str = "*"
@@ -221,8 +234,10 @@ class FaultSpec:
     probability: float = 0.0
 
     def __post_init__(self):
-        if self.kind not in _FAULT_BY_KIND:
-            raise ValueError(f"unknown fault kind {self.kind!r}; one of {sorted(_FAULT_BY_KIND)}")
+        if self.kind not in _FAULT_BY_KIND and self.kind not in CORRUPTION_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {sorted((*_FAULT_BY_KIND, *CORRUPTION_KINDS))}"
+            )
         if self.nth is not None and self.nth < 1:
             raise ValueError("nth is 1-based")
         if not (0.0 <= self.probability <= 1.0):
@@ -249,15 +264,18 @@ class FaultPlan:
     def from_specs(cls, specs: Sequence[dict], seed: int = 0) -> "FaultPlan":
         return cls([FaultSpec(**spec) for spec in specs], seed=seed)
 
-    def check(self, entry: str) -> None:
-        """Consult the plan at one dispatch-boundary call; raises the
-        planned typed fault when a trigger fires (first matching spec
-        wins)."""
+    def _consult(self, entry: str, corruption: bool) -> Optional[FaultSpec]:
+        """Shared trigger logic: one plan call against either the raising
+        taxonomy specs (dispatch boundaries) or the corruption specs (state
+        seams). A spec's per-match counter only advances at ITS seam family,
+        so mixing both families in one plan stays deterministic."""
         fire: Optional[FaultSpec] = None
         with self._lock:
             self._calls += 1
             call = self._calls
             for i, spec in enumerate(self.specs):
+                if (spec.kind in CORRUPTION_KINDS) != corruption:
+                    continue
                 if spec.entry != "*" and spec.entry != entry:
                     continue
                 self._spec_calls[i] += 1
@@ -273,8 +291,29 @@ class FaultPlan:
                     fire = spec
             if fire is not None:
                 self._history.append({"call": call, "entry": entry, "kind": fire.kind})
+        return fire
+
+    def check(self, entry: str) -> None:
+        """Consult the plan at one dispatch-boundary call; raises the
+        planned typed fault when a trigger fires (first matching spec
+        wins)."""
+        fire = self._consult(entry, corruption=False)
         if fire is not None:
             raise _FAULT_BY_KIND[fire.kind](f"injected {fire.kind} fault at dispatch entry {entry!r}")
+
+    def corrupt(self, entry: str) -> Optional[str]:
+        """Consult the plan at one state seam; returns the corruption kind
+        to apply (never raises — the seam damages its own state silently,
+        which is the whole point: the auditor must FIND it). Fired triggers
+        land in the same determinism `history()` as the raising kinds."""
+        fire = self._consult(entry, corruption=True)
+        return fire.kind if fire is not None else None
+
+    def corruptions_fired(self) -> int:
+        """Fired corruption triggers only (the storm scenario's
+        divergences == injections bar)."""
+        with self._lock:
+            return sum(1 for h in self._history if h["kind"] in CORRUPTION_KINDS)
 
     def history(self) -> List[dict]:
         """The fired triggers, in dispatch order (determinism witness)."""
@@ -304,14 +343,36 @@ class FaultInjector:
 
     def install(self, plan: FaultPlan) -> None:
         self._plan = plan
+        # the journal's mutator seam lives in ir/delta.py, which imports
+        # nothing from this package (it must stay a leaf): arm its module
+        # hook ONLY when the plan actually carries suppress-delta specs, so
+        # every other plan leaves record() at one module-global read
+        if any(spec.kind == KIND_SUPPRESS_DELTA for spec in plan.specs):
+            from ..ir import delta as ir_delta
+
+            # only pod-level records are suppressible: a dropped NODE_ADDED/
+            # NODE_REMOVED is invisible (the engine diffs the row set without
+            # the journal), so suppressing one would spend a trigger on an
+            # injection no auditor could ever detect
+            ir_delta.set_corrupt_seam(
+                lambda node, kind: kind in (ir_delta.POD_BOUND, ir_delta.POD_REMOVED)
+                and self.corrupt("journal-record") == KIND_SUPPRESS_DELTA
+            )
         log.info("solver fault plan installed: %d spec(s), seed %d", len(plan.specs), plan.seed)
 
     def clear(self) -> None:
         self._plan = None
+        from ..ir import delta as ir_delta
+
+        ir_delta.set_corrupt_seam(None)
 
     def fired(self) -> int:
         plan = self._plan
         return plan.fired() if plan is not None else 0
+
+    def corruptions_fired(self) -> int:
+        plan = self._plan
+        return plan.corruptions_fired() if plan is not None else 0
 
     def set_simulation(self, simulation: bool) -> None:
         """Mark THIS thread's in-flight solve as a simulation re-solve
@@ -329,6 +390,19 @@ class FaultInjector:
         if simulation:
             return
         plan.check(entry)
+
+    def corrupt(self, entry: str, simulation: Optional[bool] = None) -> Optional[str]:
+        """State-seam mirror of check(): returns the corruption kind to
+        apply at `entry`, or None. Same no-plan fast path, same per-thread
+        simulation bypass."""
+        plan = self._plan
+        if plan is None:
+            return None
+        if simulation is None:
+            simulation = getattr(self._local, "simulation", False)
+        if simulation:
+            return None
+        return plan.corrupt(entry)
 
 
 FAULTS = FaultInjector()

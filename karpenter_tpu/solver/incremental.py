@@ -30,9 +30,11 @@ Each `advance()` classifies the pass:
           start, catalog-key change (`invalidate.catalog` — a catalog bump
           can re-shape every row), journal gap/overflow (`invalidate.gap`),
           view-pad regrowth, a forced fault invalidation (breaker opened,
-          flavor retired mid-solve — `invalidate.fault`), or a dirty set so
-          large the delta machinery would cost more than the full encode
-          (`invalidate.bulk`).
+          flavor/mesh retired or a ladder rung taken mid-solve, a classified
+          device fault at the rebase boundary — `invalidate.fault-*`), a
+          residency-auditor heal (`invalidate.audit`, solver/audit.py), or a
+          dirty set so large the delta machinery would cost more than the
+          full encode (`invalidate.bulk`).
   bypass  the incremental flag is on but there is nothing to manage (no
           views); the caller runs the fresh path untouched.
 
@@ -87,8 +89,10 @@ INCREMENTAL_PASSES = REGISTRY.counter(
 INCREMENTAL_INVALIDATIONS = REGISTRY.counter(
     "karpenter_solver_incremental_invalidations_total",
     "Resident-state invalidations forcing a full re-encode, by reason:"
-    " 'cold', 'catalog', 'gap', 'grow', 'bulk', or a fault seam"
-    " ('fault-breaker', 'fault-flavor').",
+    " 'cold', 'catalog', 'gap', 'grow', 'bulk', a fault seam"
+    " ('fault-breaker', 'fault-flavor', 'fault-chunked', 'fault-host',"
+    " 'fault-device'), or 'audit' (the residency auditor found divergence"
+    " and healed by forcing the fresh full re-encode path).",
     ("reason",),
 )
 
@@ -173,6 +177,7 @@ class IncrementalEngine:
             dt = time.perf_counter() - t0
             self._note(PASS_FULL)
             INCREMENTAL_INVALIDATIONS.inc(reason=reason)
+            self._maybe_corrupt_row()
             return AdvanceResult(enc, PASS_FULL, reason, dt, len(views))
 
         res = self._resident
@@ -184,6 +189,7 @@ class IncrementalEngine:
         enc = self._apply_delta(views, names, dirty_idx, epoch, ckey)
         dt = time.perf_counter() - t0
         self._note(PASS_DELTA)
+        self._maybe_corrupt_row()
         return AdvanceResult(enc, PASS_DELTA, "", dt, len(dirty_idx))
 
     # -- classification ----------------------------------------------------
@@ -222,6 +228,21 @@ class IncrementalEngine:
     def _note(self, kind: str) -> None:
         self.passes[kind] += 1
         INCREMENTAL_PASSES.inc(kind=kind)
+
+    def _maybe_corrupt_row(self) -> None:
+        """Seeded resident-row corruption seam (solver/faults.py): when the
+        installed plan fires 'corrupt-row' at 'resident-row', flip one value
+        in the HOST mirror — not head_dev, so the device check cannot
+        double-count the same injection — modeling a splice/aliasing bug the
+        residency auditor must detect as row-drift."""
+        res = self._resident
+        if res is None:
+            return
+        from .faults import FAULTS, KIND_CORRUPT_ROW
+
+        if FAULTS.corrupt("resident-row") == KIND_CORRUPT_ROW:
+            res.enc.avail_tol[0] += 1.0
+            log.warning("injected resident-row corruption: host mirror row 0 avail_tol flipped")
 
     # -- full rebuild ------------------------------------------------------
 
@@ -317,7 +338,9 @@ class IncrementalEngine:
                 import jax.numpy as jnp
 
                 from ..ops.rebase import pack_rebase, rebase_view_state
+                from .faults import FAULTS, KIND_CORRUPT_DEVICE
 
+                FAULTS.check("rebase")
                 rows32 = sub.head0.astype(np.float32) if dirty_idx else np.zeros((0, head0.shape[1]), np.float32)
                 perm_p, rows_p, idx_p = pack_rebase(
                     perm, rows32, np.asarray(dirty_idx, dtype=np.int32), res.vp
@@ -325,7 +348,30 @@ class IncrementalEngine:
                 head_dev = rebase_view_state(
                     res.head_dev, jnp.asarray(perm_p), jnp.asarray(rows_p), jnp.asarray(idx_p)
                 )
+                if FAULTS.corrupt("rebase") == KIND_CORRUPT_DEVICE and head_dev is not None:
+                    # seeded device-buffer corruption: perturb one element of
+                    # the rebased buffer AFTER the dispatch — the host mirror
+                    # stays byte-exact, so only the auditor's device check
+                    # (gather_rows vs f32(head0)) can see it
+                    head_dev = head_dev.at[0, 0].add(1.0)
+                    log.warning("injected device-buffer corruption: resident head_dev[0, 0] perturbed")
             except Exception as exc:  # noqa: BLE001 - residency is an optimization
+                from .faults import SOLVER_FAULTS, classify
+
+                fault = classify(exc)
+                if fault is not None:
+                    # a CLASSIFIED device fault at the rebase boundary: the
+                    # prior buffer was donated into the failed dispatch and
+                    # must never be reused — void residency entirely so the
+                    # recovery pass is a clean full re-encode (fresh upload),
+                    # and count the fault like every other dispatch boundary
+                    SOLVER_FAULTS.inc(kind=fault.kind)
+                    log.warning(
+                        "device fault at rebase boundary (%s): residency voided, next pass full re-encode: %r",
+                        fault.kind, exc,
+                    )
+                    self.invalidate("fault-device")
+                    return enc
                 log.warning("incremental device rebase failed; host-only pass: %r", exc)
                 head_dev = None
 

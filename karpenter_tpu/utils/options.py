@@ -140,6 +140,14 @@ class Options:
     # O(changes) steady state with byte-equal fallback to the fresh-encode
     # path on catalog changes, journal gaps, and fault invalidations
     solver_incremental: bool = False
+    # residency auditor (solver/audit.py): every Nth incremental provision
+    # pass re-encodes a seeded sample of view rows (plus a periodic full
+    # shadow under a byte budget) from cluster truth and compares host
+    # mirror, device-buffer rows, and the availability cube against the
+    # engine's resident state; divergence triggers a residency-divergence
+    # capsule and auto-heals by forcing the fresh full re-encode path.
+    # 0 (the default) disables the auditor entirely
+    residency_audit_interval: int = 0
     # incident capsules (capsule.py): triggered cross-subsystem evidence
     # capture — breaker opens, host-rung falls, conservation violations,
     # steady-state recompiles, lock cycles, invariant breaches, and the
@@ -181,6 +189,8 @@ class Options:
             errs.append("solver breaker threshold must be >= 1")
         if self.solver_breaker_backoff <= 0:
             errs.append("solver breaker backoff must be positive")
+        if self.residency_audit_interval < 0:
+            errs.append("residency audit interval must be non-negative")
         if self.trace_ring_size <= 0:
             errs.append("trace ring size must be positive")
         if self.flight_ring_size <= 0:
@@ -255,6 +265,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument("--solver-breaker-threshold", type=int, default=_env("SOLVER_BREAKER_THRESHOLD", defaults.solver_breaker_threshold))
     parser.add_argument("--solver-breaker-backoff", type=float, default=_env("SOLVER_BREAKER_BACKOFF", defaults.solver_breaker_backoff))
     parser.add_argument("--solver-incremental", dest="solver_incremental", action="store_true", default=_env("SOLVER_INCREMENTAL", defaults.solver_incremental))
+    parser.add_argument("--residency-audit-interval", type=int, default=_env("RESIDENCY_AUDIT_INTERVAL", defaults.residency_audit_interval))
     parser.add_argument("--disable-disruption", dest="disruption_enabled", action="store_false", default=_env("DISRUPTION_ENABLED", defaults.disruption_enabled))
     parser.add_argument("--apiserver-url", default=_env("KUBERNETES_APISERVER_URL", defaults.apiserver_url))
     parser.add_argument("--gc-interval", type=float, default=_env("GC_INTERVAL", defaults.gc_interval))

@@ -198,6 +198,61 @@ def test_controller_process_serves_capsule_debug_surface(apiserver):
             controller.communicate()
 
 
+def test_controller_process_serves_residency_debug_surface(apiserver):
+    """The residency-auditor read surface over a REAL controller process:
+    --residency-audit-interval wires /debug/residency on the metrics
+    listener — a JSON stats document with zero divergences on a healthy
+    controller — a never-audited ?row= honours the 404-JSON contract every
+    debug route shares, and the /debug index lists the route."""
+    import urllib.error
+    import urllib.request
+
+    health_port, metrics_port = _free_port(), _free_port()
+    controller = _spawn(
+        "karpenter_tpu.cmd.controller",
+        "--disable-dense-solver",
+        "--residency-audit-interval", "1",
+        "--batch-max-duration", "0.3",
+        "--batch-idle-duration", "0.05",
+        "--health-probe-port", str(health_port),
+        "--metrics-port", str(metrics_port),
+        env_extra={"KUBERNETES_APISERVER_URL": apiserver.url},
+    )
+
+    def fetch(path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{metrics_port}{path}", timeout=2) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+        except OSError:
+            return None, ""
+
+    try:
+        assert _wait(lambda: fetch("/debug/residency")[0] is not None or None, message="metrics listener")
+        code, body = fetch("/debug/residency")
+        assert code == 200, body
+        stats = json.loads(body)
+        assert stats["enabled"] is True and stats["interval"] == 1
+        assert stats["divergences"] == {} and stats["heals"] == 0, "a healthy controller never diverges"
+        assert {"passes_seen", "audits", "clean_streak", "last_divergence"} <= set(stats)
+        code, body = fetch("/debug/residency?row=nope")
+        assert code == 404
+        missing = json.loads(body)
+        assert missing["status"] == 404 and "nope" in missing["error"]
+        # the route is registered in the /debug index alongside its description
+        code, body = fetch("/debug")
+        if code == 200:
+            assert "/debug/residency" in body
+    finally:
+        controller.terminate()
+        try:
+            controller.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            controller.kill()
+            controller.communicate()
+
+
 def test_full_deployment_topology(apiserver):
     webhook = _spawn("karpenter_tpu.cmd.webhook", "--port", "0")
     controller = None

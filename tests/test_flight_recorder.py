@@ -130,7 +130,7 @@ class TestRecordContents:
         # every DenseSolveStats phase, mask included, as THIS solve's delta
         assert set(record.phases) == {
             "encode", "fill", "device", "mask", "assemble", "commit", "fill_device",
-            "delta_apply", "full_encode",
+            "delta_apply", "full_encode", "audit_seconds",
         }
         assert all(v >= 0 for v in record.phases.values())
         assert record.phases["device"] > 0

@@ -33,8 +33,11 @@ from karpenter_tpu.solver.faults import (
     BREAKER,
     FAULTS,
     KIND_DEVICE_LOST,
+    KIND_HBM,
     KIND_KERNEL,
+    RUNG_CHUNKED,
     RUNG_FLAVOR,
+    SOLVER_FAULTS,
     STATE_OPEN,
     FaultPlan,
     FaultSpec,
@@ -177,4 +180,76 @@ def test_flavor_retirement_mid_solve_voids_resident_state():
     assert engine.passes[PASS_FULL] == full_before + 1
     assert INCREMENTAL_INVALIDATIONS.value(reason="fault-flavor") == base_inval + 1
     results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "flv", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+
+
+def test_rebase_device_fault_voids_residency_with_zero_lost_pods():
+    """A CLASSIFIED device fault raised at the `rebase_view_state` dispatch
+    boundary: the prior pass's buffer was donated into the failed dispatch,
+    so it must never be reused — residency is voided (reason 'fault-device'),
+    the faulted pass still places every pod from the host-spliced mirror,
+    and the recovery pass is a clean full re-encode byte-equal to a fresh
+    solver's."""
+    provider, kube, churn, cluster, engine, solver = _rig(9000, "rbs")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "rbs")
+    base_inval = INCREMENTAL_INVALIDATIONS.value(reason="fault-device")
+    base_faults = SOLVER_FAULTS.value(kind=KIND_DEVICE_LOST)
+    full_before = engine.passes[PASS_FULL]
+
+    FAULTS.install(FaultPlan([FaultSpec(kind=KIND_DEVICE_LOST, entry="rebase", nth=1)]))
+    churn.step()
+    _solve(solver, cluster, provider, "rbs", 2)  # the faulted pass: zero lost pods
+    FAULTS.clear()
+    assert SOLVER_FAULTS.value(kind=KIND_DEVICE_LOST) == base_faults + 1, (
+        "the rebase boundary must count its classified fault like every other dispatch seam"
+    )
+    assert engine._resident is None, "a donated buffer lost to a failed dispatch must void residency"
+
+    # recovery: clean full re-encode attributed to the device seam
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "rbs", 3)
+    assert engine.passes[PASS_FULL] == full_before + 1
+    assert INCREMENTAL_INVALIDATIONS.value(reason="fault-device") == base_inval + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "rbs", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+
+    # steady state resumes after the rebuild
+    delta_before = engine.passes[PASS_DELTA]
+    churn.step()
+    _solve(solver, cluster, provider, "rbs", 4)
+    assert engine.passes[PASS_DELTA] == delta_before + 1
+
+
+def test_chunked_hbm_rung_voids_resident_state():
+    """The ROADMAP interplay gap, closed: the chunked-HBM degradation rung
+    firing mid-solve drops residency like the flavor and host rungs do — a
+    chunked dispatch re-plans the device surface under memory pressure, and
+    the donated resident buffer must not survive into that re-planned
+    surface. The next pass is a clean full re-encode ('fault-chunked')."""
+    provider, kube, churn, cluster, engine, solver = _rig(9100, "chk")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "chk")
+    base_inval = INCREMENTAL_INVALIDATIONS.value(reason="fault-chunked")
+    full_before = engine.passes[PASS_FULL]
+
+    # an HBM RESOURCE_EXHAUSTED fault at whichever new-node flavor this
+    # environment dispatches (mesh conftest -> 'sharded', else 'plain'):
+    # the ladder's reactive response is the chunked re-dispatch
+    FAULTS.install(FaultPlan([
+        FaultSpec(kind=KIND_HBM, entry="sharded", nth=1),
+        FaultSpec(kind=KIND_HBM, entry="plain", nth=1),
+    ]))
+    churn.step()
+    # a memory-bound batch that overflows the warm cluster: the spill forces
+    # the new-node dense dispatch where the HBM fault (and the rung) fires
+    _solve(solver, cluster, provider, "chk", 2, count=60, memory="16Gi")
+    FAULTS.clear()
+    if RUNG_CHUNKED not in solver._solve_rungs:
+        pytest.skip("no dense new-node dispatch in this environment; chunked rung never fired")
+    assert engine._resident is None, "the chunked rung must drop the resident state"
+
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "chk", 3)
+    assert engine.passes[PASS_FULL] == full_before + 1
+    assert INCREMENTAL_INVALIDATIONS.value(reason="fault-chunked") == base_inval + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "chk", 3)
     assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)

@@ -1,0 +1,319 @@
+"""Resident-state integrity domain (solver/audit.py): detection proofs.
+
+Each seeded corruption kind is driven end to end — a real FaultPlan firing
+at a real state seam of real solves against a real cluster mirror — and the
+auditor must detect it as exactly its kind, heal by invalidating residency
+with reason 'audit' (the next pass rides the existing byte-equal full
+re-encode path), and lose ZERO pods along the way. The clean-churn test is
+the specificity half: byte-equal residency under churn must never diverge.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import capsule
+from karpenter_tpu.ir import delta as ir_delta
+from karpenter_tpu.solver import DenseSolver
+from karpenter_tpu.solver import audit
+from karpenter_tpu.solver.audit import AUDITOR, KIND_CUBE_STALE, KIND_DEVICE_CORRUPT, KIND_MISSED_DELTA, KIND_ROW_DRIFT
+from karpenter_tpu.solver.faults import (
+    BREAKER,
+    CORRUPTION_KINDS,
+    FAULTS,
+    KIND_CORRUPT_DEVICE,
+    KIND_CORRUPT_ROW,
+    KIND_SUPPRESS_DELTA,
+    FaultPlan,
+    FaultSpec,
+)
+from karpenter_tpu.solver.incremental import INCREMENTAL_INVALIDATIONS, PASS_DELTA, PASS_FULL
+from tests.helpers import make_pod
+from tests.test_incremental_faults import _rig, _solve, _warm_to_delta
+from tests.test_warm_fill_vectorized import _fill_fingerprint
+
+
+@pytest.fixture(autouse=True)
+def _audit_hygiene():
+    FAULTS.clear()
+    BREAKER.reset()
+    AUDITOR.disable()
+    AUDITOR.reset()
+    yield
+    FAULTS.clear()
+    BREAKER.reset()
+    AUDITOR.disable()
+    AUDITOR.reset()
+
+
+def _arm(interval: int = 1) -> None:
+    """Every pass audited, every audit a full shadow: same-pass
+    deterministic detection on the rig's small cluster."""
+    AUDITOR.enable(interval=interval, shadow_every=1, seed=3)
+
+
+def _stamps():
+    return (
+        audit.divergences_total(),
+        audit.heals_total(),
+        audit.audit_passes_total(),
+        INCREMENTAL_INVALIDATIONS.value(reason="audit"),
+    )
+
+
+# -- specificity: clean churn never diverges ----------------------------------
+
+
+def test_clean_churn_audits_zero_divergences():
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9200, "aud")
+    d0, h0, p0, _ = _stamps()
+    _warm_to_delta(engine, solver, cluster, provider, churn, "aud")
+    for step in range(2, 5):
+        churn.step()
+        _solve(solver, cluster, provider, "aud", step)
+    assert audit.divergences_total() - d0 == 0, "byte-equal residency must never diverge"
+    assert audit.heals_total() - h0 == 0
+    assert audit.audit_passes_total() - p0 >= 5, "interval=1 must audit every resident pass"
+    assert AUDITOR.clean_streak() >= 5
+    assert solver.stats.audit_seconds > 0.0, "audit time must be attributed to its phase key"
+
+
+# -- row-drift: seeded host-mirror corruption ---------------------------------
+
+
+def test_corrupt_row_detected_same_pass_and_healed():
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9300, "drift")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "drift")
+    d0, h0, _, a0 = _stamps()
+    full_before = engine.passes[PASS_FULL]
+
+    plan = FaultPlan([FaultSpec(kind=KIND_CORRUPT_ROW, entry="resident-row", nth=1)])
+    FAULTS.install(plan)
+    churn.step()
+    _solve(solver, cluster, provider, "drift", 2)  # corruption + same-pass detection
+    FAULTS.clear()
+
+    assert plan.corruptions_fired() == 1, "the seeded corruption must fire exactly once"
+    assert any(h.get("kind") == KIND_CORRUPT_ROW for h in plan.history()), (
+        "the corruption must land in the determinism history witness"
+    )
+    assert audit.divergences_total() - d0 == 1
+    assert audit.RESIDENCY_DIVERGENCES.value(kind=KIND_ROW_DRIFT) >= 1
+    assert audit.heals_total() - h0 == 1
+    assert engine._resident is None, "the heal must drop residency before the fill consumes it"
+    last = AUDITOR.stats()["last_divergence"]
+    assert last["kinds"] == [KIND_ROW_DRIFT]
+    assert len(last["rows"]) == 1 and last["findings"][0]["fields"] == ["avail_tol"]
+
+    # the recovery pass is the existing byte-equal full re-encode path,
+    # attributed to the audit seam — and placement-parity with a fresh solver
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "drift", 3)
+    assert engine.passes[PASS_FULL] == full_before + 1
+    assert INCREMENTAL_INVALIDATIONS.value(reason="audit") == a0 + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "drift", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+    assert AUDITOR.clean_streak() >= 1, "the rebuilt state must re-verify clean"
+
+
+# -- missed-delta: seeded journal-record suppression --------------------------
+
+
+def test_suppressed_delta_detected_as_missed_delta():
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9400, "miss")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "miss")
+    d0, h0, _, a0 = _stamps()
+
+    plan = FaultPlan([FaultSpec(kind=KIND_SUPPRESS_DELTA, entry="journal-record", nth=1)])
+    FAULTS.install(plan)
+    assert ir_delta._corrupt_consult is not None, "install must arm the journal seam"
+    # an out-of-band pod bind (the production kube -> watch -> journal feed)
+    # whose POD_BOUND record the armed seam swallows: cluster truth moves,
+    # the journal stays silent, the mirror row goes stale
+    victim = cluster.nodes_snapshot()[0].node.name
+    kube.create(
+        make_pod(
+            name="miss-suppressed-pod",
+            labels={"app": "standing"},
+            requests={"cpu": 0.5, "memory": "512Mi"},
+            node_name=victim,
+            phase="Running",
+            unschedulable=False,
+        )
+    )
+    assert plan.corruptions_fired() == 1, "the bind's journal record must have been suppressed"
+
+    # next pass: the engine sees no dirty rows, the audit sees truth moved
+    # outside the journal window -> missed-delta (not drift)
+    _solve(solver, cluster, provider, "miss", 2)
+    FAULTS.clear()
+    assert ir_delta._corrupt_consult is None, "clear must disarm the journal seam"
+    assert audit.divergences_total() - d0 == 1
+    assert audit.RESIDENCY_DIVERGENCES.value(kind=KIND_MISSED_DELTA) >= 1
+    assert audit.heals_total() - h0 == 1
+    last = AUDITOR.stats()["last_divergence"]
+    assert last["kinds"] == [KIND_MISSED_DELTA]
+    assert last["rows"] == [victim]
+    assert last["journal_window"] is not None and victim not in last["journal_window"], (
+        "missed-delta means the journal window does NOT name the moved row"
+    )
+
+    # heal: full re-encode from truth, parity, zero lost pods throughout
+    results_i, sched_i = _solve(solver, cluster, provider, "miss", 3)
+    assert INCREMENTAL_INVALIDATIONS.value(reason="audit") == a0 + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "miss", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+
+
+# -- device-corrupt: seeded buffer perturbation at the rebase boundary --------
+
+
+def test_device_corruption_detected_at_rebase_boundary():
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9500, "dev")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "dev")
+    if engine._resident.head_dev is None:
+        pytest.skip("no device residency in this environment")
+    d0, h0, _, a0 = _stamps()
+
+    plan = FaultPlan([FaultSpec(kind=KIND_CORRUPT_DEVICE, entry="rebase", nth=1)])
+    FAULTS.install(plan)
+    churn.step()
+    _solve(solver, cluster, provider, "dev", 2)  # corrupt after dispatch, detect same pass
+    FAULTS.clear()
+
+    assert plan.corruptions_fired() == 1
+    assert audit.divergences_total() - d0 == 1
+    assert audit.RESIDENCY_DIVERGENCES.value(kind=KIND_DEVICE_CORRUPT) >= 1
+    assert audit.heals_total() - h0 == 1
+    last = AUDITOR.stats()["last_divergence"]
+    assert last["kinds"] == [KIND_DEVICE_CORRUPT]
+    assert last["findings"][-1]["fields"] == ["head_dev"], (
+        "the host mirror stayed byte-exact: only the device check can see this injection"
+    )
+
+    churn.step()
+    results_i, sched_i = _solve(solver, cluster, provider, "dev", 3)
+    assert INCREMENTAL_INVALIDATIONS.value(reason="audit") == a0 + 1
+    results_f, sched_f = _solve(DenseSolver(min_batch=1), cluster, provider, "dev", 3)
+    assert _fill_fingerprint(results_i, sched_i) == _fill_fingerprint(results_f, sched_f)
+
+
+# -- cube-stale: the cached availability cube diverges from its host truth ----
+
+
+def test_stale_availability_cube_detected_and_cache_dropped():
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9600, "cube")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "cube")
+    d0, h0, _, _ = _stamps()
+
+    # plant a cache whose device half no longer matches the host truth it
+    # claims to mirror (the staleness/aliasing bug shape): dense hands both
+    # halves to the audit, which must flag cube-stale and drop the cache
+    import jax.numpy as jnp
+
+    avail = np.ones((2, 3, 2), dtype=bool)
+    solver._avail_cube_dev = (avail, jnp.asarray(np.zeros((2, 6), np.float32)))
+    churn.step()
+    _solve(solver, cluster, provider, "cube", 2)
+
+    assert audit.divergences_total() - d0 == 1
+    assert audit.RESIDENCY_DIVERGENCES.value(kind=KIND_CUBE_STALE) >= 1
+    assert audit.heals_total() - h0 == 1
+    assert AUDITOR.stats()["last_divergence"]["cube_stale"] is True
+    assert getattr(solver, "_avail_cube_dev", "unset") is None, (
+        "a stale cube must be dropped from the cache, not reused"
+    )
+
+
+# -- read surface: /debug/residency -------------------------------------------
+
+
+def test_routes_and_descriptions_lockstep_with_404_contract():
+    assert set(audit.routes()) == set(audit.route_descriptions()), (
+        "every route must carry its /debug index description, in lockstep"
+    )
+
+    _arm()
+    provider, kube, churn, cluster, engine, solver = _rig(9700, "rt")
+    _warm_to_delta(engine, solver, cluster, provider, churn, "rt")
+
+    status, ctype, body = audit._residency_route({})
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc["enabled"] is True and doc["audits"] >= 1 and doc["divergences"] == {}
+
+    audited = cluster.nodes_snapshot()[0].node.name
+    status, _, body = audit._residency_route({"row": [audited]})
+    assert status == 200 and json.loads(body)["row"] == audited
+
+    status, _, body = audit._residency_route({"row": ["never-a-node"]})
+    assert status == 404
+    err = json.loads(body)
+    assert err["status"] == 404 and "error" in err
+
+
+# -- plan plumbing -------------------------------------------------------------
+
+
+def test_corruption_kinds_are_plan_vocabulary():
+    assert set(CORRUPTION_KINDS) == {KIND_CORRUPT_ROW, KIND_SUPPRESS_DELTA, KIND_CORRUPT_DEVICE}
+    for kind, entry in (
+        (KIND_CORRUPT_ROW, "resident-row"),
+        (KIND_SUPPRESS_DELTA, "journal-record"),
+        (KIND_CORRUPT_DEVICE, "rebase"),
+    ):
+        FaultSpec(kind=kind, entry=entry)  # must validate
+    with pytest.raises(ValueError):
+        FaultSpec(kind="corrupt-everything", entry="resident-row")
+
+
+def test_journal_seam_suppresses_only_pod_level_records():
+    plan = FaultPlan([FaultSpec(kind=KIND_SUPPRESS_DELTA, entry="journal-record", nth=1)])
+    FAULTS.install(plan)
+    journal = ir_delta.DeltaJournal()
+    # node-level records pass through untouched: a dropped NODE_ADDED is
+    # invisible to any auditor (the engine diffs the row set directly), so
+    # spending a trigger on one would inject an undetectable corruption
+    e1 = journal.record("n-a", ir_delta.NODE_ADDED)
+    assert e1 == 1 and plan.corruptions_fired() == 0
+    # the first pod-level record is swallowed: epoch unmoved, name unseen
+    e2 = journal.record("n-a", ir_delta.POD_BOUND)
+    assert e2 == e1 and plan.corruptions_fired() == 1
+    assert "n-a" not in (journal.dirty_since(e1) or frozenset())
+    # the trigger is spent: the next pod record region flows normally
+    e3 = journal.record("n-b", ir_delta.POD_BOUND)
+    assert e3 == e1 + 1
+    FAULTS.clear()
+
+
+def test_storm_scenario_and_score_keys_registered():
+    from karpenter_tpu.scenarios import schema
+    from karpenter_tpu.scenarios.campaign import default_campaign, residency_settled
+
+    for key in ("residency_divergences", "residency_heals", "audit_passes"):
+        assert key in schema.SCORE_KEYS
+    assert capsule.TRIGGER_RESIDENCY in capsule.TRIGGERS
+
+    storm = next(s for s in default_campaign() if s.name == "residency_divergence_storm")
+    assert storm.residency_audit_interval == 1
+    assert storm.settled is residency_settled
+    kinds = sorted(spec["kind"] for spec in storm.fault_specs)
+    assert kinds == [KIND_CORRUPT_ROW, KIND_SUPPRESS_DELTA]
+    soak = next(s for s in default_campaign() if s.name == "chaos_soak")
+    assert soak.residency_audit_interval > 0, "the soak must pin healthy divergences at zero"
+
+
+def test_audit_interval_option_parses():
+    from karpenter_tpu.utils.options import parse
+
+    opts = parse(["--residency-audit-interval", "4"])
+    assert opts.residency_audit_interval == 4
+    assert parse([]).residency_audit_interval == 0

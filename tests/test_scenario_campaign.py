@@ -86,6 +86,9 @@ class TestSchemaValidator:
                         "compressed_seconds": 1.0,
                         "capsules_captured": 0,
                         "capsule_triggers": {},
+                        "residency_divergences": 0,
+                        "residency_heals": 0,
+                        "audit_passes": 0,
                         "waterfall": {
                             "queue_wait": {"p50": 0.0, "p95": 0.01, "p99": 0.01, "count": 4},
                             "solve": {"p50": 0.02, "p95": 0.03, "p99": 0.03, "count": 4},
@@ -158,6 +161,18 @@ class TestSchemaValidator:
         assert scenario_doc_errors(doc) == []
         doc["runs"][0]["scores"]["solver_latency_p95_flatness"] = -1.0
         assert any("solver_latency_p95_flatness" in e for e in scenario_doc_errors(doc))
+
+    def test_residency_audit_scores_required_and_typed(self):
+        # the residency-auditor keys are schema-gated on ALL runs (scored 0
+        # when the scenario never armed the auditor) so a healthy run pins
+        # divergences == 0 rather than silently omitting the key
+        for key in ("residency_divergences", "residency_heals", "audit_passes"):
+            doc = self._valid_doc()
+            del doc["runs"][0]["scores"][key]
+            assert any(key in e for e in scenario_doc_errors(doc))
+            doc = self._valid_doc()
+            doc["runs"][0]["scores"][key] = 1.5
+            assert any(key in e for e in scenario_doc_errors(doc))
 
     def test_solver_fault_scores_required_and_typed(self):
         doc = self._valid_doc()
